@@ -1,0 +1,29 @@
+"""tpu-life: a TPU-native Game of Life framework.
+
+A brand-new, TPU-first rebuild of the capabilities of the MPI+CUDA reference
+(shoron-dutta/Game-of-Life---MPI-CUDA): torus Game of Life, five seed
+patterns, spatial domain decomposition with ring halo exchange, the exact
+five-argument CLI surface, per-rank world dumps, and duration/cell-update
+reporting — implemented on JAX/XLA with `shard_map` + `lax.ppermute` over a
+device mesh instead of MPI point-to-point, XLA stencils (with a Pallas fused
+fast path and a bit-packed SWAR perf tier) instead of a CUDA kernel, and a
+pure-functional double buffer via XLA input/output aliasing instead of
+pointer swaps.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  L1 CLI/driver            -> gol_tpu.cli (+ native/gol_driver.cpp)
+  L2 distributed halo comm -> gol_tpu.parallel.halo (lax.ppermute rings)
+  L3 step orchestration    -> gol_tpu.parallel.engine / gol_tpu.ops.stencil.run
+  L4 device memory/runtime -> XLA HBM arrays + donation (no explicit mgmt)
+  L5 compute kernel        -> gol_tpu.ops.stencil / ops.pallas_step / ops.bitlife
+  L6 init patterns         -> gol_tpu.models.patterns
+  L7 observability/output  -> gol_tpu.utils.io / utils.timing
+"""
+
+__version__ = "0.1.0"
+
+from gol_tpu.models.state import GolState
+from gol_tpu.models import patterns
+from gol_tpu.ops import stencil
+
+__all__ = ["GolState", "patterns", "stencil", "__version__"]

@@ -1,0 +1,6 @@
+"""Model layer: world state pytree and the seed-pattern "model zoo"."""
+
+from gol_tpu.models.state import GolState
+from gol_tpu.models import patterns
+
+__all__ = ["GolState", "patterns"]

@@ -1,0 +1,114 @@
+"""Seed patterns 0-4: the reference's "model zoo".
+
+The reference implements five init functions dispatched by an integer id
+(``gol_initMaster`` switch, ``gol-with-cuda.cu:302-327``).  Each rank holds a
+``size × size`` local block; the global world stacks ``num_ranks`` blocks
+vertically.  We reproduce each pattern's *effective* cell placement exactly
+(including the consequences of the reference's index-math bugs on square
+worlds) while replacing its out-of-bounds UB with validation errors:
+
+- pattern 0 ``gol_initAllZeros``       (gol-with-cuda.cu:56-69):  all dead.
+- pattern 1 ``gol_initAllOnes``        (gol-with-cuda.cu:72-92):  all alive.
+- pattern 2 ``gol_initOnesInMiddle``   (gol-with-cuda.cu:95-120): despite the
+  name, every rank sets 10 live cells at flat indices
+  ``(H-1)*H + 127 .. +136`` (bug B3 uses height where width belongs; on the
+  CLI-enforced square worlds that lands on the *last local row*, columns
+  127-136).  Bug B4: the reference heap-overflows when ``size < 137``; we
+  raise a ValueError instead (see :func:`validate_pattern_size`).
+- pattern 3 ``gol_initOnesAtCorners``  (gol-with-cuda.cu:123-147): rank 0 sets
+  the two top corners of its block, the last rank sets its two bottom corners
+  (index ``H*(W-1)`` is again square-only math) — i.e. the four corners of the
+  global stacked world.
+- pattern 4 ``gol_initSpinnerAtCorner`` (gol-with-cuda.cu:150-171): rank 0
+  only, live cells at local (0,0), (0,1) and (0, W-1) — a horizontal blinker
+  spanning the column wrap; a period-2 oscillator used as the de-facto
+  correctness probe.
+
+All constructors are NumPy-free of device work until the caller moves the
+board to devices; per-shard constructors exist so a 65536² world never has to
+materialize unsharded on one host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gol_tpu.models.state import Geometry
+
+PATTERN_NAMES = {
+    0: "all_zeros",
+    1: "all_ones",
+    2: "ones_in_middle",  # effective: last local row, cols 127-136 (B3/B4)
+    3: "ones_at_corners",  # global corners
+    4: "spinner_at_corner",  # wrap-spanning blinker on rank 0
+}
+
+#: Pattern 2 writes flat indices (H-1)*H+127 .. +136 (gol-with-cuda.cu:108-114);
+#: on a square world that is columns 127..136 of the last row, so any
+#: worldSize < 137 overflowed the reference's heap (bug B4).
+PATTERN2_COL0 = 127
+PATTERN2_NCELLS = 10
+PATTERN2_MIN_SIZE = PATTERN2_COL0 + PATTERN2_NCELLS  # 137
+
+
+def validate_pattern(pattern: int) -> None:
+    """Unknown pattern ids exit in the reference (gol-with-cuda.cu:324-326)."""
+    if pattern not in PATTERN_NAMES:
+        raise ValueError(f"Pattern {pattern} has not been implemented")
+
+
+def validate_pattern_size(pattern: int, size: int) -> None:
+    """Reject geometries that were undefined behavior in the reference (B4)."""
+    validate_pattern(pattern)
+    if pattern == 2 and size < PATTERN2_MIN_SIZE:
+        raise ValueError(
+            f"pattern 2 requires worldSize >= {PATTERN2_MIN_SIZE} (the reference "
+            f"writes columns {PATTERN2_COL0}..{PATTERN2_COL0 + PATTERN2_NCELLS - 1} "
+            f"of the last row and heap-overflows below that; got size={size})"
+        )
+
+
+def init_local(pattern: int, size: int, rank: int, num_ranks: int) -> np.ndarray:
+    """One rank's ``size × size`` local block at t=0, as uint8.
+
+    Mirrors the per-rank behavior of ``gol_initMaster`` → ``gol_init*``
+    (gol-with-cuda.cu:286-328): patterns 0-2 are rank-oblivious, patterns 3-4
+    condition on ``myRank``/``numRank``.
+    """
+    validate_pattern_size(pattern, size)
+    if not (0 <= rank < num_ranks):
+        raise ValueError(f"rank {rank} out of range for {num_ranks} ranks")
+
+    board = np.zeros((size, size), dtype=np.uint8)
+    if pattern == 0:
+        pass
+    elif pattern == 1:
+        board[:] = 1
+    elif pattern == 2:
+        board[size - 1, PATTERN2_COL0 : PATTERN2_COL0 + PATTERN2_NCELLS] = 1
+    elif pattern == 3:
+        if rank == 0:
+            board[0, 0] = 1
+            board[0, size - 1] = 1
+        # `else if` in the reference (gol-with-cuda.cu:139): with num_ranks == 1
+        # rank 0 takes the first branch only, so the bottom corners stay dead.
+        elif rank == num_ranks - 1:
+            board[size - 1, 0] = 1
+            board[size - 1, size - 1] = 1
+    elif pattern == 4:
+        if rank == 0:
+            board[0, 0] = 1
+            board[0, 1] = 1
+            board[0, size - 1] = 1
+    return board
+
+
+def init_global(pattern: int, size: int, num_ranks: int) -> np.ndarray:
+    """The full ``(num_ranks*size) × size`` world at t=0 (ranks stacked)."""
+    geom = Geometry(size=size, num_ranks=num_ranks)
+    board = np.empty((geom.global_height, geom.global_width), dtype=np.uint8)
+    for rank in range(num_ranks):
+        board[rank * size : (rank + 1) * size] = init_local(
+            pattern, size, rank, num_ranks
+        )
+    return board

@@ -1,0 +1,94 @@
+"""World state as an immutable pytree.
+
+The reference keeps its state in C globals shared across two translation
+units (``g_data``/``g_resultData``/``g_worldWidth``/``g_worldHeight`` at
+``gol-main.c:11-13`` and ``gol-with-cuda.cu:10-30``, ghost-row pointers at
+``gol-main.c:11``).  The TPU-native design replaces all of that with a single
+immutable dataclass threaded through pure step functions:
+
+- the double buffer (``gol_swap``, ``gol-with-cuda.cu:174-186``) becomes XLA
+  input/output aliasing — step functions donate their input board;
+- the four ghost-row buffers (``init_Ghost_rows``, ``gol-with-cuda.cu:32-53``)
+  have no stored equivalent: fresh halos are produced per step by
+  ``lax.ppermute`` (or, in reference-compat mode, frozen t=0 halos are carried
+  explicitly in the state — see :mod:`gol_tpu.parallel.engine`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+CELL_DTYPE = jnp.uint8
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GolState:
+    """Immutable Game-of-Life world state.
+
+    Attributes:
+      board: uint8[H, W] cell grid (1 = alive, 0 = dead). May be the global
+        world or one shard's local block depending on context.
+      generation: uint32 scalar — number of steps taken so far.
+    """
+
+    board: jax.Array
+    generation: jax.Array
+
+    @staticmethod
+    def create(board: jax.Array, generation: int = 0) -> "GolState":
+        return GolState(
+            board=jnp.asarray(board, CELL_DTYPE),
+            generation=jnp.asarray(generation, jnp.uint32),
+        )
+
+    @property
+    def height(self) -> int:
+        return self.board.shape[-2]
+
+    @property
+    def width(self) -> int:
+        return self.board.shape[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """Static world geometry: the TPU-native equivalent of the reference's
+    rank bookkeeping (``myRank``/``numRank``/``g_worldWidth``/``g_worldHeight``
+    globals, ``gol-main.c:13,55-62``).
+
+    The reference's global world is ``num_ranks`` stacked ``size × size``
+    blocks: ``(num_ranks * size)`` rows by ``size`` columns (row labels at
+    ``gol-main.c:22``, cell-update count at ``gol-main.c:124-125``).  Both
+    axes are periodic (torus): columns wrap mod width inside the kernel
+    (``gol-with-cuda.cu:210-211``), rows wrap because the rank ring uses mod
+    arithmetic (``gol-main.c:86-87``).
+    """
+
+    size: int  # per-rank square edge (CLI `worldSize`)
+    num_ranks: int  # logical ranks (= shards of the row axis)
+
+    def __post_init__(self):
+        if self.size <= 0:
+            raise ValueError(f"worldSize must be positive, got {self.size}")
+        if self.num_ranks <= 0:
+            raise ValueError(f"num_ranks must be positive, got {self.num_ranks}")
+
+    @property
+    def global_height(self) -> int:
+        return self.size * self.num_ranks
+
+    @property
+    def global_width(self) -> int:
+        return self.size
+
+    @property
+    def local_height(self) -> int:
+        return self.size
+
+    def cell_updates(self, iterations: int) -> int:
+        """`numRank * H * W * iterations` (gol-main.c:124-125)."""
+        return self.num_ranks * self.size * self.size * iterations

@@ -1,0 +1,5 @@
+"""Compute ops: the TPU-native replacements for the CUDA kernel layer."""
+
+from gol_tpu.ops import stencil
+
+__all__ = ["stencil"]

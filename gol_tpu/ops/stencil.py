@@ -1,0 +1,126 @@
+"""Dense torus Game-of-Life stencil, single device.
+
+TPU-native replacement for the CUDA kernel path of the reference:
+
+- ``gol_kernel`` (gol-with-cuda.cu:189-262) — a grid-stride SIMT loop doing a
+  per-cell 8-neighbor sum with mod-width column wrap (:210-211), ghost-row
+  substitution on the first/last local rows (:224-231), and the B3/S23 rule as
+  an if/else chain (:239-257) — becomes a vectorized separable roll-sum plus a
+  branchless rule, fused by XLA onto the VPU.
+- ``gol_kernelLaunch`` (gol-with-cuda.cu:264-284) — per-step launch +
+  ``cudaDeviceSynchronize`` + pointer swap — becomes a single jitted program:
+  the multi-generation loop is a ``lax.fori_loop`` *inside* the compiled fn
+  (no per-step host sync), and the double buffer is XLA buffer donation.
+
+The neighbor sum is separable: one vertical 3-row sum then one horizontal
+3-column sum (4 rolls + 4 adds instead of 8 rolls + 7 adds), then subtract
+the center.  Counts fit in uint8 (max 9), so everything stays 1 byte/cell in
+HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gol_tpu.models.state import CELL_DTYPE
+
+
+def life_rule(board: jax.Array, neighbors: jax.Array) -> jax.Array:
+    """Branchless B3/S23: born on 3, survive on 2 or 3.
+
+    Equivalent to the reference's if/else chain (gol-with-cuda.cu:239-257),
+    which is only defined for 0/1 cells; we require uint8 0/1 boards.
+    """
+    alive = board == 1
+    nxt = (neighbors == 3) | (alive & (neighbors == 2))
+    return nxt.astype(CELL_DTYPE)
+
+
+def neighbor_count_torus(board: jax.Array) -> jax.Array:
+    """8-neighbor count on a fully periodic board via separable roll-sums.
+
+    Columns wrap mod W and rows wrap mod H — the reference's global topology
+    (x wrap at gol-with-cuda.cu:210-211; row wrap via the mod-ring rank ids,
+    gol-main.c:86-87).
+    """
+    rows3 = board + jnp.roll(board, 1, axis=-2) + jnp.roll(board, -1, axis=-2)
+    total = rows3 + jnp.roll(rows3, 1, axis=-1) + jnp.roll(rows3, -1, axis=-1)
+    return total - board
+
+
+def step(board: jax.Array) -> jax.Array:
+    """One generation on a fully periodic (torus) board."""
+    return life_rule(board, neighbor_count_torus(board))
+
+
+def step_reduce_window(board: jax.Array) -> jax.Array:
+    """Same semantics via wrap-pad + ``lax.reduce_window`` 3×3 add.
+
+    Kept as an alternative lowering of the stencil (the SURVEY §7 step-1
+    candidate); benchmarking picks the default — the roll-sum variant wins on
+    TPU because XLA fuses the separable adds into one VPU pass.
+    """
+    padded = jnp.pad(board, 1, mode="wrap").astype(jnp.int32)
+    total = lax.reduce_window(padded, 0, lax.add, (3, 3), (1, 1), "valid")
+    return life_rule(board, (total - board).astype(CELL_DTYPE))
+
+
+def step_halo_rows(block: jax.Array, top: jax.Array, bottom: jax.Array) -> jax.Array:
+    """One generation of a row-sharded local block with explicit row halos.
+
+    ``top`` is the previous rank's last row (the reference's
+    ``previous_last_row``), ``bottom`` the next rank's first row
+    (``next_first_row``) — the ghost rows of gol-main.c:11 /
+    gol-with-cuda.cu:26-30.  Columns wrap locally mod W because the width axis
+    is not sharded (gol-with-cuda.cu:210-211).
+    """
+    ext = jnp.concatenate([top[None, :], block, bottom[None, :]], axis=0)
+    rows3 = ext[:-2] + ext[1:-1] + ext[2:]
+    total = rows3 + jnp.roll(rows3, 1, axis=-1) + jnp.roll(rows3, -1, axis=-1)
+    return life_rule(block, total - block)
+
+
+def step_halo_full(ext: jax.Array) -> jax.Array:
+    """One generation given a fully halo-extended block ``ext[h+2, w+2]``.
+
+    Used by the 2-D block decomposition (edge + corner halos already in
+    place); no wrap is applied — the halo ring carries all periodicity.
+    Returns the updated interior ``[h, w]``.
+    """
+    rows3 = ext[:-2] + ext[1:-1] + ext[2:]  # [h, w+2]
+    total = rows3[:, :-2] + rows3[:, 1:-1] + rows3[:, 2:]  # [h, w]
+    center = ext[1:-1, 1:-1]
+    return life_rule(center, total - center)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def run(board: jax.Array, steps: int) -> jax.Array:
+    """Evolve a torus board ``steps`` generations in one compiled program.
+
+    The host loop of gol-main.c:94-116 collapses into ``lax.fori_loop``; the
+    donated argument gives the double buffer for free (no ``gol_swap``).
+    """
+    return lax.fori_loop(0, steps, lambda _, b: step(b), board)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def run_reference_semantics(board: jax.Array, steps: int) -> jax.Array:
+    """Evolve with the reference's *as-implemented* (buggy) semantics.
+
+    Bug B1: the reference fills its halo send buffers once at t=0
+    (gol-with-cuda.cu:40-47) and never refreshes them, so every step's
+    exchanged ghost rows are the t=0 boundary rows.  With one rank,
+    prev == next == self, so the vertical wrap neighbors are frozen at t=0.
+    This single-rank compat path pins ``top``/``bottom`` to the initial last/
+    first rows; the multi-rank compat engine lives in
+    :mod:`gol_tpu.parallel.engine`.
+    """
+    top0 = board[-1]  # my_last_row at t=0 → received as previous_last_row
+    bottom0 = board[0]  # my_first_row at t=0 → received as next_first_row
+    return lax.fori_loop(
+        0, steps, lambda _, b: step_halo_rows(b, top0, bottom0), board
+    )

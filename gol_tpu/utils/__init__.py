@@ -1,0 +1,1 @@
+"""Utilities: I/O, timing/observability, config validation, native bindings."""

@@ -1,0 +1,27 @@
+"""Test env: force the CPU backend with 8 virtual devices.
+
+Sharded-path tests run the exact same shard_map/ppermute programs on a
+host-local 8-device mesh (the standard JAX trick), substituting for a real
+pod — this covers the halo logic the reference never tested (bug B1).
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+# Hard override, not setdefault: the ambient env pins JAX_PLATFORMS to the
+# single real TPU (axon); tests must run on the deterministic 8-device CPU
+# mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# This machine's site hook (/root/.axon_site) pre-imports jax at interpreter
+# startup, so the env var above can be read too late.  The config API takes
+# effect post-import; without it the first backend touch would try to claim
+# the axon TPU tunnel and can hang the whole suite.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,85 @@
+"""Trivially-correct NumPy oracles for Game of Life, independent of JAX.
+
+Two semantics are modeled:
+
+- `step_torus`: correct torus GoL (both axes periodic) — what the new
+  framework's default engine implements.
+- `simulate_reference`: the reference program's *as-implemented* semantics,
+  including bug B1 (halo send buffers filled once at t=0 and never refreshed,
+  gol-with-cuda.cu:40-47 vs the loop gol-main.c:94-116): each rank's block
+  evolves with its top/bottom ghost rows frozen at the neighbors' t=0
+  boundary rows, while columns wrap mod W locally.  Used to validate the
+  compat engine bit-for-bit.
+
+Written with explicit per-cell loops over shifted views kept deliberately
+different in structure from the JAX implementation (8 explicit shifts here
+vs. separable roll-sums there) so a shared bug is unlikely.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _neighbors_torus(board: np.ndarray) -> np.ndarray:
+    n = np.zeros(board.shape, dtype=np.int32)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            if dy == 0 and dx == 0:
+                continue
+            n += np.roll(np.roll(board, dy, axis=0), dx, axis=1)
+    return n
+
+
+def _apply_rule(board: np.ndarray, n: np.ndarray) -> np.ndarray:
+    return ((n == 3) | ((board == 1) & (n == 2))).astype(np.uint8)
+
+
+def step_torus(board: np.ndarray) -> np.ndarray:
+    """One generation, both axes periodic (correct global semantics)."""
+    return _apply_rule(board, _neighbors_torus(board))
+
+
+def run_torus(board: np.ndarray, steps: int) -> np.ndarray:
+    for _ in range(steps):
+        board = step_torus(board)
+    return board
+
+
+def _step_block_frozen_halos(
+    block: np.ndarray, top: np.ndarray, bottom: np.ndarray
+) -> np.ndarray:
+    """One step of a local block with given ghost rows; columns wrap mod W."""
+    ext = np.concatenate([top[None, :], block, bottom[None, :]], axis=0)
+    n = np.zeros(block.shape, dtype=np.int32)
+    h = block.shape[0]
+    for dy in (-1, 0, 1):
+        rows = ext[1 + dy : 1 + dy + h]
+        for dx in (-1, 0, 1):
+            if dy == 0 and dx == 0:
+                continue
+            n += np.roll(rows, dx, axis=1)
+    return _apply_rule(block, n)
+
+
+def simulate_reference(
+    global_board: np.ndarray, num_ranks: int, steps: int
+) -> np.ndarray:
+    """Evolve with the reference's as-implemented stale-halo semantics (B1).
+
+    Every step, rank r receives rank (r-1)%n's *t=0* last row and rank
+    (r+1)%n's *t=0* first row (the send buffers are never refreshed), so the
+    blocks are mutually independent after t=0.
+    """
+    height = global_board.shape[0]
+    assert height % num_ranks == 0
+    s = height // num_ranks
+    blocks = [global_board[r * s : (r + 1) * s].copy() for r in range(num_ranks)]
+    top0 = [blocks[(r - 1) % num_ranks][-1].copy() for r in range(num_ranks)]
+    bot0 = [blocks[(r + 1) % num_ranks][0].copy() for r in range(num_ranks)]
+    for _ in range(steps):
+        blocks = [
+            _step_block_frozen_halos(blocks[r], top0[r], bot0[r])
+            for r in range(num_ranks)
+        ]
+    return np.concatenate(blocks, axis=0)

@@ -1,0 +1,77 @@
+"""Exact cell placement for every init pattern, incl. buggy-effective ones."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models import patterns
+
+
+def test_pattern0_all_zeros():
+    b = patterns.init_global(0, 16, 3)
+    assert b.shape == (48, 16) and b.dtype == np.uint8
+    assert b.sum() == 0
+
+
+def test_pattern1_all_ones():
+    b = patterns.init_global(1, 16, 2)
+    assert b.shape == (32, 16)
+    assert (b == 1).all()
+
+
+def test_pattern2_last_row_cols_127_136_every_rank():
+    """Effective behavior of gol-with-cuda.cu:108-114 on square worlds:
+    10 live cells on each rank's LAST local row, columns 127-136 (the
+    'middle' in the name is a misnomer — bug B3)."""
+    s, r = 140, 3
+    b = patterns.init_global(2, s, r)
+    expected = np.zeros((r * s, s), np.uint8)
+    for rank in range(r):
+        expected[rank * s + s - 1, 127:137] = 1
+    np.testing.assert_array_equal(b, expected)
+    assert b.sum() == 10 * r
+
+
+def test_pattern2_small_world_rejected():
+    """Bug B4 (OOB heap write when size < 137) becomes a clear error."""
+    with pytest.raises(ValueError, match="137"):
+        patterns.init_local(2, 136, 0, 1)
+    patterns.init_local(2, 137, 0, 1)  # exactly at the bound: fine
+
+
+def test_pattern3_global_corners():
+    s, r = 8, 4
+    b = patterns.init_global(3, s, r)
+    expected = np.zeros((r * s, s), np.uint8)
+    expected[0, 0] = expected[0, s - 1] = 1
+    expected[r * s - 1, 0] = expected[r * s - 1, s - 1] = 1
+    np.testing.assert_array_equal(b, expected)
+
+
+def test_pattern3_single_rank_top_corners_only():
+    """With numRank==1 the reference's `else if` (gol-with-cuda.cu:139) never
+    fires: only the TOP corners are set."""
+    b = patterns.init_global(3, 8, 1)
+    assert b.sum() == 2
+    assert b[0, 0] == 1 and b[0, 7] == 1
+
+
+def test_pattern4_spinner_rank0_only():
+    s, r = 8, 3
+    b = patterns.init_global(4, s, r)
+    assert b.sum() == 3
+    assert b[0, 0] == 1 and b[0, 1] == 1 and b[0, s - 1] == 1
+
+
+def test_unknown_pattern_rejected():
+    with pytest.raises(ValueError, match="not been implemented"):
+        patterns.init_local(5, 8, 0, 1)
+
+
+def test_init_local_stacks_to_global():
+    for pat in (0, 1, 3, 4):
+        g = patterns.init_global(pat, 8, 4)
+        for rank in range(4):
+            np.testing.assert_array_equal(
+                g[rank * 8 : (rank + 1) * 8],
+                patterns.init_local(pat, 8, rank, 4),
+            )

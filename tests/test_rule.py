@@ -1,0 +1,34 @@
+"""Rule-table unit tests: all 2×9 alive×neighbor-count cases.
+
+The reference encodes B3/S23 as an if/else chain (gol-with-cuda.cu:239-257);
+these tests enumerate every (alive, neighbor_count) combination explicitly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gol_tpu.ops import stencil
+
+
+@pytest.mark.parametrize("alive", [0, 1])
+@pytest.mark.parametrize("n", list(range(9)))
+def test_rule_table(alive, n):
+    if alive:
+        expected = 1 if n in (2, 3) else 0  # survive on 2 or 3
+    else:
+        expected = 1 if n == 3 else 0  # born on exactly 3
+    board = jnp.full((1, 1), alive, jnp.uint8)
+    count = jnp.full((1, 1), n, jnp.uint8)
+    out = stencil.life_rule(board, count)
+    assert out.dtype == jnp.uint8
+    assert int(out[0, 0]) == expected
+
+
+def test_rule_vectorized_matches_scalar():
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, 2, (16, 16)).astype(np.uint8)
+    counts = rng.integers(0, 9, (16, 16)).astype(np.uint8)
+    out = np.asarray(stencil.life_rule(jnp.asarray(board), jnp.asarray(counts)))
+    expected = ((counts == 3) | ((board == 1) & (counts == 2))).astype(np.uint8)
+    np.testing.assert_array_equal(out, expected)
