@@ -1,0 +1,8 @@
+"""`python -m gol_tpu <pattern> <size> <iterations> <threads> <on_off>`."""
+
+import sys
+
+from gol_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

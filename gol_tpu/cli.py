@@ -1,0 +1,148 @@
+"""Command-line driver preserving the reference's exact 5-argument surface.
+
+The reference driver (``main``, gol-main.c:30-146) takes
+``./gol <pattern> <worldSize> <iterations> <threadsPerBlock> <on_off>``
+(parse at gol-main.c:43-53), runs the halo-exchange generation loop, prints
+rank 0's timing line (gol-main.c:124-125) and a closing banner
+(gol-main.c:132), and — when ``on_off == 1`` — dumps each rank's final block
+to ``Rank_<r>_of_<n>.txt`` (gol-main.c:64-73,135-139).
+
+This TPU driver keeps that surface verbatim and adds optional flags *after*
+the five positionals:
+
+- ``--ranks N``: logical rank count (the reference gets this from
+  ``mpirun -np N``; here the world is ``N`` stacked ``S×S`` blocks evolved
+  on however many TPU devices exist — logical decomposition is decoupled
+  from physical chips).
+- ``--halo {fresh,stale_t0}``: correct torus semantics (default) or the
+  reference's as-implemented stale-halo semantics (bug B1) for bit-exact
+  output parity.
+- ``--engine {auto,dense,bitpack,pallas}``: stencil implementation tier.
+- ``--outdir DIR``, ``--profile DIR``, ``--compat-banner``,
+  ``--checkpoint-every K`` / ``--resume PATH`` (capability additions).
+
+``threadsPerBlock`` configured the CUDA launch (gol-main.c:52,
+gol-with-cuda.cu:272-275); XLA owns tiling here, so the value is validated
+(fixing bug B5's silent 0-block no-op) and forwarded as the Pallas tile-size
+hint where applicable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+USAGE = (
+    "GOL requires 5 arguments: pattern number, sq size of the world and the "
+    "number of itterations, threads per block and output-on-off e.g. "
+    "./gol 0 32 2 512 0 \n"
+)
+
+_ATOI_RE = re.compile(r"\s*([+-]?\d+)")
+
+
+def atoi(text: str) -> int:
+    """C ``atoi`` semantics (gol-main.c:49-53): leading integer, else 0."""
+    m = _ATOI_RE.match(text)
+    return int(m.group(1)) if m else 0
+
+
+def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
+    """Parse the 5 reference positionals + extension flags.
+
+    Returns None (after printing usage) when the positional count is wrong —
+    the caller exits with the reference's status (-1 → 255).
+    """
+    ext = argparse.ArgumentParser(prog="gol", add_help=True)
+    ext.add_argument("positionals", nargs="*", metavar="ARG")
+    ext.add_argument("--ranks", type=int, default=1)
+    ext.add_argument("--halo", choices=["fresh", "stale_t0"], default="fresh")
+    ext.add_argument(
+        "--engine", choices=["auto", "dense", "bitpack", "pallas"], default="auto"
+    )
+    ext.add_argument("--outdir", default=".")
+    ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
+    ext.add_argument("--compat-banner", action="store_true")
+    ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
+    ext.add_argument("--checkpoint-dir", default=None)
+    ext.add_argument("--resume", default=None, metavar="CKPT")
+    ns = ext.parse_args(list(argv))
+    if len(ns.positionals) != 5:
+        sys.stdout.write(USAGE)
+        return None
+    ns.pattern = atoi(ns.positionals[0])
+    ns.world_size = atoi(ns.positionals[1])
+    ns.iterations = atoi(ns.positionals[2])
+    ns.threads = atoi(ns.positionals[3])
+    ns.on_off = atoi(ns.positionals[4])
+    return ns
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ns = parse_args(argv)
+    if ns is None:
+        return 255  # exit(-1) in the reference (gol-main.c:46)
+
+    from gol_tpu.models import patterns
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    try:
+        geom = Geometry(size=ns.world_size, num_ranks=ns.ranks)
+        patterns.validate_pattern_size(ns.pattern, ns.world_size)
+        if ns.threads <= 0:
+            raise ValueError(
+                f"threads per block must be positive, got {ns.threads} "
+                "(the reference silently launched zero blocks here — bug B5)"
+            )
+        if ns.iterations < 0:
+            raise ValueError(f"iterations must be >= 0, got {ns.iterations}")
+    except ValueError as e:
+        print(e)
+        return 255
+
+    try:
+        rt = GolRuntime(
+            geometry=geom,
+            engine=ns.engine,
+            halo_mode=ns.halo,
+            tile_hint=ns.threads,
+            checkpoint_every=ns.checkpoint_every,
+            checkpoint_dir=ns.checkpoint_dir,
+        )
+        report, final_state = rt.run(
+            pattern=ns.pattern,
+            iterations=ns.iterations,
+            resume=ns.resume,
+            profile_dir=ns.profile,
+        )
+    except (ValueError, OSError) as e:
+        # Same clean-error convention as the pre-validation path: bad
+        # --resume paths/shapes, unavailable engines, unwritable dirs.
+        print(e)
+        return 255
+
+    # Rank 0's report (gol-main.c:121-128) + closing banner (gol-main.c:132).
+    print(report.duration_line())
+    accelerator = "GPU" if ns.compat_banner else "TPU"
+    print(
+        f"This is the Game of Life running in parallel on a {accelerator} "
+        "on multiple ranks."
+    )
+
+    if ns.on_off == 1:
+        from gol_tpu.utils import io as gol_io
+
+        gol_io.write_world_dumps(
+            np.asarray(final_state.board), geom.num_ranks, ns.outdir
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+"""Distributed layer: device meshes, ppermute halo exchange, sharded engines."""

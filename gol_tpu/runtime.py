@@ -1,0 +1,191 @@
+"""Runtime orchestration: engine selection, compile/evolve phases, snapshots.
+
+This is the TPU-native stand-in for the reference's L3/L4 layers:
+``gol_initMaster``'s device binding + pattern dispatch
+(gol-with-cuda.cu:286-328) becomes pattern init + ``jax.device_put``;
+``gol_kernelLaunch``'s per-step launch/sync/swap (gol-with-cuda.cu:264-284)
+becomes one ahead-of-time-compiled program holding the entire generation
+loop; ``cuda_finalize`` (gol-with-cuda.cu:334-339) has no equivalent —
+arrays are garbage-collected.
+
+Every distinct chunk size is compiled *before* the timed loop starts and
+checkpoint I/O happens outside it, so the reported ``TOTAL DURATION``
+measures device execution only — matching what the reference measured (its
+loop wall-clock, with the CUDA kernel already compiled by nvcc and no
+mid-loop persistence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from gol_tpu.models import patterns
+from gol_tpu.models.state import Geometry, GolState
+from gol_tpu.parallel import engine as engine_mod
+from gol_tpu.utils import checkpoint as ckpt_mod
+from gol_tpu.utils.timing import RunReport, Stopwatch, maybe_profile
+
+ENGINES = ("auto", "dense", "bitpack", "pallas")
+
+
+@dataclasses.dataclass
+class GolRuntime:
+    geometry: Geometry
+    engine: str = "auto"
+    halo_mode: str = "fresh"
+    tile_hint: int = 512
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.engine not in ENGINES:
+            raise ValueError(f"unknown engine {self.engine!r}; expected {ENGINES}")
+        if self.halo_mode not in engine_mod.HALO_MODES:
+            raise ValueError(f"unknown halo_mode {self.halo_mode!r}")
+        if self.checkpoint_every and not self.checkpoint_dir:
+            self.checkpoint_dir = "checkpoints"
+        # Frozen t=0 halos, populated for stale_t0 runs at board init.
+        self._halos: Optional[Tuple[jax.Array, jax.Array]] = None
+
+    # -- engine dispatch ----------------------------------------------------
+    def _evolve_fn(self, steps: int):
+        """Returns (jitted_fn, dynamic_args, static_args).
+
+        The full call is ``fn(board, *dynamic_args, *static_args)``; after
+        AOT lowering, the Compiled object is invoked with the dynamic args
+        only.  Keeping the raw jitted function (rather than a closure) lets
+        the compile phase lower from a ShapeDtypeStruct — compiling without
+        executing a throwaway evolution.
+        """
+        name = "dense" if self.engine == "auto" else self.engine
+        if name == "dense":
+            if self.halo_mode == "fresh":
+                return engine_mod.evolve_fresh, (), (steps,)
+            top0, bottom0 = self._halos
+            return (
+                engine_mod.evolve_stale_with_halos,
+                (top0, bottom0),
+                (self.geometry.num_ranks, steps),
+            )
+        if self.halo_mode != "fresh":
+            raise ValueError(f"engine {name!r} implements fresh halos only")
+        try:
+            if name == "bitpack":
+                from gol_tpu.ops import bitlife
+
+                return bitlife.evolve_dense_io, (), (steps,)
+            if name == "pallas":
+                from gol_tpu.ops import pallas_step
+
+                return pallas_step.evolve, (), (steps, self.tile_hint)
+        except ImportError as e:
+            raise ValueError(f"engine {name!r} is not available: {e}") from e
+        raise AssertionError(name)
+
+    # -- board init ---------------------------------------------------------
+    def initial_state(
+        self, pattern: int, resume: Optional[str] = None
+    ) -> GolState:
+        """World state (board + generation), from a pattern or a checkpoint.
+
+        For stale_t0 (reference-compat) runs the frozen t=0 halos are fixed
+        here: computed from the t=0 board on a fresh start, or restored from
+        the snapshot on resume (re-freezing from the resumed board would
+        silently change the semantics mid-run).
+        """
+        if resume:
+            snap = ckpt_mod.load(resume)
+            if snap.num_ranks != self.geometry.num_ranks:
+                raise ValueError(
+                    f"checkpoint has {snap.num_ranks} ranks, run configured "
+                    f"for {self.geometry.num_ranks}"
+                )
+            expected = (self.geometry.global_height, self.geometry.global_width)
+            if snap.board.shape != expected:
+                raise ValueError(
+                    f"checkpoint board {snap.board.shape} != configured {expected}"
+                )
+            if self.halo_mode == "stale_t0":
+                if snap.top0 is None:
+                    raise ValueError(
+                        "checkpoint lacks frozen halos; it was not written by "
+                        "a stale_t0 run and cannot resume one bit-exactly"
+                    )
+                self._halos = (
+                    jax.device_put(snap.top0),
+                    jax.device_put(snap.bottom0),
+                )
+            return GolState.create(jax.device_put(snap.board), snap.generation)
+
+        board_np = patterns.init_global(
+            pattern, self.geometry.size, self.geometry.num_ranks
+        )
+        board = jax.device_put(board_np)
+        if self.halo_mode == "stale_t0":
+            self._halos = engine_mod.frozen_halos(board, self.geometry.num_ranks)
+        return GolState.create(board, 0)
+
+    def _save_snapshot(self, state: GolState) -> None:
+        top0, bottom0 = self._halos if self._halos is not None else (None, None)
+        ckpt_mod.save(
+            ckpt_mod.checkpoint_path(self.checkpoint_dir, int(state.generation)),
+            np.asarray(state.board),
+            int(state.generation),
+            self.geometry.num_ranks,
+            top0=None if top0 is None else np.asarray(top0),
+            bottom0=None if bottom0 is None else np.asarray(bottom0),
+        )
+
+    # -- main entry ---------------------------------------------------------
+    def run(
+        self,
+        pattern: int,
+        iterations: int,
+        resume: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+    ) -> Tuple[RunReport, GolState]:
+        sw = Stopwatch()
+        with sw.phase("init"):
+            state = self.initial_state(pattern, resume)
+            board = state.board
+
+        # Chunk schedule: full chunks of `checkpoint_every` plus one tail.
+        chunk = (
+            min(self.checkpoint_every, iterations)
+            if self.checkpoint_every > 0
+            else iterations
+        )
+        schedule = []
+        remaining = iterations
+        while remaining > 0:
+            take = min(chunk, remaining)
+            schedule.append(take)
+            remaining -= take
+
+        with sw.phase("compile"):
+            evolvers = {}
+            spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
+            for take in set(schedule):
+                fn, dynamic, static = self._evolve_fn(take)
+                # AOT-compile (no execution, no throwaway board) so the timed
+                # loop measures steady-state execution only.
+                compiled = fn.lower(spec, *dynamic, *static).compile()
+                evolvers[take] = (compiled, dynamic)
+
+        with maybe_profile(profile_dir):
+            for take in schedule:
+                compiled, dynamic = evolvers[take]
+                with sw.phase("total"):
+                    board = compiled(board, *dynamic)
+                    jax.block_until_ready(board)
+                state = GolState.create(board, int(state.generation) + take)
+                if self.checkpoint_every > 0:
+                    with sw.phase("checkpoint"):
+                        self._save_snapshot(state)
+
+        report = sw.report(self.geometry.cell_updates(iterations))
+        return report, state

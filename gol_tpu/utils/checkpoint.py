@@ -1,0 +1,79 @@
+"""Checkpoint / resume — a capability addition over the reference.
+
+The reference's only persistence is the write-only final dump
+(gol-main.c:135-139); there is no loader and no mid-run snapshot (SURVEY §5).
+Here a run can periodically snapshot the board + generation counter and
+resume from any snapshot.  Format: a single ``.npz`` with the board, the
+generation, the geometry, and — for reference-compat (stale-halo, bug B1)
+runs — the frozen t=0 ghost rows, so a resumed compat run keeps the
+*original* halos rather than re-freezing from the resumed board.  Portable
+and readable without JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import numpy as np
+
+CKPT_SUFFIX = ".gol.npz"
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    board: np.ndarray
+    generation: int
+    num_ranks: int
+    top0: Optional[np.ndarray] = None  # frozen halos, stale_t0 runs only
+    bottom0: Optional[np.ndarray] = None
+
+
+def checkpoint_path(directory: str, generation: int) -> str:
+    return os.path.join(directory, f"ckpt_{generation:012d}{CKPT_SUFFIX}")
+
+
+def save(
+    path: str,
+    board: np.ndarray,
+    generation: int,
+    num_ranks: int,
+    top0: Optional[np.ndarray] = None,
+    bottom0: Optional[np.ndarray] = None,
+) -> str:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = dict(
+        board=np.asarray(board, np.uint8),
+        generation=np.int64(generation),
+        num_ranks=np.int64(num_ranks),
+    )
+    if top0 is not None:
+        arrays["top0"] = np.asarray(top0, np.uint8)
+        arrays["bottom0"] = np.asarray(bottom0, np.uint8)
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def load(path: str) -> Snapshot:
+    with np.load(path) as data:
+        return Snapshot(
+            board=data["board"].astype(np.uint8),
+            generation=int(data["generation"]),
+            num_ranks=int(data["num_ranks"]),
+            top0=data["top0"].astype(np.uint8) if "top0" in data else None,
+            bottom0=data["bottom0"].astype(np.uint8) if "bottom0" in data else None,
+        )
+
+
+def latest(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    ckpts = sorted(
+        f
+        for f in os.listdir(directory)
+        if f.startswith("ckpt_") and f.endswith(CKPT_SUFFIX)
+    )
+    return os.path.join(directory, ckpts[-1]) if ckpts else None

@@ -1,0 +1,161 @@
+"""Per-rank world dumps, byte-compatible with the reference's output files.
+
+The reference writes each rank's final board to ``Rank_<r>_of_<n>.txt``
+(filename at gol-main.c:66) consisting of a banner line
+(gol-main.c:136) followed by one line per local row in the format
+``"Row %2d: "`` + ``"%u "`` per cell + newline (gol_printWorld,
+gol-main.c:17-28).  The row label is globalized: ``local_height * rank + i``
+(gol-main.c:22).  Note the ``%2d`` minimum field width and the trailing
+space after the last cell — both reproduced here byte-for-byte (golden-file
+tests pin this).
+
+A native C++ fast path for the hot formatting loop lives in
+``native/golrt.cpp`` (loaded lazily via :mod:`gol_tpu.utils.native`); this
+module is the always-available pure-Python/NumPy implementation and the
+arbiter of correctness.
+
+Reading the files back (:func:`read_rank_file`) is a capability *addition* —
+the reference's dump is write-only (SURVEY §5: no loader exists).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+RANK_FILE_TEMPLATE = "Rank_{rank}_of_{num_ranks}.txt"
+_HEADER_TEMPLATE = (
+    "######################### FINAL WORLD IN RANK {rank} IS "
+    "###############################\n"
+)
+
+
+def rank_filename(rank: int, num_ranks: int) -> str:
+    return RANK_FILE_TEMPLATE.format(rank=rank, num_ranks=num_ranks)
+
+
+def _format_rows_fast(block: np.ndarray, row0: int) -> bytes:
+    """Vectorized renderer for the common case: all cells are single digit.
+
+    Builds each data row as ``digit + space`` byte pairs in one NumPy pass;
+    only the ``Row %2d: `` prefixes are Python-level.
+    """
+    h, w = block.shape
+    cells = np.empty((h, w, 2), dtype=np.uint8)
+    cells[:, :, 0] = block + ord("0")
+    cells[:, :, 1] = ord(" ")
+    body = cells.reshape(h, 2 * w)
+    out = []
+    for i in range(h):
+        out.append(b"Row %2d: " % (row0 + i))
+        out.append(body[i].tobytes())
+        out.append(b"\n")
+    return b"".join(out)
+
+
+def format_world(block: np.ndarray, rank: int) -> bytes:
+    """Render one rank's block exactly as gol_printWorld (gol-main.c:17-28).
+
+    ``block`` is the rank's local board; row labels are globalized with the
+    block's own height (the reference uses the *local* ``g_worldHeight``).
+    """
+    block = np.asarray(block)
+    if block.ndim != 2:
+        raise ValueError(f"expected a 2-D block, got shape {block.shape}")
+    row0 = block.shape[0] * rank
+    if block.size and block.max() > 9:
+        # General %u rendering (cells are 0/1 in practice; keep correctness
+        # for arbitrary uint8 anyway).
+        lines = []
+        for i, row in enumerate(block):
+            lines.append(
+                ("Row %2d: " % (row0 + i))
+                + "".join("%u " % v for v in row)
+                + "\n"
+            )
+        return "".join(lines).encode()
+    return _format_rows_fast(block.astype(np.uint8, copy=False), row0)
+
+
+def format_rank_file(block: np.ndarray, rank: int) -> bytes:
+    """Banner (gol-main.c:136) + world dump — the full file contents."""
+    return _HEADER_TEMPLATE.format(rank=rank).encode() + format_world(block, rank)
+
+
+def write_rank_file(
+    block: np.ndarray,
+    rank: int,
+    num_ranks: int,
+    directory: str = ".",
+    use_native: bool = True,
+) -> str:
+    """Write one rank's ``Rank_<r>_of_<n>.txt``; returns the path."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, rank_filename(rank, num_ranks))
+    data: Optional[bytes] = None
+    block = np.asarray(block)
+    if use_native and (block.size == 0 or block.max() <= 9):
+        # The native renderer emits single-digit cells only; multi-digit
+        # values take the generic Python '%u ' path so the bytes written
+        # never depend on whether the library was built.
+        from gol_tpu.utils import native
+
+        if native.available():
+            native.write_rank_file(path, np.ascontiguousarray(block), rank)
+            return path
+    data = format_rank_file(block, rank)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def write_world_dumps(
+    global_board: np.ndarray,
+    num_ranks: int,
+    directory: str = ".",
+    use_native: bool = True,
+) -> list[str]:
+    """Write all ranks' dump files from the stacked global board.
+
+    Equivalent to every MPI rank executing gol-main.c:135-139 — but here the
+    shards are rows of one (possibly sharded) global array, written per
+    logical rank without any gather beyond host transfer of each block.
+    """
+    height = global_board.shape[0]
+    if height % num_ranks:
+        raise ValueError(f"global height {height} not divisible by {num_ranks} ranks")
+    s = height // num_ranks
+    return [
+        write_rank_file(
+            global_board[r * s : (r + 1) * s], r, num_ranks, directory, use_native
+        )
+        for r in range(num_ranks)
+    ]
+
+
+_ROW_RE = re.compile(rb"^Row\s*(-?\d+): (.*?) ?$")
+
+
+def read_rank_file(path: str) -> tuple[int, np.ndarray]:
+    """Parse a dump file back into (first_global_row, block array)."""
+    with open(path, "rb") as f:
+        lines = f.read().split(b"\n")
+    if not lines or not lines[0].startswith(b"#"):
+        raise ValueError(f"{path}: missing banner line")
+    rows = []
+    first_label = None
+    for line in lines[1:]:
+        if not line:
+            continue
+        m = _ROW_RE.match(line)
+        if not m:
+            raise ValueError(f"{path}: malformed row line {line[:40]!r}")
+        if first_label is None:
+            first_label = int(m.group(1))
+        rows.append(np.array([int(t) for t in m.group(2).split()], dtype=np.uint8))
+    if first_label is None:
+        raise ValueError(f"{path}: no data rows")
+    return first_label, np.stack(rows)

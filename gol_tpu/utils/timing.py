@@ -1,0 +1,80 @@
+"""Timing/observability harness.
+
+The reference's only instrumentation is a rank-0 wall-clock pair around the
+whole loop plus one printed line (``MPI_Wtime`` at gol-main.c:81-82,122 and
+the report at gol-main.c:124-125).  This module reproduces that headline
+metric exactly and extends it (SURVEY §5) with per-phase breakdowns, derived
+throughput, and an optional ``jax.profiler`` trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Dict, Iterator, Optional
+
+
+@dataclasses.dataclass
+class RunReport:
+    duration_s: float
+    cell_updates: int
+    phases: Dict[str, float]
+
+    @property
+    def updates_per_sec(self) -> float:
+        return self.cell_updates / self.duration_s if self.duration_s > 0 else 0.0
+
+    def duration_line(self) -> str:
+        """The reference's exact report line (gol-main.c:124-125)."""
+        return (
+            f"TOTAL DURATION : {self.duration_s:.5f}, "
+            f"number of cell updates = {self.cell_updates}"
+        )
+
+    def throughput_line(self) -> str:
+        """Extension: derived throughput (the BASELINE.json metric)."""
+        return f"THROUGHPUT     : {self.updates_per_sec:.4g} cell-updates/sec"
+
+
+class Stopwatch:
+    """Accumulates named wall-clock phases; the whole-run phase is 'total'."""
+
+    def __init__(self) -> None:
+        self.phases: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + time.perf_counter() - t0
+
+    def report(self, cell_updates: int, total_phase: str = "total") -> RunReport:
+        return RunReport(
+            duration_s=self.phases.get(total_phase, 0.0),
+            cell_updates=cell_updates,
+            phases=dict(self.phases),
+        )
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace when a directory is given (else no-op).
+
+    View with TensorBoard or xprof.  The runtime enters this around the
+    timed generation loop only — compilation is warmed beforehand, so the
+    trace shows steady-state device execution (the TPU-native upgrade over
+    the reference's single wall-clock delta).
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

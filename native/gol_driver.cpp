@@ -1,0 +1,56 @@
+// gol: native CLI driver for the tpu-life framework.
+//
+// Plays the role of the reference's C driver entrypoint (main,
+// gol-main.c:30-146): owns the process surface — argument count check with
+// the usage message and exit(-1) (gol-main.c:43-47) — then hands the run to
+// the TPU runtime.  Where the reference driver then calls MPI + CUDA
+// directly, this one exec's the Python/JAX runtime (`python -m gol_tpu`),
+// which performs the mesh setup, compiled generation loop, reporting and
+// dumps; argument *values* are forwarded verbatim so atoi-equivalent
+// parsing (gol-main.c:49-53) happens in one place, the runtime.
+//
+// Build: `make -C native gol`.  Usage identical to the reference:
+//   ./gol <pattern> <worldSize> <iterations> <threadsPerBlock> <on_off> [--flags]
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <unistd.h>
+#include <vector>
+
+int main(int argc, char** argv) {
+  // Count positionals (extension --flags and their values are passed through;
+  // a value belonging to a --flag is not a positional).
+  int positionals = 0;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strncmp(argv[i], "--", 2) == 0) {
+      // Flags with separate values: skip the value token when present.
+      if (i + 1 < argc && std::strncmp(argv[i + 1], "--", 2) != 0 &&
+          std::strcmp(argv[i], "--compat-banner") != 0)
+        ++i;
+      continue;
+    }
+    ++positionals;
+  }
+  if (positionals != 5) {
+    std::printf(
+        "GOL requires 5 arguments: pattern number, sq size of the world and "
+        "the number of itterations, threads per block and output-on-off "
+        "e.g. ./gol 0 32 2 512 0 \n");
+    return -1;
+  }
+
+  const char* python = std::getenv("GOL_PYTHON");
+  if (!python) python = "python3";
+
+  std::vector<char*> args;
+  args.push_back(const_cast<char*>(python));
+  args.push_back(const_cast<char*>("-m"));
+  args.push_back(const_cast<char*>("gol_tpu"));
+  for (int i = 1; i < argc; ++i) args.push_back(argv[i]);
+  args.push_back(nullptr);
+
+  execvp(python, args.data());
+  std::perror("gol: failed to exec python runtime");
+  return 127;
+}

@@ -1,0 +1,123 @@
+// golrt: native host-runtime helpers for the tpu-life framework.
+//
+// The reference's host runtime is native C/CUDA; here the TPU compute path
+// is XLA-compiled, and this library covers the *host-side* hot spots:
+//
+//  - world-dump formatting/writing, byte-identical to gol_printWorld
+//    (gol-main.c:17-28: "Row %2d: " prefix with a globalized label, "%u "
+//    per cell, banner line from gol-main.c:136).  Formatting a 65536^2
+//    board is ~8.6 GB of text; the pure-Python renderer is the correctness
+//    arbiter and this is the fast path.
+//  - bit-pack/unpack between the dense uint8 board and the bit-packed
+//    engine's uint32 words (bit i of word j = cell j*32 + i).
+//
+// Exposed with C linkage and called from Python via ctypes
+// (gol_tpu/utils/native.py); no pybind11 dependency.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+// Digits needed for a non-negative row label (%2d pads to >= 2 chars).
+inline size_t label_width(int64_t v) {
+  size_t w = 1;
+  while (v >= 10) {
+    v /= 10;
+    ++w;
+  }
+  return w < 2 ? 2 : w;
+}
+
+// Renders "Row %2d: " into out; returns bytes written.
+inline size_t render_prefix(int64_t label, char* out) {
+  return static_cast<size_t>(std::sprintf(out, "Row %2ld: ", (long)label));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Upper bound on the rendered size of a block (cells assumed single digit,
+// which holds for 0/1 boards; multi-digit cells fall back to Python).
+size_t golrt_format_world_size(int64_t h, int64_t w, int64_t row0) {
+  size_t total = 0;
+  for (int64_t i = 0; i < h; ++i) {
+    total += 4 + 1 + label_width(row0 + i) + 2;  // "Row " + pad/label + ": "
+    total += static_cast<size_t>(2 * w) + 1;     // "d " per cell + "\n"
+  }
+  return total;
+}
+
+// Renders the block; returns bytes written (<= golrt_format_world_size).
+size_t golrt_format_world(const uint8_t* cells, int64_t h, int64_t w,
+                          int64_t row0, char* out) {
+  char* p = out;
+  for (int64_t i = 0; i < h; ++i) {
+    p += render_prefix(row0 + i, p);
+    const uint8_t* row = cells + i * w;
+    for (int64_t j = 0; j < w; ++j) {
+      *p++ = static_cast<char>('0' + row[j]);
+      *p++ = ' ';
+    }
+    *p++ = '\n';
+  }
+  return static_cast<size_t>(p - out);
+}
+
+// Writes banner + world to path. Returns 0 on success.
+int golrt_write_rank_file(const char* path, const uint8_t* cells, int64_t h,
+                          int64_t w, int64_t rank) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return 1;
+  if (std::fprintf(f,
+                   "######################### FINAL WORLD IN RANK %ld IS "
+                   "###############################\n",
+                   (long)rank) < 0) {
+    std::fclose(f);
+    return 2;
+  }
+  // Stream row by row to keep memory flat for multi-GB worlds.
+  const size_t line_cap = 32 + static_cast<size_t>(2 * w) + 2;
+  char* line = new char[line_cap];
+  int rc = 0;
+  const int64_t row0 = h * rank;
+  for (int64_t i = 0; i < h && rc == 0; ++i) {
+    char* p = line;
+    p += render_prefix(row0 + i, p);
+    const uint8_t* row = cells + i * w;
+    for (int64_t j = 0; j < w; ++j) {
+      *p++ = static_cast<char>('0' + row[j]);
+      *p++ = ' ';
+    }
+    *p++ = '\n';
+    if (std::fwrite(line, 1, static_cast<size_t>(p - line), f) !=
+        static_cast<size_t>(p - line))
+      rc = 3;
+  }
+  delete[] line;
+  if (std::fclose(f) != 0 && rc == 0) rc = 4;
+  return rc;
+}
+
+// uint8[n] 0/1 cells -> uint32[n/32] words; bit i of word j = cell j*32+i.
+void golrt_pack_bits(const uint8_t* cells, int64_t n, uint32_t* words) {
+  const int64_t nw = n / 32;
+  for (int64_t j = 0; j < nw; ++j) {
+    uint32_t word = 0;
+    const uint8_t* c = cells + j * 32;
+    for (int b = 0; b < 32; ++b) word |= static_cast<uint32_t>(c[b] & 1u) << b;
+    words[j] = word;
+  }
+}
+
+void golrt_unpack_bits(const uint32_t* words, int64_t nw, uint8_t* cells) {
+  for (int64_t j = 0; j < nw; ++j) {
+    const uint32_t word = words[j];
+    uint8_t* c = cells + j * 32;
+    for (int b = 0; b < 32; ++b) c[b] = static_cast<uint8_t>((word >> b) & 1u);
+  }
+}
+
+}  // extern "C"

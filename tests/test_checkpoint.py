@@ -1,0 +1,119 @@
+"""Checkpoint/resume: snapshot round-trip and resumed-run equivalence."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.models.state import Geometry
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.utils import checkpoint as ckpt
+
+from tests import oracle
+
+
+def test_save_load_roundtrip(tmp_path):
+    board = np.random.default_rng(0).integers(0, 2, (16, 8)).astype(np.uint8)
+    path = ckpt.checkpoint_path(str(tmp_path), 42)
+    ckpt.save(path, board, 42, num_ranks=2)
+    snap = ckpt.load(path)
+    np.testing.assert_array_equal(snap.board, board)
+    assert snap.generation == 42 and snap.num_ranks == 2
+    assert snap.top0 is None and snap.bottom0 is None
+
+
+def test_save_load_with_frozen_halos(tmp_path):
+    board = np.random.default_rng(1).integers(0, 2, (16, 8)).astype(np.uint8)
+    top0 = board[::8].copy()  # [2, 8] — one row per rank
+    bottom0 = board[7::8].copy()
+    path = ckpt.checkpoint_path(str(tmp_path), 7)
+    ckpt.save(path, board, 7, num_ranks=2, top0=top0, bottom0=bottom0)
+    snap = ckpt.load(path)
+    np.testing.assert_array_equal(snap.top0, top0)
+    np.testing.assert_array_equal(snap.bottom0, bottom0)
+
+
+def test_latest_picks_highest_generation(tmp_path):
+    b = np.zeros((4, 4), np.uint8)
+    for g in (5, 100, 20):
+        ckpt.save(ckpt.checkpoint_path(str(tmp_path), g), b, g, 1)
+    assert ckpt.latest(str(tmp_path)).endswith("ckpt_000000000100.gol.npz")
+    assert ckpt.latest(str(tmp_path / "missing")) is None
+
+
+def test_runtime_checkpoints_and_resume_equivalence(tmp_path):
+    """10 straight generations == 4 generations, checkpoint, resume +6."""
+    geom = Geometry(size=8, num_ranks=1)
+    straight = GolRuntime(geometry=geom)
+    _, st_straight = straight.run(pattern=4, iterations=10)
+    final_straight = st_straight.board
+
+    ck_dir = str(tmp_path / "ck")
+    part1 = GolRuntime(geometry=geom, checkpoint_every=4, checkpoint_dir=ck_dir)
+    part1.run(pattern=4, iterations=4)
+    resume_path = ckpt.latest(ck_dir)
+    assert resume_path is not None
+
+    part2 = GolRuntime(geometry=geom)
+    _, st_resumed = part2.run(pattern=4, iterations=6, resume=resume_path)
+    final_resumed = st_resumed.board
+    np.testing.assert_array_equal(np.asarray(final_resumed), np.asarray(final_straight))
+
+
+def test_stale_t0_chunked_and_resumed_keeps_original_halos(tmp_path):
+    """Regression: a chunked/resumed stale_t0 (reference-compat) run must
+    keep the t=0 frozen halos — re-freezing per chunk silently changes the
+    semantics (halos must stay at true t=0 per bug B1)."""
+    size, ranks, iters = 8, 3, 6
+    geom = Geometry(size=size, num_ranks=ranks)
+    board0 = np.random.default_rng(7).integers(0, 2, (ranks * size, size))
+    board0 = board0.astype(np.uint8)
+    expected = oracle.simulate_reference(board0, ranks, iters)
+
+    ck_dir = str(tmp_path / "ck")
+    # Chunked run (checkpoint every 2 gens) from a custom t=0 board: seed the
+    # runtime via a handcrafted snapshot so we control the board exactly.
+    seed_path = ckpt.checkpoint_path(str(tmp_path), 0)
+    from gol_tpu.parallel import engine as engine_mod
+    import jax.numpy as jnp
+
+    top0, bottom0 = engine_mod.frozen_halos(jnp.asarray(board0), ranks)
+    ckpt.save(
+        seed_path, board0, 0, ranks, top0=np.asarray(top0), bottom0=np.asarray(bottom0)
+    )
+    rt = GolRuntime(
+        geometry=geom,
+        halo_mode="stale_t0",
+        checkpoint_every=2,
+        checkpoint_dir=ck_dir,
+    )
+    rt.run(pattern=0, iterations=4, resume=seed_path)
+    # Resume the last 2 gens in a fresh runtime from the gen-4 snapshot.
+    rt2 = GolRuntime(geometry=geom, halo_mode="stale_t0")
+    _, st_final = rt2.run(pattern=0, iterations=2, resume=ckpt.latest(ck_dir))
+    assert int(st_final.generation) == iters
+    np.testing.assert_array_equal(np.asarray(st_final.board), expected)
+
+
+def test_stale_t0_resume_without_halos_rejected(tmp_path):
+    path = ckpt.checkpoint_path(str(tmp_path), 3)
+    ckpt.save(path, np.zeros((8, 8), np.uint8), 3, num_ranks=1)
+    rt = GolRuntime(geometry=Geometry(size=8, num_ranks=1), halo_mode="stale_t0")
+    with pytest.raises(ValueError, match="frozen halos"):
+        rt.run(pattern=0, iterations=1, resume=path)
+
+
+def test_resume_geometry_mismatch_rejected(tmp_path):
+    path = ckpt.checkpoint_path(str(tmp_path), 1)
+    ckpt.save(path, np.zeros((16, 8), np.uint8), 1, num_ranks=2)
+    rt = GolRuntime(geometry=Geometry(size=8, num_ranks=1))
+    with pytest.raises(ValueError, match="ranks"):
+        rt.run(pattern=0, iterations=1, resume=path)
+
+
+def test_runtime_report_phases(tmp_path):
+    geom = Geometry(size=8, num_ranks=1)
+    report, state = GolRuntime(geometry=geom).run(pattern=4, iterations=2)
+    assert report.cell_updates == 8 * 8 * 2
+    assert {"init", "compile", "total"} <= set(report.phases)
+    assert report.duration_line().startswith("TOTAL DURATION : ")
+    assert state.board.shape == (8, 8)
+    assert int(state.generation) == 2

@@ -1,0 +1,123 @@
+"""CLI surface parity: arg handling, timing line, dump files, compat mode."""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from gol_tpu import cli
+from gol_tpu.utils import io as gol_io
+
+from tests import oracle
+
+
+def run_cli(args, tmp_path):
+    """Run the CLI in-process with cwd-style outdir control."""
+    return cli.main(list(args) + ["--outdir", str(tmp_path)])
+
+
+def test_wrong_argc_prints_usage(capsys):
+    rc = cli.main(["1", "2", "3"])
+    out = capsys.readouterr().out
+    assert rc == 255
+    assert "5 arguments" in out
+
+
+def test_atoi_semantics():
+    assert cli.atoi("42") == 42
+    assert cli.atoi("  -7") == -7
+    assert cli.atoi("12abc") == 12
+    assert cli.atoi("abc") == 0
+    assert cli.atoi("") == 0
+
+
+def test_unknown_pattern_rejected(capsys, tmp_path):
+    rc = run_cli(["9", "32", "1", "64", "0"], tmp_path)
+    assert rc == 255
+    assert "not been implemented" in capsys.readouterr().out
+
+
+def test_zero_threads_rejected(capsys, tmp_path):
+    """Bug B5 (0-block silent no-op) becomes a hard error."""
+    rc = run_cli(["0", "32", "1", "0", "0"], tmp_path)
+    assert rc == 255
+    assert "threads" in capsys.readouterr().out
+
+
+def test_run_blinker_writes_dump_and_timing(capsys, tmp_path):
+    rc = run_cli(["4", "8", "2", "64", "1"], tmp_path)
+    assert rc == 0
+    out = capsys.readouterr().out
+    m = re.search(
+        r"^TOTAL DURATION : (\d+\.\d{5}), number of cell updates = (\d+)$",
+        out,
+        re.M,
+    )
+    assert m, out
+    assert int(m.group(2)) == 1 * 8 * 8 * 2  # numRank*H*W*iters
+    assert "running in parallel on a TPU" in out
+
+    path = tmp_path / "Rank_0_of_1.txt"
+    assert path.exists()
+    row0, block = gol_io.read_rank_file(str(path))
+    # Blinker has period 2: after 2 steps the world equals t=0.
+    expected = np.zeros((8, 8), np.uint8)
+    expected[0, 0] = expected[0, 1] = expected[0, 7] = 1
+    np.testing.assert_array_equal(block, expected)
+
+
+def test_on_off_zero_writes_nothing(capsys, tmp_path):
+    rc = run_cli(["4", "8", "1", "64", "0"], tmp_path)
+    assert rc == 0
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_multirank_stale_halo_matches_reference_oracle(capsys, tmp_path):
+    """End-to-end bit-parity: CLI in compat mode == NumPy reference simulator,
+    through the byte-exact per-rank files."""
+    size, ranks, iters = 8, 3, 5
+    rc = cli.main(
+        ["1", str(size), str(iters), "32", "1"]
+        + ["--outdir", str(tmp_path), "--ranks", str(ranks), "--halo", "stale_t0"]
+    )
+    assert rc == 0
+    board0 = np.ones((ranks * size, size), np.uint8)
+    expected = oracle.simulate_reference(board0, ranks, iters)
+    for r in range(ranks):
+        row0, block = gol_io.read_rank_file(
+            str(tmp_path / f"Rank_{r}_of_{ranks}.txt")
+        )
+        assert row0 == r * size
+        np.testing.assert_array_equal(block, expected[r * size : (r + 1) * size])
+
+
+def test_bad_resume_path_clean_error(capsys, tmp_path):
+    rc = run_cli(["0", "8", "1", "32", "0", "--resume", "/nonexistent.npz"], tmp_path)
+    assert rc == 255
+    out = capsys.readouterr().out
+    assert "Traceback" not in out and "nonexistent" in out
+
+
+def test_compat_banner(capsys, tmp_path):
+    rc = run_cli(["0", "8", "1", "32", "0", "--compat-banner"], tmp_path)
+    assert rc == 0
+    assert "on a GPU on multiple ranks." in capsys.readouterr().out
+
+
+def test_module_entrypoint_runs():
+    """`python -m gol_tpu` end-to-end in a subprocess (CPU backend)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", "4", "8", "2", "64", "0"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "TOTAL DURATION : " in proc.stdout

@@ -1,0 +1,53 @@
+"""Engine semantics: fresh vs stale_t0 (reference-compat, bug B1) vs oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gol_tpu.parallel import engine
+
+from tests import oracle
+
+
+def random_board(h, w, seed, density=0.35):
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
+@pytest.mark.parametrize("steps", [0, 1, 7])
+def test_fresh_matches_torus_oracle(steps):
+    board = random_board(24, 12, 0)
+    got = np.asarray(engine.evolve_fresh(jnp.asarray(board), steps))
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+@pytest.mark.parametrize("num_ranks", [1, 2, 4])
+@pytest.mark.parametrize("steps", [1, 5])
+def test_stale_t0_matches_reference_oracle(num_ranks, steps):
+    s = 8
+    board = random_board(num_ranks * s, s, seed=num_ranks * 10 + steps)
+    got = np.asarray(
+        engine.evolve_stale_t0(jnp.asarray(board), num_ranks, steps)
+    )
+    expected = oracle.simulate_reference(board, num_ranks, steps)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_stale_t0_step1_equals_fresh_step1_multirank():
+    """At step 1 the stale halos ARE the fresh halos (both are t=0 rows), so
+    the two semantics agree; they diverge from step 2 on."""
+    board = random_board(16, 8, 3)
+    a = np.asarray(engine.evolve_fresh(jnp.asarray(board), 1))
+    b = np.asarray(engine.evolve_stale_t0(jnp.asarray(board), 2, 1))
+    np.testing.assert_array_equal(a, b)
+    a2 = np.asarray(engine.evolve_fresh(jnp.asarray(board), 2))
+    b2 = np.asarray(engine.evolve_stale_t0(jnp.asarray(board), 2, 2))
+    assert not np.array_equal(a2, b2)
+
+
+def test_evolve_dispatch():
+    board = random_board(8, 8, 5)
+    a = np.asarray(engine.evolve(jnp.asarray(board), 3, halo_mode="fresh"))
+    np.testing.assert_array_equal(a, oracle.run_torus(board, 3))
+    with pytest.raises(ValueError, match="halo_mode"):
+        engine.evolve(jnp.asarray(board), 1, halo_mode="nope")

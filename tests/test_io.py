@@ -1,0 +1,76 @@
+"""Byte-exact dump format, round-trip reader, multi-rank file sets."""
+
+import numpy as np
+import pytest
+
+from gol_tpu.utils import io as gol_io
+
+
+def test_format_exact_bytes_small():
+    """Pin the exact byte format of gol_printWorld (gol-main.c:17-28):
+    'Row %2d: ' prefix (width-2, right-justified), '%u ' per cell with the
+    trailing space, globalized row labels local_height*rank + i."""
+    block = np.array([[0, 1, 0], [1, 1, 1], [0, 0, 1]], np.uint8)
+    got = gol_io.format_world(block, rank=0)
+    expected = b"Row  0: 0 1 0 \nRow  1: 1 1 1 \nRow  2: 0 0 1 \n"
+    assert got == expected
+
+
+def test_format_globalized_row_labels():
+    block = np.zeros((3, 2), np.uint8)
+    got = gol_io.format_world(block, rank=4)  # rows 12..14
+    assert got.startswith(b"Row 12: 0 0 \n")
+    assert b"Row 14: 0 0 \n" in got
+
+
+def test_format_label_width_transition():
+    """%2d pads single digits to width 2 and grows naturally past 99."""
+    block = np.zeros((1, 1), np.uint8)
+    assert gol_io.format_world(block, rank=5).startswith(b"Row  5: ")
+    big = np.zeros((120, 1), np.uint8)
+    text = gol_io.format_world(big, rank=0)
+    assert b"Row  9: 0 \n" in text
+    assert b"Row 10: 0 \n" in text
+    assert b"Row 100: 0 \n" in text
+
+
+def test_rank_file_banner():
+    block = np.zeros((2, 2), np.uint8)
+    data = gol_io.format_rank_file(block, rank=3)
+    first = data.split(b"\n", 1)[0]
+    assert first == (
+        b"######################### FINAL WORLD IN RANK 3 IS "
+        b"###############################"
+    )
+
+
+def test_write_and_read_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    board = rng.integers(0, 2, (12, 6)).astype(np.uint8)
+    paths = gol_io.write_world_dumps(board, num_ranks=3, directory=str(tmp_path))
+    assert [p.split("/")[-1] for p in paths] == [
+        "Rank_0_of_3.txt",
+        "Rank_1_of_3.txt",
+        "Rank_2_of_3.txt",
+    ]
+    for r, path in enumerate(paths):
+        row0, block = gol_io.read_rank_file(path)
+        assert row0 == 4 * r
+        np.testing.assert_array_equal(block, board[4 * r : 4 * (r + 1)])
+
+
+def test_fast_and_generic_renderers_agree():
+    rng = np.random.default_rng(1)
+    block = rng.integers(0, 2, (5, 7)).astype(np.uint8)
+    fast = gol_io.format_world(block, rank=2)
+    lines = []
+    for i, row in enumerate(block):
+        lines.append(
+            ("Row %2d: " % (5 * 2 + i)) + "".join("%u " % v for v in row) + "\n"
+        )
+    assert fast == "".join(lines).encode()
+
+
+def test_indivisible_ranks_rejected():
+    with pytest.raises(ValueError, match="divisible"):
+        gol_io.write_world_dumps(np.zeros((10, 4), np.uint8), num_ranks=3)
