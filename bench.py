@@ -1,0 +1,85 @@
+"""Benchmark: cell-updates/sec on the local accelerator. Prints ONE JSON line.
+
+Headline metric (BASELINE.md): cell-updates/sec/chip at 16384².  The
+baseline target is 1e11 aggregate on a 256-chip v5e pod == 3.90625e8 per
+chip; ``vs_baseline`` is measured-per-chip / per-chip-target, so 1.0 means
+pod-parity pro-rated to this chip and bigger is better.
+
+Runs the best available engine on the real device (TPU under the driver;
+CPU fallback works too), warm-compiled, timing only steady-state execution
+of a multi-generation fori_loop.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+SIZE = 16384
+STEPS = 64
+PER_CHIP_TARGET = 1e11 / 256.0
+
+
+def _measure(evolve, board, steps: int, repeats: int = 3) -> float:
+    import jax
+
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        board = evolve(board)
+        jax.block_until_ready(board)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import stencil
+
+    size, steps = SIZE, STEPS
+    # Keep CPU smoke runs tractable; the driver's TPU run uses the full size.
+    if jax.devices()[0].platform == "cpu":
+        size, steps = 2048, 8
+
+    rng = np.random.default_rng(0)
+    board = jnp.asarray((rng.random((size, size)) < 0.35).astype(np.uint8))
+
+    engines = {}
+    try:
+        from gol_tpu.ops import bitlife
+
+        engines["bitpack"] = lambda b, s=steps: bitlife.evolve_dense_io(b, s)
+    except ImportError:
+        pass
+    engines["dense"] = lambda b, s=steps: stencil.run(b, s)
+
+    results = {}
+    for name, evolve in engines.items():
+        # Warm-up: compile + one full execution outside timing. Work on a
+        # private copy since the engines donate their input.
+        warm = jnp.array(board, copy=True)
+        jax.block_until_ready(evolve(warm))
+        work = jnp.array(board, copy=True)
+        dt = _measure(evolve, work, steps)
+        results[name] = (size * size * steps) / dt
+
+    best_name = max(results, key=results.get)
+    value = results[best_name]
+    print(
+        json.dumps(
+            {
+                "metric": f"cell_updates_per_sec_per_chip@{size}^2x{steps}({best_name})",
+                "value": value,
+                "unit": "cell-updates/s",
+                "vs_baseline": value / PER_CHIP_TARGET,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

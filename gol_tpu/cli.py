@@ -64,6 +64,8 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument(
         "--engine", choices=["auto", "dense", "bitpack", "pallas"], default="auto"
     )
+    ext.add_argument("--mesh", choices=["none", "1d", "2d"], default="none")
+    ext.add_argument("--shard-mode", choices=["explicit", "auto"], default="explicit")
     ext.add_argument("--outdir", default=".")
     ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
     ext.add_argument("--compat-banner", action="store_true")
@@ -90,7 +92,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from gol_tpu.models import patterns
     from gol_tpu.models.state import Geometry
-    from gol_tpu.runtime import GolRuntime
+    from gol_tpu.runtime import GolRuntime, build_mesh
 
     try:
         geom = Geometry(size=ns.world_size, num_ranks=ns.ranks)
@@ -114,6 +116,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             tile_hint=ns.threads,
             checkpoint_every=ns.checkpoint_every,
             checkpoint_dir=ns.checkpoint_dir,
+            mesh=build_mesh(ns.mesh),
+            shard_mode=ns.shard_mode,
         )
         report, final_state = rt.run(
             pattern=ns.pattern,

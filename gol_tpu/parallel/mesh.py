@@ -1,0 +1,83 @@
+"""Device-mesh construction: the TPU-native replacement for MPI topology.
+
+The reference's topology layer is ``MPI_Init``/``Comm_rank``/``Comm_size``
+(gol-main.c:58-62) plus mod-ring neighbor ids (gol-main.c:86-87) and a
+rank→GPU binding ``cudaSetDevice(myRank % deviceCount)``
+(gol-with-cuda.cu:296).  On TPU none of that is explicit: a
+``jax.sharding.Mesh`` names the axes, ``shard_map`` places the per-shard
+program, and ring neighborhoods are expressed as ``lax.ppermute``
+permutations over the mesh axis — XLA routes them over ICI (and pjit over
+DCN for multi-slice).
+
+Axis conventions:
+  - 1-D row decomposition: ``('rows',)`` — the reference's own layout
+    (each rank owns a horizontal stripe).
+  - 2-D block decomposition: ``('rows', 'cols')`` — BASELINE.md config 3.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+ROWS = "rows"
+COLS = "cols"
+
+
+def make_mesh_1d(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """Ring of devices over the row axis."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (ROWS,))
+
+
+def make_mesh_2d(
+    shape: Optional[Tuple[int, int]] = None, devices=None
+) -> Mesh:
+    """Grid of devices over (rows, cols).
+
+    Without an explicit shape, picks the most square factorization of the
+    device count (halo bytes scale with the shard perimeter, so squarer is
+    cheaper).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        r = int(np.sqrt(n))
+        while n % r:
+            r -= 1
+        shape = (r, n // r)
+    rows, cols = shape
+    if rows * cols != len(devices):
+        raise ValueError(f"mesh shape {shape} != device count {len(devices)}")
+    return Mesh(np.asarray(devices).reshape(rows, cols), (ROWS, COLS))
+
+
+def board_sharding(mesh: Mesh) -> NamedSharding:
+    """The canonical board sharding for a mesh: rows (and cols) split."""
+    if COLS in mesh.axis_names:
+        return NamedSharding(mesh, PartitionSpec(ROWS, COLS))
+    return NamedSharding(mesh, PartitionSpec(ROWS, None))
+
+
+def shard_board(board, mesh: Mesh):
+    """Place a board onto the mesh with the canonical sharding."""
+    return jax.device_put(board, board_sharding(mesh))
+
+
+def validate_geometry(shape: Sequence[int], mesh: Mesh) -> None:
+    h, w = shape
+    rows = mesh.shape[ROWS]
+    cols = mesh.shape.get(COLS, 1)
+    if h % rows:
+        raise ValueError(f"board height {h} not divisible by mesh rows {rows}")
+    if w % cols:
+        raise ValueError(f"board width {w} not divisible by mesh cols {cols}")
+    if h // rows < 1 or w // cols < 1:
+        raise ValueError(f"empty shards for board {shape} on mesh {mesh.shape}")

@@ -1,0 +1,161 @@
+"""Sharded generation engines: ``shard_map`` + ``lax.ppermute`` halo rings.
+
+TPU-native replacement for the reference's L2 distributed layer.  The
+reference exchanges one ghost row with each ring neighbor per step via
+nonblocking MPI point-to-point (2×``MPI_Irecv`` gol-main.c:97-100,
+2×``MPI_Isend`` gol-main.c:104-107, ``MPI_Wait`` gol-main.c:110-111) with
+mod-ring neighbor ids (gol-main.c:86-87) — and, due to bug B1, actually
+ships stale t=0 rows forever.  Here each step's halos are sliced from the
+*live* block and shifted with ``lax.ppermute`` ring permutations inside one
+compiled program: fresh by construction, no tags, no request management,
+ordering owned by the XLA scheduler, traffic riding ICI.
+
+Two decompositions (SURVEY §7 steps 4 and 6):
+
+- **1-D rows** (the reference's own layout): two ppermutes/step deliver the
+  up/down ghost rows; columns wrap locally since the width axis is
+  unsharded.
+- **2-D blocks** (BASELINE config 3): two-phase exchange — vertical edge
+  rows first, then the *halo-extended* blocks' edge columns horizontally,
+  which carries the four corner cells for free (the part with no reference
+  analog: MPI codes typically need 8 messages or a diagonal phase; the
+  ordered two-phase does it in 4).
+
+A third engine lets XLA's SPMD partitioner derive the halo exchange
+automatically from the sharded torus rolls (``mode="auto"``) — the
+"annotate shardings, let the compiler insert collectives" recipe; the
+explicit shard_map path exists because hand-placed ppermutes are the analog
+of the reference's explicit messaging and are what we tune (overlap,
+bit-packing) in the perf tiers.
+
+The whole multi-generation loop runs inside one jitted program
+(``lax.fori_loop``), so there is no per-step host round-trip — the
+reference pays ``cudaDeviceSynchronize`` per step (gol-with-cuda.cu:277).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gol_tpu.ops import stencil
+from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding, validate_geometry
+
+MODES = ("explicit", "auto")
+
+
+def _recv_from_prev(n: int):
+    """Permutation delivering each shard its ring-predecessor's message."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _recv_from_next(n: int):
+    return [(i, (i - 1) % n) for i in range(n)]
+
+
+def exchange_row_halos(block: jax.Array, num_rows: int):
+    """Fresh up/down ghost rows for a row-sharded block.
+
+    One up-shift and one down-shift ppermute — the ``previous_last_row`` /
+    ``next_first_row`` of the reference (gol-main.c:11), except re-sliced
+    from the live board every step (fixing B1 by construction).
+    Returns (top_row[W], bottom_row[W]).
+    """
+    top = lax.ppermute(block[-1:], ROWS, _recv_from_prev(num_rows))
+    bottom = lax.ppermute(block[:1], ROWS, _recv_from_next(num_rows))
+    return top[0], bottom[0]
+
+
+def exchange_block_halos(block: jax.Array, num_rows: int, num_cols: int):
+    """Halo-extend a 2-D-sharded block to [h+2, w+2] via two-phase ppermute.
+
+    Phase 1 ships edge *rows* vertically; phase 2 ships the edge *columns of
+    the already row-extended block* horizontally, so each corner cell makes
+    two hops (vertical then horizontal) and lands correctly — no diagonal
+    messages needed.
+    """
+    top = lax.ppermute(block[-1:, :], ROWS, _recv_from_prev(num_rows))
+    bottom = lax.ppermute(block[:1, :], ROWS, _recv_from_next(num_rows))
+    vext = jnp.concatenate([top, block, bottom], axis=0)  # [h+2, w]
+    left = lax.ppermute(vext[:, -1:], COLS, _recv_from_prev(num_cols))
+    right = lax.ppermute(vext[:, :1], COLS, _recv_from_next(num_cols))
+    return jnp.concatenate([left, vext, right], axis=1)  # [h+2, w+2]
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_evolve(mesh: Mesh, steps: int, mode: str):
+    """Build + jit the sharded evolve for (mesh, steps, mode).
+
+    The returned function donates its input buffer (the framework's double
+    buffer); callers who need the input afterwards must pass a copy.
+    """
+    if mode not in MODES:
+        raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
+    if mode == "auto":
+        # XLA SPMD derives collective-permutes from the sharded torus rolls.
+        return jax.jit(
+            lambda b: lax.fori_loop(0, steps, lambda _, x: stencil.step(x), b),
+            in_shardings=board_sharding(mesh),
+            out_shardings=board_sharding(mesh),
+            donate_argnums=0,
+        )
+
+    two_d = COLS in mesh.axis_names
+    num_rows = mesh.shape[ROWS]
+    num_cols = mesh.shape.get(COLS, 1)
+
+    if two_d:
+
+        def body(_, blk):
+            ext = exchange_block_halos(blk, num_rows, num_cols)
+            return stencil.step_halo_full(ext)
+
+        spec = P(ROWS, COLS)
+    else:
+
+        def body(_, blk):
+            top, bottom = exchange_row_halos(blk, num_rows)
+            return stencil.step_halo_rows(blk, top, bottom)
+
+        spec = P(ROWS, None)
+
+    local = jax.shard_map(
+        lambda b: lax.fori_loop(0, steps, body, b),
+        mesh=mesh,
+        in_specs=spec,
+        out_specs=spec,
+    )
+    return jax.jit(local, donate_argnums=0)
+
+
+def evolve_sharded(
+    board: jax.Array, steps: int, mesh: Mesh, mode: str = "explicit"
+) -> jax.Array:
+    """Evolve a board sharded over ``mesh`` for ``steps`` generations.
+
+    The board is placed with the canonical sharding if it isn't already, and
+    the caller's array is never consumed: the compiled program donates its
+    input (double buffering), so when ``device_put`` would be a no-op we
+    hand it a private copy.  Performance-critical callers that *want* the
+    donation manage placement themselves and call :func:`compiled_evolve`.
+    Semantics are the correct torus (fresh halos) in every mode.
+    """
+    if mode not in MODES:
+        raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
+    validate_geometry(board.shape, mesh)
+    sharding = board_sharding(mesh)
+    if getattr(board, "sharding", None) == sharding:
+        board = jnp.array(board, copy=True)
+    else:
+        board = jax.device_put(board, sharding)
+    return compiled_evolve(mesh, steps, mode)(board)
+
+
+def lower_sharded(shape, dtype, steps: int, mesh: Mesh, mode: str = "explicit"):
+    """AOT-lower the sharded evolve for compile-cost inspection / warmup."""
+    spec = jax.ShapeDtypeStruct(shape, dtype, sharding=board_sharding(mesh))
+    return compiled_evolve(mesh, steps, mode).lower(spec)

@@ -22,14 +22,29 @@ from typing import Optional, Tuple
 
 import jax
 import numpy as np
+from jax.sharding import Mesh
 
 from gol_tpu.models import patterns
 from gol_tpu.models.state import Geometry, GolState
 from gol_tpu.parallel import engine as engine_mod
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils import checkpoint as ckpt_mod
 from gol_tpu.utils.timing import RunReport, Stopwatch, maybe_profile
 
 ENGINES = ("auto", "dense", "bitpack", "pallas")
+MESH_CHOICES = ("none", "1d", "2d")
+
+
+def build_mesh(kind: str) -> Optional[Mesh]:
+    """CLI-level mesh selection: shard over all visible devices."""
+    if kind == "none":
+        return None
+    if kind == "1d":
+        return mesh_mod.make_mesh_1d()
+    if kind == "2d":
+        return mesh_mod.make_mesh_2d()
+    raise ValueError(f"unknown mesh kind {kind!r}; expected one of {MESH_CHOICES}")
 
 
 @dataclasses.dataclass
@@ -40,14 +55,31 @@ class GolRuntime:
     tile_hint: int = 512
     checkpoint_every: int = 0
     checkpoint_dir: Optional[str] = None
+    mesh: Optional[Mesh] = None
+    shard_mode: str = "explicit"  # shard_map+ppermute vs XLA auto-SPMD
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
             raise ValueError(f"unknown engine {self.engine!r}; expected {ENGINES}")
         if self.halo_mode not in engine_mod.HALO_MODES:
             raise ValueError(f"unknown halo_mode {self.halo_mode!r}")
+        if self.shard_mode not in sharded_mod.MODES:
+            raise ValueError(
+                f"unknown shard_mode {self.shard_mode!r}; expected "
+                f"{sharded_mod.MODES}"
+            )
         if self.checkpoint_every and not self.checkpoint_dir:
             self.checkpoint_dir = "checkpoints"
+        if self.mesh is not None:
+            if self.halo_mode != "fresh":
+                raise ValueError(
+                    "stale_t0 (reference-compat) runs are single-device only; "
+                    "its blocks evolve independently so a mesh adds nothing"
+                )
+            mesh_mod.validate_geometry(
+                (self.geometry.global_height, self.geometry.global_width),
+                self.mesh,
+            )
         # Frozen t=0 halos, populated for stale_t0 runs at board init.
         self._halos: Optional[Tuple[jax.Array, jax.Array]] = None
 
@@ -63,6 +95,12 @@ class GolRuntime:
         """
         name = "dense" if self.engine == "auto" else self.engine
         if name == "dense":
+            if self.mesh is not None:
+                return (
+                    sharded_mod.compiled_evolve(self.mesh, steps, self.shard_mode),
+                    (),
+                    (),
+                )
             if self.halo_mode == "fresh":
                 return engine_mod.evolve_fresh, (), (steps,)
             top0, bottom0 = self._halos
@@ -166,9 +204,17 @@ class GolRuntime:
             schedule.append(take)
             remaining -= take
 
+        if self.mesh is not None:
+            board = mesh_mod.shard_board(board, self.mesh)
+
         with sw.phase("compile"):
             evolvers = {}
-            spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
+            if self.mesh is not None:
+                spec = jax.ShapeDtypeStruct(
+                    board.shape, board.dtype, sharding=mesh_mod.board_sharding(self.mesh)
+                )
+            else:
+                spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
             for take in set(schedule):
                 fn, dynamic, static = self._evolve_fn(take)
                 # AOT-compile (no execution, no throwaway board) so the timed

@@ -1,0 +1,50 @@
+"""Driver contract: entry() compile-check and dryrun_multichip on CPU mesh."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import __graft_entry__  # noqa: E402
+
+
+def test_entry_jits_and_runs():
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    assert out.shape == args[0].shape and out.dtype == args[0].dtype
+
+
+def test_dryrun_multichip_8():
+    __graft_entry__.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_odd_counts():
+    for n in (1, 2, 3, 6):
+        __graft_entry__.dryrun_multichip(n)
+
+
+def test_bench_prints_one_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=570,
+    )
+    assert proc.returncode == 0, proc.stderr
+    import json
+
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+    assert rec["value"] > 0
