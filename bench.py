@@ -13,9 +13,12 @@ of a multi-generation fori_loop.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
+
+from gol_tpu.utils.timing import force_ready as _force
 
 SIZE = 16384
 STEPS = 64
@@ -23,13 +26,11 @@ PER_CHIP_TARGET = 1e11 / 256.0
 
 
 def _measure(evolve, board, steps: int, repeats: int = 3) -> float:
-    import jax
-
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
         board = evolve(board)
-        jax.block_until_ready(board)
+        _force(board)
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -55,18 +56,31 @@ def main() -> None:
         engines["bitpack"] = lambda b, s=steps: bitlife.evolve_dense_io(b, s)
     except ImportError:
         pass
+    try:
+        from gol_tpu.ops import pallas_step
+
+        engines["pallas"] = lambda b, s=steps: pallas_step.evolve(b, s, 512)
+    except ImportError:
+        pass
     engines["dense"] = lambda b, s=steps: stencil.run(b, s)
 
     results = {}
     for name, evolve in engines.items():
         # Warm-up: compile + one full execution outside timing. Work on a
         # private copy since the engines donate their input.
-        warm = jnp.array(board, copy=True)
-        jax.block_until_ready(evolve(warm))
+        try:
+            warm = jnp.array(board, copy=True)
+            _force(evolve(warm))
+        except Exception as e:  # noqa: BLE001 — report, never hide, a dropped engine
+            print(f"bench: skipping engine {name!r}: {e!r}", file=sys.stderr)
+            continue
         work = jnp.array(board, copy=True)
         dt = _measure(evolve, work, steps)
         results[name] = (size * size * steps) / dt
 
+    if not results:
+        print("bench: every engine failed; see stderr above", file=sys.stderr)
+        raise SystemExit(1)
     best_name = max(results, key=results.get)
     value = results[best_name]
     print(

@@ -1,0 +1,142 @@
+"""Bit-packed torus Game-of-Life: 32 cells per uint32 lane word.
+
+The performance tier of SURVEY §7 step 7.  The reference spends one CUDA
+thread per cell reading 9 bytes of neighborhood per update
+(gol_kernel, gol-with-cuda.cu:189-262).  Life cells are 1 bit of state, so
+the dense uint8 layout wastes 8× HBM bandwidth — and on TPU the stencil is
+bandwidth-bound.  Here the board is packed 32 cells per ``uint32`` along
+the width axis and one generation is computed with bit-sliced carry-save
+adders: every bitwise VPU op advances 32 cells, and HBM traffic drops 8×.
+
+Counting scheme (classic bit-parallel Life):
+
+- For each of the three stencil rows, the 3-cell horizontal sum per lane is
+  a 2-bit number built with one full adder over (west, center, east)
+  bitboards.  West/east bitboards are lane shifts with the carry bit taken
+  from the ring-adjacent word, so the column torus wrap
+  (gol-with-cuda.cu:210-211) falls out of a ``jnp.roll`` along the packed
+  axis.
+- The three 2-bit row sums are added into a 4-bit count-of-9 (self
+  included) with two more adder layers.
+- B3/S23 over count-of-9 ``t``: next = (t == 3) | (alive & t == 4) — the
+  branchless form of the if/else chain at gol-with-cuda.cu:239-257.
+
+Total: ~22 bitwise ops per word = ~0.7 ops/cell, vs ~10 byte-wide ops/cell
+for the dense engine, at 1/8th the memory traffic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gol_tpu.models.state import CELL_DTYPE
+
+WORD = jnp.uint32
+BITS = 32
+_ONE = jnp.uint32(1)
+
+
+def packed_width(width: int) -> int:
+    """Number of uint32 words per row; width must pack evenly."""
+    if width % BITS != 0:
+        raise ValueError(
+            f"bit-packed engine needs width divisible by {BITS}, got {width}"
+        )
+    return width // BITS
+
+
+def pack(board: jax.Array) -> jax.Array:
+    """uint8[H, W] 0/1 board -> uint32[H, W//32]; bit j of word k = col 32k+j."""
+    h, w = board.shape
+    nw = packed_width(w)
+    lanes = board.reshape(h, nw, BITS).astype(WORD)
+    weights = (_ONE << jnp.arange(BITS, dtype=WORD)).reshape(1, 1, BITS)
+    return jnp.sum(lanes * weights, axis=-1, dtype=WORD)
+
+
+def unpack(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack`."""
+    h, nw = packed.shape
+    shifts = jnp.arange(BITS, dtype=WORD).reshape(1, 1, BITS)
+    bits = (packed[:, :, None] >> shifts) & _ONE
+    return bits.astype(CELL_DTYPE).reshape(h, nw * BITS)
+
+
+def _west_east(row: jax.Array):
+    """Bitboards of each cell's west / east neighbor within a packed row.
+
+    Bit j of a word is column 32k+j, so the west neighbor (col-1) of bit j
+    is bit j-1 — a left lane-shift — with bit 0 filled from the top bit of
+    the ring-previous word (the torus column wrap).
+    """
+    prev_word = jnp.roll(row, 1, axis=-1)
+    next_word = jnp.roll(row, -1, axis=-1)
+    west = (row << 1) | (prev_word >> (BITS - 1))
+    east = (row >> 1) | (next_word << (BITS - 1))
+    return west, east
+
+
+def _full_add(a: jax.Array, b: jax.Array, c: jax.Array):
+    """Bitwise full adder: (sum_bit, carry_bit) of three 1-bit planes."""
+    axb = a ^ b
+    return axb ^ c, (a & b) | (c & axb)
+
+
+def _row_hsum(row: jax.Array):
+    """Per-lane 3-cell horizontal sum (west+center+east) as 2 bit-planes."""
+    west, east = _west_east(row)
+    return _full_add(west, row, east)
+
+
+def step_packed_rows(center: jax.Array, above: jax.Array, below: jax.Array):
+    """Next generation of packed rows given packed neighbor rows.
+
+    ``above``/``below`` are the packed analogs of the reference's
+    ``previous_last_row``/``next_first_row`` ghost rows (gol-main.c:11) when
+    called row-sharded, or the rolled board when called on a full torus.
+    """
+    s0a, s1a = _row_hsum(above)
+    s0c, s1c = _row_hsum(center)
+    s0b, s1b = _row_hsum(below)
+
+    # count-of-9 t = (s0a+s0c+s0b) + 2*(s1a+s1c+s1b); build its bit-planes.
+    l0, c_low = _full_add(s0a, s0c, s0b)  # ones plane + carry into twos
+    u, v = _full_add(s1a, s1c, s1b)  # twos-plane sum: u ones, v twos
+    t0 = u ^ c_low
+    carry2 = u & c_low
+    t1 = v ^ carry2
+    t2 = v & carry2
+    # t = l0 + 2*t0 + 4*t1 + 8*t2;  alive-next = (t==3) | (alive & t==4)
+    eq3 = l0 & t0 & ~(t1 | t2)
+    eq4 = ~l0 & ~t0 & t1 & ~t2
+    return eq3 | (center & eq4)
+
+
+def step_packed(packed: jax.Array) -> jax.Array:
+    """One generation on a fully periodic packed board uint32[H, W//32]."""
+    above = jnp.roll(packed, 1, axis=-2)
+    below = jnp.roll(packed, -1, axis=-2)
+    return step_packed_rows(packed, above, below)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def run_packed(packed: jax.Array, steps: int) -> jax.Array:
+    """Evolve a packed board ``steps`` generations in one compiled program."""
+    return lax.fori_loop(0, steps, lambda _, b: step_packed(b), packed)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def evolve_dense_io(board: jax.Array, steps: int) -> jax.Array:
+    """Dense-in / dense-out evolve: pack, run packed, unpack.
+
+    The engine entry point used by the runtime and bench: pack/unpack cost
+    is paid once and amortized over the whole fori_loop, all inside a
+    single compiled program (the donated input is the double buffer).
+    """
+    packed = pack(board)
+    packed = lax.fori_loop(0, steps, lambda _, b: step_packed(b), packed)
+    return unpack(packed)

@@ -32,6 +32,10 @@ def make_mesh_1d(num_devices: Optional[int] = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible"
+            )
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (ROWS,))
 

@@ -148,7 +148,10 @@ def evolve_sharded(
         raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
     validate_geometry(board.shape, mesh)
     sharding = board_sharding(mesh)
-    if getattr(board, "sharding", None) == sharding:
+    current = getattr(board, "sharding", None)
+    if current is not None and sharding.is_equivalent_to(current, board.ndim):
+        # device_put would alias the caller's buffer (equivalent-sharding
+        # fast path) and donation would then delete it out from under them.
         board = jnp.array(board, copy=True)
     else:
         board = jax.device_put(board, sharding)

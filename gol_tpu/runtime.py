@@ -30,7 +30,7 @@ from gol_tpu.parallel import engine as engine_mod
 from gol_tpu.parallel import mesh as mesh_mod
 from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils import checkpoint as ckpt_mod
-from gol_tpu.utils.timing import RunReport, Stopwatch, maybe_profile
+from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready, maybe_profile
 
 ENGINES = ("auto", "dense", "bitpack", "pallas")
 MESH_CHOICES = ("none", "1d", "2d")
@@ -75,6 +75,12 @@ class GolRuntime:
                 raise ValueError(
                     "stale_t0 (reference-compat) runs are single-device only; "
                     "its blocks evolve independently so a mesh adds nothing"
+                )
+            if self.engine not in ("auto", "dense"):
+                raise ValueError(
+                    f"engine {self.engine!r} has no sharded path yet; with a "
+                    "mesh use engine 'dense'/'auto' (shard_map+ppermute or "
+                    "auto-SPMD)"
                 )
             mesh_mod.validate_geometry(
                 (self.geometry.global_height, self.geometry.global_width),
@@ -221,13 +227,16 @@ class GolRuntime:
                 # loop measures steady-state execution only.
                 compiled = fn.lower(spec, *dynamic, *static).compile()
                 evolvers[take] = (compiled, dynamic)
+            # Warm the force_ready gather too — its first call traces and
+            # compiles a getitem; that belongs in this phase, not "total".
+            force_ready(board)
 
         with maybe_profile(profile_dir):
             for take in schedule:
                 compiled, dynamic = evolvers[take]
                 with sw.phase("total"):
                     board = compiled(board, *dynamic)
-                    jax.block_until_ready(board)
+                    force_ready(board)
                 state = GolState.create(board, int(state.generation) + take)
                 if self.checkpoint_every > 0:
                     with sw.phase("checkpoint"):

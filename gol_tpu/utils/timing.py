@@ -59,6 +59,26 @@ class Stopwatch:
         )
 
 
+def force_ready(x) -> None:
+    """Force device completion of every array in a pytree, robustly.
+
+    ``jax.block_until_ready`` has been observed returning early on the
+    tunneled single-TPU platform; a 1-element readback cannot return early
+    (the output buffer must fully exist first) and moves only a few bytes.
+    Every timed phase must end with this, or the reported ``TOTAL
+    DURATION`` measures dispatch instead of execution.
+    """
+    import jax
+
+    # block_until_ready alone can return early through the tunnel; the
+    # readback alone only proves shard (0,...,0) finished on a sharded
+    # array.  Both together cover single- and multi-device cases.
+    jax.block_until_ready(x)
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "ndim"):
+            leaf[(0,) * leaf.ndim].item()
+
+
 @contextlib.contextmanager
 def maybe_profile(trace_dir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler trace when a directory is given (else no-op).
