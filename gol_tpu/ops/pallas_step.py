@@ -1,0 +1,166 @@
+"""Hand-written Pallas TPU kernel: fused halo-load + count + rule.
+
+The explicit-kernel tier of SURVEY §7 step 7 — the direct architectural
+analog of the reference's ``__global__ gol_kernel``
+(gol-with-cuda.cu:189-262) plus its launch configuration
+(``threadsCount`` → our row-tile size, gol-main.c:52,
+gol-with-cuda.cu:272-275), rebuilt for the TPU memory hierarchy instead of
+SIMT:
+
+- The board lives in HBM (``memory_space=ANY``); each grid step DMAs one
+  row-tile *plus its two wrap halo rows* into a VMEM scratch buffer — the
+  reference's ghost-row substitution (gol-with-cuda.cu:224-231) becomes
+  two extra 1-row DMAs with mod-H source indices, so the row torus wrap is
+  handled at load time and the compute is branch-free.
+- Count + rule are fused over the VMEM tile on the VPU: a separable
+  3-row/3-column sum (column wrap via lane rolls, the analog of
+  gol-with-cuda.cu:210-211) and the branchless B3/S23 select
+  (vs the if/else chain at gol-with-cuda.cu:239-257).
+
+The XLA-stencil engine (:mod:`gol_tpu.ops.stencil`) usually matches this —
+XLA fuses the roll-sums well — but the Pallas path pins down tiling and
+VMEM residency explicitly, is the scaffold for kernel-level tuning, and is
+where the CLI's ``threadsPerBlock`` argument gets a real meaning again.
+
+Runs in interpreter mode automatically on non-TPU backends so the same
+tests cover it everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+SUM_DTYPE = jnp.uint8  # neighbor counts fit (max 9)
+_VMEM_BUDGET = 8 * 1024 * 1024  # bytes for scratch + out tile, conservative
+
+
+def pick_tile(height: int, width: int, hint: int) -> int:
+    """Largest divisor of ``height`` that is <= hint and fits VMEM.
+
+    The validated replacement for the reference's unchecked
+    ``blocksCount = W*H/threadsCount`` (gol-with-cuda.cu:272, bug B5).
+    """
+    # Per tile-row VMEM: uint8 scratch+out (~2B/cell) plus the widened
+    # int32 compute temporaries (~12B/cell across live values).
+    if height % _ALIGN != 0:
+        raise ValueError(
+            f"pallas engine needs board height divisible by {_ALIGN}, "
+            f"got {height}"
+        )
+    budget = max(_ALIGN, _VMEM_BUDGET // max(1, 16 * width))
+    cap = max(_ALIGN, min(hint, height, budget))
+    for tile in range(cap - cap % _ALIGN, 0, -_ALIGN):
+        if height % tile == 0:
+            return tile
+    return _ALIGN
+
+
+# TPU tiling for 8-bit data is (32, 128): every DMA row offset must be a
+# multiple of 32 or the transfer touches partial tiles (Mosaic's
+# divisibility proof rejects some such cases outright; others have been
+# observed to pass and rarely corrupt — keep everything 32-aligned).
+_ALIGN = 32
+
+
+def _kernel(board_hbm, out_ref, scratch, sems, *, tile: int, height: int):
+    """Scratch layout (all DMA offsets 8-row aligned, as Mosaic requires):
+
+    rows [0, 8)              aligned block ending in the top halo row
+    rows [8, 8+tile)         the body tile
+    rows [8+tile, 16+tile)   aligned block starting with the bottom halo row
+
+    Single-row ghost DMAs at odd offsets fail Mosaic's tiling-divisibility
+    proof, so each halo fetches its full 8-row aligned block instead; the
+    extra rows cost a little HBM bandwidth but keep every transfer aligned.
+    """
+    i = pl.program_id(0)
+    start = pl.multiple_of(i * tile, _ALIGN)
+    top8 = pl.multiple_of(
+        jnp.where(i == 0, height - _ALIGN, start - _ALIGN), _ALIGN
+    )
+    bot8 = pl.multiple_of(
+        jnp.where(start + tile == height, 0, start + tile), _ALIGN
+    )
+
+    body_dma = pltpu.make_async_copy(
+        board_hbm.at[pl.ds(start, tile), :],
+        scratch.at[pl.ds(_ALIGN, tile), :],
+        sems.at[0],
+    )
+    top_dma = pltpu.make_async_copy(
+        board_hbm.at[pl.ds(top8, _ALIGN), :],
+        scratch.at[pl.ds(0, _ALIGN), :],
+        sems.at[1],
+    )
+    bot_dma = pltpu.make_async_copy(
+        board_hbm.at[pl.ds(bot8, _ALIGN), :],
+        scratch.at[pl.ds(_ALIGN + tile, _ALIGN), :],
+        sems.at[2],
+    )
+    body_dma.start()
+    top_dma.start()
+    bot_dma.start()
+    body_dma.wait()
+    top_dma.wait()
+    bot_dma.wait()
+
+    # Mosaic vector ops (roll in particular) need i32 lanes; the DMA'd
+    # tile stays uint8 in VMEM (1 byte/cell of HBM traffic), compute
+    # widens on the VPU.
+    ext = scratch[_ALIGN - 1 : _ALIGN + tile + 1, :].astype(jnp.int32)
+    width = ext.shape[1]
+    rows3 = ext[:-2] + ext[1:-1] + ext[2:]  # [tile, W], vertical 3-sum
+    west = pltpu.roll(rows3, 1, axis=1)  # column torus wrap
+    east = pltpu.roll(rows3, width - 1, axis=1)  # roll by -1 (must be >= 0)
+    center = ext[1:-1]
+    neighbors = rows3 + west + east - center
+    alive_next = (neighbors == 3) | ((center == 1) & (neighbors == 2))
+    out_ref[:] = alive_next.astype(out_ref.dtype)
+
+
+# Importing this module requires a jaxlib with Pallas/Mosaic; on one
+# without it the ImportError propagates and the runtime reports the
+# engine as unavailable (runtime._evolve_fn's guard).
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+
+def step_pallas(board: jax.Array, tile: int) -> jax.Array:
+    """One torus generation via the fused Pallas kernel."""
+    height, width = board.shape
+    if height % tile != 0 or tile % _ALIGN != 0:
+        raise ValueError(
+            f"tile {tile} must divide board height {height} and be a "
+            f"multiple of {_ALIGN}"
+        )
+    grid = height // tile
+    return pl.pallas_call(
+        functools.partial(_kernel, tile=tile, height=height),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (tile, width), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct(board.shape, board.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tile + 2 * _ALIGN, width), board.dtype),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(board)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=(0,))
+def evolve(board: jax.Array, steps: int, tile_hint: int) -> jax.Array:
+    """Evolve ``steps`` generations, whole loop in one compiled program.
+
+    ``tile_hint`` is the CLI's ``threadsPerBlock``; it is clamped to a
+    valid, VMEM-fitting divisor of the board height (fixing bug B5's
+    silent no-op for out-of-range values).
+    """
+    tile = pick_tile(board.shape[0], board.shape[1], tile_hint)
+    return lax.fori_loop(0, steps, lambda _, b: step_pallas(b, tile), board)

@@ -21,6 +21,14 @@ from __future__ import annotations
 import numpy as np
 
 
+def random_board(
+    h: int, w: int, seed: int, density: float = 0.4
+) -> np.ndarray:
+    """Shared random 0/1 uint8 board fixture used across the test suite."""
+    rng = np.random.default_rng(seed)
+    return (rng.random((h, w)) < density).astype(np.uint8)
+
+
 def _neighbors_torus(board: np.ndarray) -> np.ndarray:
     n = np.zeros(board.shape, dtype=np.int32)
     for dy in (-1, 0, 1):

@@ -19,8 +19,7 @@ from tests import oracle
 
 
 def random_board(h, w, seed, density=0.35):
-    rng = np.random.default_rng(seed)
-    return (rng.random((h, w)) < density).astype(np.uint8)
+    return oracle.random_board(h, w, seed, density)
 
 
 def devices():

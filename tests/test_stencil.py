@@ -9,9 +9,7 @@ from gol_tpu.ops import stencil
 from tests import oracle
 
 
-def random_board(h, w, seed, density=0.4):
-    rng = np.random.default_rng(seed)
-    return (rng.random((h, w)) < density).astype(np.uint8)
+random_board = oracle.random_board
 
 
 @pytest.mark.parametrize("shape", [(8, 8), (16, 32), (33, 17), (1, 8), (64, 64)])
