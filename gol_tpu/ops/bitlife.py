@@ -92,17 +92,14 @@ def _row_hsum(row: jax.Array):
     return _full_add(west, row, east)
 
 
-def step_packed_rows(center: jax.Array, above: jax.Array, below: jax.Array):
-    """Next generation of packed rows given packed neighbor rows.
+def _rule_from_row_sums(center, sa, sc, sb):
+    """B3/S23 from the three per-row 2-bit horizontal sums.
 
-    ``above``/``below`` are the packed analogs of the reference's
-    ``previous_last_row``/``next_first_row`` ghost rows (gol-main.c:11) when
-    called row-sharded, or the rolled board when called on a full torus.
+    ``sa``/``sc``/``sb`` are (ones_plane, twos_plane) pairs for the above /
+    center / below stencil rows; builds the 4-bit count-of-9 and applies the
+    branchless rule (the if/else chain of gol-with-cuda.cu:239-257).
     """
-    s0a, s1a = _row_hsum(above)
-    s0c, s1c = _row_hsum(center)
-    s0b, s1b = _row_hsum(below)
-
+    (s0a, s1a), (s0c, s1c), (s0b, s1b) = sa, sc, sb
     # count-of-9 t = (s0a+s0c+s0b) + 2*(s1a+s1c+s1b); build its bit-planes.
     l0, c_low = _full_add(s0a, s0c, s0b)  # ones plane + carry into twos
     u, v = _full_add(s1a, s1c, s1b)  # twos-plane sum: u ones, v twos
@@ -116,11 +113,83 @@ def step_packed_rows(center: jax.Array, above: jax.Array, below: jax.Array):
     return eq3 | (center & eq4)
 
 
+def step_packed_rows(center: jax.Array, above: jax.Array, below: jax.Array):
+    """Next generation of packed rows given packed neighbor rows.
+
+    ``above``/``below`` are the packed analogs of the reference's
+    ``previous_last_row``/``next_first_row`` ghost rows (gol-main.c:11).
+    Columns wrap mod the packed width (torus, gol-with-cuda.cu:210-211).
+    Each row's horizontal sum is computed afresh; callers stepping a whole
+    board should prefer :func:`step_packed` / :func:`step_packed_vext`,
+    which compute every row's sum exactly once.
+    """
+    return _rule_from_row_sums(
+        center, _row_hsum(above), _row_hsum(center), _row_hsum(below)
+    )
+
+
+def step_packed_vext(ext: jax.Array) -> jax.Array:
+    """One packed generation of a row-halo-extended block ``ext[h+2, nw]``.
+
+    Ghost *rows* above/below carry the vertical periodicity; columns wrap
+    locally (width axis unsharded) — the bit-packed analog of
+    :func:`gol_tpu.ops.stencil.step_halo_rows` for the 1-D row
+    decomposition.  The horizontal sum is computed once per extended row and
+    its bit-planes re-sliced for the above/center/below stencil rows.
+    Returns the updated interior ``[h, nw]``.
+    """
+    s0, s1 = _row_hsum(ext)
+    return _rule_from_row_sums(
+        ext[1:-1],
+        (s0[:-2], s1[:-2]),
+        (s0[1:-1], s1[1:-1]),
+        (s0[2:], s1[2:]),
+    )
+
+
+def _row_hsum_ext(rows: jax.Array):
+    """Per-lane 3-cell horizontal sum on word-halo-extended rows.
+
+    ``rows[..., nw+2]`` carries one ghost *word* per side, so the west/east
+    carry bits come from adjacent array words — no wrap.  Returns 2
+    bit-planes of shape ``[..., nw]``.
+    """
+    cur = rows[..., 1:-1]
+    west = (cur << 1) | (rows[..., :-2] >> (BITS - 1))
+    east = (cur >> 1) | (rows[..., 2:] << (BITS - 1))
+    return _full_add(west, cur, east)
+
+
+def step_packed_halo_full(ext: jax.Array) -> jax.Array:
+    """One packed generation given a fully halo-extended block.
+
+    ``ext[h+2, nw+2]`` has one ghost row of packed words above/below and one
+    ghost *word* column left/right (corner words included) — the bit-packed
+    analog of :func:`gol_tpu.ops.stencil.step_halo_full` for the 2-D block
+    decomposition.  No wrap is applied; the halo ring carries all
+    periodicity.  The horizontal sum is computed once per extended row and
+    its bit-planes re-sliced.  Returns the updated interior ``[h, nw]``.
+    """
+    s0, s1 = _row_hsum_ext(ext)
+    return _rule_from_row_sums(
+        ext[1:-1, 1:-1],
+        (s0[:-2], s1[:-2]),
+        (s0[1:-1], s1[1:-1]),
+        (s0[2:], s1[2:]),
+    )
+
+
 def step_packed(packed: jax.Array) -> jax.Array:
-    """One generation on a fully periodic packed board uint32[H, W//32]."""
-    above = jnp.roll(packed, 1, axis=-2)
-    below = jnp.roll(packed, -1, axis=-2)
-    return step_packed_rows(packed, above, below)
+    """One generation on a fully periodic packed board uint32[H, W//32].
+
+    The horizontal sum is computed once per row; the above/below stencil
+    rows reuse its bit-planes via torus rolls (2 rolls per plane instead of
+    re-running the ~7-op shift/adder sum on rolled boards).
+    """
+    s0, s1 = _row_hsum(packed)
+    sa = (jnp.roll(s0, 1, axis=-2), jnp.roll(s1, 1, axis=-2))
+    sb = (jnp.roll(s0, -1, axis=-2), jnp.roll(s1, -1, axis=-2))
+    return _rule_from_row_sums(packed, sa, (s0, s1), sb)
 
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))

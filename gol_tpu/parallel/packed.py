@@ -1,0 +1,123 @@
+"""Bit-packed sharded engine: 32-cells/word Life under shard_map halo rings.
+
+The two perf tiers of SURVEY §7 composed: the carry-save bit-packed step
+(:mod:`gol_tpu.ops.bitlife`, 8× less HBM traffic than dense uint8) runs
+per-shard under ``shard_map``, with ``lax.ppermute`` ring exchanges shipping
+*packed* halos — so the wire traffic of the reference's ghost-row messages
+(``MPI_UNSIGNED_CHAR`` × width, gol-main.c:97-107) also drops 8×: one
+uint32 word per 32 cells of boundary instead of 32 bytes.
+
+Decompositions mirror :mod:`gol_tpu.parallel.sharded`:
+
+- **1-D rows**: two ppermutes/step deliver packed up/down ghost rows;
+  columns wrap locally (width axis unsharded) via the lane-carry roll inside
+  the packed step.
+- **2-D blocks**: two-phase exchange — edge *rows* of packed words
+  vertically, then edge *word columns* of the row-extended block
+  horizontally, which carries the four corner words for free.  The
+  horizontal halo quantum is a full 32-cell word even though only 1
+  boundary bit is consumed; a word is the cheapest addressable unit and
+  the traffic is still ≤ the dense engine's 1-byte column halo.
+
+Pack/unpack happen once per evolve call, per shard, inside the compiled
+program — dense uint8 in, dense uint8 out, cost amortized over the whole
+``fori_loop`` (same contract as :func:`gol_tpu.ops.bitlife.evolve_dense_io`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gol_tpu.ops import bitlife
+from gol_tpu.parallel.mesh import COLS, ROWS, validate_geometry
+from gol_tpu.parallel.sharded import (
+    exchange_block_halos,
+    exchange_row_halos,
+    place_private,
+)
+
+
+def validate_packed_geometry(shape, mesh: Mesh) -> None:
+    """Packed sharding needs each shard's width to pack into whole words."""
+    validate_geometry(shape, mesh)
+    cols = mesh.shape.get(COLS, 1)
+    shard_w = shape[1] // cols
+    if shard_w % bitlife.BITS != 0:
+        raise ValueError(
+            f"bit-packed sharded engine needs shard width divisible by "
+            f"{bitlife.BITS}; board width {shape[1]} over {cols} mesh cols "
+            f"gives shard width {shard_w}"
+        )
+
+
+def step_packed_halo_rows(block: jax.Array, num_rows: int) -> jax.Array:
+    """One packed generation of a row-sharded shard with fresh ring halos.
+
+    ``block`` is the shard's packed words ``uint32[h, W/32]``.  The dense
+    engine's ring exchange (:func:`~gol_tpu.parallel.sharded.
+    exchange_row_halos`, dtype-agnostic) ships the packed boundary rows —
+    the ``previous_last_row``/``next_first_row`` of gol-main.c:11, re-sliced
+    live each step (B1 fixed by construction), at 1/8th the bytes.
+    """
+    top, bottom = exchange_row_halos(block, num_rows)
+    ext = jnp.concatenate([top[None], block, bottom[None]], axis=0)
+    return bitlife.step_packed_vext(ext)
+
+
+def step_packed_halo_blocks(
+    block: jax.Array, num_rows: int, num_cols: int
+) -> jax.Array:
+    """One packed generation of a 2-D-sharded shard with fresh ring halos.
+
+    The same two-phase edge exchange as the dense engine
+    (:func:`gol_tpu.parallel.sharded.exchange_block_halos` is dtype-agnostic
+    and reused directly), but the halo quantum is a packed word: phase 2
+    ships the edge word-columns of the already row-extended block, so the
+    corner *words* make two hops and land with their boundary bits intact.
+    """
+    ext = exchange_block_halos(block, num_rows, num_cols)  # [h+2, nw+2]
+    return bitlife.step_packed_halo_full(ext)
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_evolve_packed(mesh: Mesh, steps: int):
+    """Build + jit the packed sharded evolve for (mesh, steps).
+
+    Dense uint8 board in/out with the canonical mesh sharding; pack /
+    ``fori_loop`` over packed steps / unpack all run per-shard inside one
+    compiled program.  The input buffer is donated (the double buffer).
+    """
+    two_d = COLS in mesh.axis_names
+    num_rows = mesh.shape[ROWS]
+    num_cols = mesh.shape.get(COLS, 1)
+
+    if two_d:
+        body = lambda _, blk: step_packed_halo_blocks(blk, num_rows, num_cols)
+        spec = P(ROWS, COLS)
+    else:
+        body = lambda _, blk: step_packed_halo_rows(blk, num_rows)
+        spec = P(ROWS, None)
+
+    def local(board):
+        packed = bitlife.pack(board)
+        packed = lax.fori_loop(0, steps, body, packed)
+        return bitlife.unpack(packed)
+
+    shmapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    return jax.jit(shmapped, donate_argnums=0)
+
+
+def evolve_sharded_packed(board: jax.Array, steps: int, mesh: Mesh) -> jax.Array:
+    """Evolve a dense board over ``mesh`` with the bit-packed engine.
+
+    Placement/copy contract matches
+    :func:`gol_tpu.parallel.sharded.evolve_sharded`: the caller's array is
+    never consumed (see :func:`gol_tpu.parallel.sharded.place_private`).
+    """
+    validate_packed_geometry(board.shape, mesh)
+    return compiled_evolve_packed(mesh, steps)(place_private(board, mesh))

@@ -132,30 +132,36 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str):
     return jax.jit(local, donate_argnums=0)
 
 
+def place_private(board: jax.Array, mesh: Mesh) -> jax.Array:
+    """Canonically shard ``board`` in a buffer safe to donate.
+
+    The sharded evolvers donate their input (the framework's double
+    buffer), so the caller's array must never be the donated buffer: when
+    ``device_put`` would be a no-op (equivalent-sharding fast path, which
+    aliases), hand the evolver a private copy instead.
+    """
+    sharding = board_sharding(mesh)
+    current = getattr(board, "sharding", None)
+    if current is not None and sharding.is_equivalent_to(current, board.ndim):
+        return jnp.array(board, copy=True)
+    return jax.device_put(board, sharding)
+
+
 def evolve_sharded(
     board: jax.Array, steps: int, mesh: Mesh, mode: str = "explicit"
 ) -> jax.Array:
     """Evolve a board sharded over ``mesh`` for ``steps`` generations.
 
     The board is placed with the canonical sharding if it isn't already, and
-    the caller's array is never consumed: the compiled program donates its
-    input (double buffering), so when ``device_put`` would be a no-op we
-    hand it a private copy.  Performance-critical callers that *want* the
-    donation manage placement themselves and call :func:`compiled_evolve`.
-    Semantics are the correct torus (fresh halos) in every mode.
+    the caller's array is never consumed (see :func:`place_private`).
+    Performance-critical callers that *want* the donation manage placement
+    themselves and call :func:`compiled_evolve`.  Semantics are the correct
+    torus (fresh halos) in every mode.
     """
     if mode not in MODES:
         raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
     validate_geometry(board.shape, mesh)
-    sharding = board_sharding(mesh)
-    current = getattr(board, "sharding", None)
-    if current is not None and sharding.is_equivalent_to(current, board.ndim):
-        # device_put would alias the caller's buffer (equivalent-sharding
-        # fast path) and donation would then delete it out from under them.
-        board = jnp.array(board, copy=True)
-    else:
-        board = jax.device_put(board, sharding)
-    return compiled_evolve(mesh, steps, mode)(board)
+    return compiled_evolve(mesh, steps, mode)(place_private(board, mesh))
 
 
 def lower_sharded(shape, dtype, steps: int, mesh: Mesh, mode: str = "explicit"):

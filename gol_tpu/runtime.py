@@ -28,6 +28,7 @@ from gol_tpu.models import patterns
 from gol_tpu.models.state import Geometry, GolState
 from gol_tpu.parallel import engine as engine_mod
 from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import packed as packed_mod
 from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils import checkpoint as ckpt_mod
 from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready, maybe_profile
@@ -76,16 +77,23 @@ class GolRuntime:
                     "stale_t0 (reference-compat) runs are single-device only; "
                     "its blocks evolve independently so a mesh adds nothing"
                 )
-            if self.engine not in ("auto", "dense"):
+            if self.engine not in ("auto", "dense", "bitpack"):
                 raise ValueError(
-                    f"engine {self.engine!r} has no sharded path yet; with a "
-                    "mesh use engine 'dense'/'auto' (shard_map+ppermute or "
-                    "auto-SPMD)"
+                    f"engine {self.engine!r} has no sharded path; with a "
+                    "mesh use 'dense'/'auto' (shard_map+ppermute or "
+                    "auto-SPMD) or 'bitpack' (packed shard_map+ppermute)"
                 )
-            mesh_mod.validate_geometry(
-                (self.geometry.global_height, self.geometry.global_width),
-                self.mesh,
-            )
+            shape = (self.geometry.global_height, self.geometry.global_width)
+            if self.engine == "bitpack":
+                if self.shard_mode != "explicit":
+                    raise ValueError(
+                        "the bit-packed sharded engine has only the explicit "
+                        "shard_map+ppermute program; shard_mode "
+                        f"{self.shard_mode!r} applies to engine 'dense'/'auto'"
+                    )
+                packed_mod.validate_packed_geometry(shape, self.mesh)
+            else:
+                mesh_mod.validate_geometry(shape, self.mesh)
         # Frozen t=0 halos, populated for stale_t0 runs at board init.
         self._halos: Optional[Tuple[jax.Array, jax.Array]] = None
 
@@ -119,6 +127,12 @@ class GolRuntime:
             raise ValueError(f"engine {name!r} implements fresh halos only")
         try:
             if name == "bitpack":
+                if self.mesh is not None:
+                    return (
+                        packed_mod.compiled_evolve_packed(self.mesh, steps),
+                        (),
+                        (),
+                    )
                 from gol_tpu.ops import bitlife
 
                 return bitlife.evolve_dense_io, (), (steps,)

@@ -33,6 +33,58 @@ def test_runtime_sharded_2d_matches_oracle():
     )
 
 
+def test_runtime_sharded_bitpack_matches_oracle():
+    geom = Geometry(size=32, num_ranks=1)  # 32×32 world, 1-D ring
+    rt = GolRuntime(
+        geometry=geom, engine="bitpack", mesh=mesh_mod.make_mesh_1d(4)
+    )
+    _, state = rt.run(pattern=4, iterations=5)
+    board0 = patterns.init_global(4, 32, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 5)
+    )
+
+
+def test_runtime_sharded_bitpack_2d_matches_oracle():
+    geom = Geometry(size=256, num_ranks=1)  # 256×256 on a 2×4 mesh
+    rt = GolRuntime(
+        geometry=geom, engine="bitpack", mesh=mesh_mod.make_mesh_2d((2, 4))
+    )
+    _, state = rt.run(pattern=2, iterations=3)
+    board0 = patterns.init_global(2, 256, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 3)
+    )
+
+
+def test_runtime_bitpack_mesh_rejects_auto_shard_mode():
+    with pytest.raises(ValueError, match="explicit"):
+        GolRuntime(
+            geometry=Geometry(size=32, num_ranks=1),
+            engine="bitpack",
+            shard_mode="auto",
+            mesh=mesh_mod.make_mesh_1d(4),
+        )
+
+
+def test_runtime_bitpack_mesh_rejects_unpackable_width():
+    with pytest.raises(ValueError, match="shard width"):
+        GolRuntime(
+            geometry=Geometry(size=16, num_ranks=1),
+            engine="bitpack",
+            mesh=mesh_mod.make_mesh_2d((2, 4)),  # shard width 4 < 32
+        )
+
+
+def test_runtime_mesh_rejects_pallas_engine():
+    with pytest.raises(ValueError, match="sharded path"):
+        GolRuntime(
+            geometry=Geometry(size=32, num_ranks=1),
+            engine="pallas",
+            mesh=mesh_mod.make_mesh_1d(4),
+        )
+
+
 def test_runtime_mesh_rejects_stale_halo():
     with pytest.raises(ValueError, match="single-device"):
         GolRuntime(
