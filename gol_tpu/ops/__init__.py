@@ -1,5 +1,5 @@
 """Compute ops: the TPU-native replacements for the CUDA kernel layer."""
 
-from gol_tpu.ops import stencil
+from gol_tpu.ops import life3d, stencil
 
-__all__ = ["stencil"]
+__all__ = ["life3d", "stencil"]

@@ -1,0 +1,62 @@
+"""Generic N-phase halo extension over an N-D device mesh.
+
+Generalizes the two-phase edge+corner exchange of
+:func:`gol_tpu.parallel.sharded.exchange_block_halos` to any rank: extend
+one array axis at a time with ``lax.ppermute`` ring shifts, each later
+phase shipping boundary slices of the *already-extended* array.  After
+phase k, a halo cell that must cross k mesh axes (an edge or corner of the
+decomposition) has made its k hops — so faces, edges, and corners all land
+without diagonal messages, in 2 ppermutes per axis.
+
+The same code path expresses the local torus wrap: on a mesh axis of size
+1 the ring permutation ``[(0, 0)]`` delivers the shard its *own* boundary
+slice, which is exactly the periodic wrap.  Axes the caller leaves
+unsharded therefore just use size-1 rings — there is one program shape for
+every decomposition of the torus (the property the reference's hand-rolled
+1-D MPI exchange, gol-main.c:86-111, could not scale to).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring(n: int, shift: int):
+    """Permutation delivering each shard the slice from its ring ±1 neighbor.
+
+    ``shift=+1`` receives from the ring predecessor (the reference's
+    ``prevRank``, gol-main.c:86), ``shift=-1`` from the successor.
+    """
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def halo_extend(
+    block: jax.Array, mesh_axes: Sequence[Tuple[int, str, int]]
+) -> jax.Array:
+    """Extend ``block`` by one ghost layer on both sides of each given axis.
+
+    ``mesh_axes`` is a sequence of ``(array_axis, mesh_axis_name, ring_size)``
+    — one entry per array axis to extend, in phase order.  Must be called
+    inside ``shard_map`` over a mesh carrying the named axes.  Returns the
+    block grown by 2 along every listed axis.
+    """
+    ext = block
+    for axis, name, n in mesh_axes:
+        last = tuple(
+            slice(-1, None) if a == axis else slice(None)
+            for a in range(ext.ndim)
+        )
+        first = tuple(
+            slice(None, 1) if a == axis else slice(None)
+            for a in range(ext.ndim)
+        )
+        # Receive the ring-predecessor's last slice (our "low" ghost) and the
+        # ring-successor's first slice (our "high" ghost).
+        lo = lax.ppermute(ext[last], name, ring(n, 1))
+        hi = lax.ppermute(ext[first], name, ring(n, -1))
+        ext = jnp.concatenate([lo, ext, hi], axis=axis)
+    return ext

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 ROWS = "rows"
 COLS = "cols"
+PLANES = "planes"  # leading axis of the 3-D Life volume decomposition
 
 
 def make_mesh_1d(num_devices: Optional[int] = None, devices=None) -> Mesh:
@@ -63,6 +64,35 @@ def make_mesh_2d(
     return Mesh(np.asarray(devices).reshape(rows, cols), (ROWS, COLS))
 
 
+def make_mesh_3d(
+    shape: Optional[Tuple[int, int, int]] = None, devices=None
+) -> Mesh:
+    """Grid of devices over (planes, rows, cols) for 3-D Life volumes.
+
+    Axes may have size 1 (unsharded volume axes use size-1 halo rings, which
+    degenerate to the local torus wrap).  Without an explicit shape, picks
+    the most cube-like factorization of the device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        p = int(round(n ** (1 / 3)))
+        while p > 1 and n % p:
+            p -= 1
+        rest = n // p
+        r = int(np.sqrt(rest))
+        while rest % r:
+            r -= 1
+        shape = (p, r, rest // r)
+    planes, rows, cols = shape
+    if planes * rows * cols != n:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    return Mesh(
+        np.asarray(devices).reshape(planes, rows, cols), (PLANES, ROWS, COLS)
+    )
+
+
 def board_sharding(mesh: Mesh) -> NamedSharding:
     """The canonical board sharding for a mesh: rows (and cols) split."""
     if COLS in mesh.axis_names:
@@ -73,6 +103,22 @@ def board_sharding(mesh: Mesh) -> NamedSharding:
 def shard_board(board, mesh: Mesh):
     """Place a board onto the mesh with the canonical sharding."""
     return jax.device_put(board, board_sharding(mesh))
+
+
+def place_private(arr, sharding: NamedSharding):
+    """Place ``arr`` with ``sharding`` in a buffer safe to donate.
+
+    The sharded evolvers donate their input (the framework's double
+    buffer), so the caller's array must never be the donated buffer: when
+    ``device_put`` would be a no-op (equivalent-sharding fast path, which
+    aliases), hand the evolver a private copy instead.
+    """
+    import jax.numpy as jnp
+
+    current = getattr(arr, "sharding", None)
+    if current is not None and sharding.is_equivalent_to(current, arr.ndim):
+        return jnp.array(arr, copy=True)
+    return jax.device_put(arr, sharding)
 
 
 def validate_geometry(shape: Sequence[int], mesh: Mesh) -> None:

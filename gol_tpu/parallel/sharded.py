@@ -43,18 +43,11 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.ops import stencil
+from gol_tpu.parallel.halo import halo_extend, ring
 from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding, validate_geometry
+from gol_tpu.parallel.mesh import place_private as mesh_place_private
 
 MODES = ("explicit", "auto")
-
-
-def _recv_from_prev(n: int):
-    """Permutation delivering each shard its ring-predecessor's message."""
-    return [(i, (i + 1) % n) for i in range(n)]
-
-
-def _recv_from_next(n: int):
-    return [(i, (i - 1) % n) for i in range(n)]
 
 
 def exchange_row_halos(block: jax.Array, num_rows: int):
@@ -65,8 +58,8 @@ def exchange_row_halos(block: jax.Array, num_rows: int):
     from the live board every step (fixing B1 by construction).
     Returns (top_row[W], bottom_row[W]).
     """
-    top = lax.ppermute(block[-1:], ROWS, _recv_from_prev(num_rows))
-    bottom = lax.ppermute(block[:1], ROWS, _recv_from_next(num_rows))
+    top = lax.ppermute(block[-1:], ROWS, ring(num_rows, 1))
+    bottom = lax.ppermute(block[:1], ROWS, ring(num_rows, -1))
     return top[0], bottom[0]
 
 
@@ -76,14 +69,10 @@ def exchange_block_halos(block: jax.Array, num_rows: int, num_cols: int):
     Phase 1 ships edge *rows* vertically; phase 2 ships the edge *columns of
     the already row-extended block* horizontally, so each corner cell makes
     two hops (vertical then horizontal) and lands correctly — no diagonal
-    messages needed.
+    messages needed.  Implemented by the generic N-phase extension in
+    :mod:`gol_tpu.parallel.halo` (shared with the 3-D engine).
     """
-    top = lax.ppermute(block[-1:, :], ROWS, _recv_from_prev(num_rows))
-    bottom = lax.ppermute(block[:1, :], ROWS, _recv_from_next(num_rows))
-    vext = jnp.concatenate([top, block, bottom], axis=0)  # [h+2, w]
-    left = lax.ppermute(vext[:, -1:], COLS, _recv_from_prev(num_cols))
-    right = lax.ppermute(vext[:, :1], COLS, _recv_from_next(num_cols))
-    return jnp.concatenate([left, vext, right], axis=1)  # [h+2, w+2]
+    return halo_extend(block, ((0, ROWS, num_rows), (1, COLS, num_cols)))
 
 
 @functools.lru_cache(maxsize=64)
@@ -135,16 +124,10 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str):
 def place_private(board: jax.Array, mesh: Mesh) -> jax.Array:
     """Canonically shard ``board`` in a buffer safe to donate.
 
-    The sharded evolvers donate their input (the framework's double
-    buffer), so the caller's array must never be the donated buffer: when
-    ``device_put`` would be a no-op (equivalent-sharding fast path, which
-    aliases), hand the evolver a private copy instead.
+    See :func:`gol_tpu.parallel.mesh.place_private` for the aliasing
+    rationale.
     """
-    sharding = board_sharding(mesh)
-    current = getattr(board, "sharding", None)
-    if current is not None and sharding.is_equivalent_to(current, board.ndim):
-        return jnp.array(board, copy=True)
-    return jax.device_put(board, sharding)
+    return mesh_place_private(board, board_sharding(mesh))
 
 
 def evolve_sharded(

@@ -54,6 +54,37 @@ def run_torus(board: np.ndarray, steps: int) -> np.ndarray:
     return board
 
 
+def random_volume(
+    d: int, h: int, w: int, seed: int, density: float = 0.3
+) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return (rng.random((d, h, w)) < density).astype(np.uint8)
+
+
+def step_torus3d(
+    vol: np.ndarray, birth=frozenset({5}), survive=frozenset({4, 5})
+) -> np.ndarray:
+    """One 3-D generation, all axes periodic; 26 explicit shifted adds
+    (deliberately non-separable, unlike the JAX implementation)."""
+    n = np.zeros(vol.shape, dtype=np.int32)
+    for dz in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                if dz == dy == dx == 0:
+                    continue
+                n += np.roll(vol, (dz, dy, dx), axis=(0, 1, 2))
+    alive = vol == 1
+    born = np.isin(n, sorted(birth)) & ~alive
+    stay = np.isin(n, sorted(survive)) & alive
+    return (born | stay).astype(np.uint8)
+
+
+def run_torus3d(vol: np.ndarray, steps: int, **rule) -> np.ndarray:
+    for _ in range(steps):
+        vol = step_torus3d(vol, **rule)
+    return vol
+
+
 def _step_block_frozen_halos(
     block: np.ndarray, top: np.ndarray, bottom: np.ndarray
 ) -> np.ndarray:

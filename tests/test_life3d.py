@@ -1,0 +1,118 @@
+"""3-D Life (26-neighbor stencil) vs. the NumPy oracle, local and sharded.
+
+BASELINE config 5 coverage: the single-device 3-torus step, the
+halo-extended step, and the three-phase ppermute decomposition on every
+mesh shape the 8-device CPU fixture can express — including meshes with
+size-1 axes (whose rings degenerate to the local wrap) and the full 2×2×2
+cube, where corner cells cross three mesh axes in one generation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gol_tpu.ops import life3d
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import sharded3d
+
+from tests import oracle
+
+
+@pytest.mark.parametrize("steps", [1, 3])
+def test_step3d_matches_oracle(steps):
+    vol = oracle.random_volume(6, 8, 10, seed=steps)
+    got = np.asarray(life3d.run3d(jnp.asarray(vol), steps))
+    np.testing.assert_array_equal(got, oracle.run_torus3d(vol, steps))
+
+
+def test_step3d_custom_rule():
+    vol = oracle.random_volume(6, 6, 6, seed=7, density=0.4)
+    rule = life3d.BAYS_5766
+    got = np.asarray(life3d.run3d(jnp.asarray(vol), 2, rule))
+    np.testing.assert_array_equal(
+        got,
+        oracle.run_torus3d(vol, 2, birth=rule.birth, survive=rule.survive),
+    )
+
+
+def test_rule_table_exhaustive():
+    """Every (alive, count) pair via a cell whose neighborhood is built
+    directly: center of a 3×3×3 block with k live neighbors."""
+    for k in range(27):
+        for alive in (0, 1):
+            vol = np.zeros((3, 3, 3), np.uint8)
+            flat = [i for i in range(27) if i != 13][:k]
+            vol.flat[flat] = 1
+            vol[1, 1, 1] = alive
+            # 3×3×3 torus wraps make each neighbor triple-counted; use a
+            # padded 5-cube instead so the neighborhood is exact.
+            big = np.zeros((5, 5, 5), np.uint8)
+            big[1:4, 1:4, 1:4] = vol
+            nxt = np.asarray(life3d.step3d(jnp.asarray(big)))[2, 2, 2]
+            expect = (
+                1
+                if (alive and k in {4, 5}) or (not alive and k == 5)
+                else 0
+            )
+            assert nxt == expect, (alive, k)
+
+
+def test_empty_rule_sets_are_legal():
+    """A pure-decay rule (no birth, no survive) kills everything — the empty
+    frozenset must trace as an always-false predicate, not crash."""
+    vol = oracle.random_volume(4, 4, 4, seed=9, density=0.5)
+    rule = life3d.Rule3D(birth=frozenset(), survive=frozenset())
+    got = np.asarray(life3d.step3d(jnp.asarray(vol), rule))
+    assert got.sum() == 0
+
+
+def test_halo_full_matches_wrap_pad():
+    vol = oracle.random_volume(4, 6, 8, seed=3)
+    ext = np.pad(vol, 1, mode="wrap")
+    got = np.asarray(life3d.step3d_halo_full(jnp.asarray(ext)))
+    np.testing.assert_array_equal(got, oracle.step_torus3d(vol))
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 2, 2), (8, 1, 1), (1, 8, 1), (1, 1, 8), (2, 4, 1), (1, 2, 4)]
+)
+def test_sharded3d_matches_oracle(shape):
+    vol = oracle.random_volume(8, 8, 8, seed=sum(shape))
+    mesh = mesh_mod.make_mesh_3d(shape, devices=jax.devices()[: np.prod(shape)])
+    got = np.asarray(sharded3d.evolve_sharded3d(jnp.asarray(vol), 4, mesh))
+    np.testing.assert_array_equal(got, oracle.run_torus3d(vol, 4))
+
+
+def test_sharded3d_single_device_mesh():
+    vol = oracle.random_volume(4, 4, 4, seed=1)
+    mesh = mesh_mod.make_mesh_3d((1, 1, 1), devices=jax.devices()[:1])
+    got = np.asarray(sharded3d.evolve_sharded3d(jnp.asarray(vol), 3, mesh))
+    np.testing.assert_array_equal(got, oracle.run_torus3d(vol, 3))
+
+
+def test_sharded3d_corner_crossing():
+    """Live cluster straddling the junction of all 8 shards of a 2×2×2 mesh:
+    its neighbors cross three mesh axes (the 3-hop corner path)."""
+    vol = np.zeros((8, 8, 8), np.uint8)
+    vol[3:5, 3:5, 3:5] = 1  # 2×2×2 cube at the 8-shard corner: n=7 each → dies
+    mesh = mesh_mod.make_mesh_3d((2, 2, 2), devices=jax.devices()[:8])
+    got = np.asarray(sharded3d.evolve_sharded3d(jnp.asarray(vol), 2, mesh))
+    np.testing.assert_array_equal(got, oracle.run_torus3d(vol, 2))
+
+
+def test_geometry3d_validation():
+    mesh = mesh_mod.make_mesh_3d((2, 2, 2), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="divisible"):
+        sharded3d.evolve_sharded3d(jnp.zeros((7, 8, 8), jnp.uint8), 1, mesh)
+
+
+def test_mesh_3d_auto_factorization():
+    mesh = mesh_mod.make_mesh_3d()
+    assert int(np.prod(list(mesh.shape.values()))) == len(jax.devices())
+    assert dict(mesh.shape) == {"planes": 2, "rows": 2, "cols": 2}
+
+
+def test_mesh_3d_shape_mismatch():
+    with pytest.raises(ValueError, match="device count"):
+        mesh_mod.make_mesh_3d((2, 2, 3))
