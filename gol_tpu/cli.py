@@ -65,7 +65,11 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
         "--engine", choices=["auto", "dense", "bitpack", "pallas"], default="auto"
     )
     ext.add_argument("--mesh", choices=["none", "1d", "2d"], default="none")
-    ext.add_argument("--shard-mode", choices=["explicit", "auto"], default="explicit")
+    ext.add_argument(
+        "--shard-mode",
+        choices=["explicit", "overlap", "auto"],
+        default="explicit",
+    )
     ext.add_argument("--outdir", default=".")
     ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
     ext.add_argument("--compat-banner", action="store_true")

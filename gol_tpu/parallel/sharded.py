@@ -21,12 +21,19 @@ Two decompositions (SURVEY §7 steps 4 and 6):
   analog: MPI codes typically need 8 messages or a diagonal phase; the
   ordered two-phase does it in 4).
 
-A third engine lets XLA's SPMD partitioner derive the halo exchange
-automatically from the sharded torus rolls (``mode="auto"``) — the
-"annotate shardings, let the compiler insert collectives" recipe; the
-explicit shard_map path exists because hand-placed ppermutes are the analog
-of the reference's explicit messaging and are what we tune (overlap,
-bit-packing) in the perf tiers.
+Three program modes:
+
+- ``"explicit"`` — hand-placed ppermutes (the analog of the reference's
+  explicit messaging), halo-extend then stencil.
+- ``"overlap"`` — same exchange, but the stencil is split interior/boundary
+  so the interior (the bulk) has no data dependency on the ppermutes and
+  XLA's latency-hiding scheduler runs exchange and compute concurrently —
+  the interior-first overlap the reference attempted with nonblocking MPI
+  but forfeited by calling ``MPI_Wait`` before the kernel
+  (gol-main.c:110-114).
+- ``"auto"`` — XLA's SPMD partitioner derives collective-permutes from the
+  sharded torus rolls: the "annotate shardings, let the compiler insert
+  collectives" recipe.
 
 The whole multi-generation loop runs inside one jitted program
 (``lax.fori_loop``), so there is no per-step host round-trip — the
@@ -47,7 +54,7 @@ from gol_tpu.parallel.halo import halo_extend, ring
 from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding, validate_geometry
 from gol_tpu.parallel.mesh import place_private as mesh_place_private
 
-MODES = ("explicit", "auto")
+MODES = ("explicit", "overlap", "auto")
 
 
 def exchange_row_halos(block: jax.Array, num_rows: int):
@@ -96,11 +103,14 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str):
     two_d = COLS in mesh.axis_names
     num_rows = mesh.shape[ROWS]
     num_cols = mesh.shape.get(COLS, 1)
+    overlap = mode == "overlap"
 
     if two_d:
 
         def body(_, blk):
             ext = exchange_block_halos(blk, num_rows, num_cols)
+            if overlap:
+                return stencil.step_halo_full_overlap(blk, ext)
             return stencil.step_halo_full(ext)
 
         spec = P(ROWS, COLS)
@@ -108,6 +118,8 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str):
 
         def body(_, blk):
             top, bottom = exchange_row_halos(blk, num_rows)
+            if overlap:
+                return stencil.step_halo_rows_overlap(blk, top, bottom)
             return stencil.step_halo_rows(blk, top, bottom)
 
         spec = P(ROWS, None)

@@ -1,0 +1,160 @@
+"""Halo-exchange latency measurement (SURVEY §5 observability).
+
+The reference's only performance artifact is one end-to-end wall-clock
+line (gol-main.c:121-125); it cannot attribute time to communication vs
+compute.  This tool times three compiled programs on the live mesh:
+
+- ``exchange``: ``steps`` back-to-back halo exchanges alone (the ppermute
+  ring traffic, nothing else) — the TPU analog of timing the reference's
+  ``MPI_Irecv``/``Isend``/``Wait`` block;
+- ``step``: the full exchange+stencil generation loop;
+- ``stencil``: the halo-free torus stencil loop (pure compute ceiling).
+
+``step - stencil`` estimates the *exposed* (non-overlapped) exchange cost
+per generation; ``exchange`` bounds the raw ring latency.  All loops run
+inside single compiled programs so host round-trips don't pollute the
+numbers.
+
+Run as a module for a JSON report:
+``python -m gol_tpu.utils.halobench [size] [steps] [mesh {1d,2d}]``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gol_tpu.ops import stencil
+from gol_tpu.parallel import sharded
+from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding
+
+
+@functools.lru_cache(maxsize=32)
+def _exchange_only(mesh: Mesh, steps: int):
+    """jit: `steps` chained halo exchanges, no stencil.
+
+    Each iteration folds the received halos back into the block (one add)
+    so the loop has a genuine data dependency and XLA cannot elide the
+    ppermutes.
+    """
+    two_d = COLS in mesh.axis_names
+    num_rows = mesh.shape[ROWS]
+    num_cols = mesh.shape.get(COLS, 1)
+
+    if two_d:
+
+        def body(_, blk):
+            ext = sharded.exchange_block_halos(blk, num_rows, num_cols)
+            # Fold in all four ghost sides so none of the four ppermutes
+            # (both phases) is dead code.
+            return (
+                blk
+                + ext[0, 1:-1]
+                + ext[-1, 1:-1]
+                + ext[1:-1, 0][:, None]
+                + ext[1:-1, -1][:, None]
+            )
+
+        spec = P(ROWS, COLS)
+    else:
+
+        def body(_, blk):
+            top, bottom = sharded.exchange_row_halos(blk, num_rows)
+            return blk + top + bottom
+
+        spec = P(ROWS, None)
+
+    local = jax.shard_map(
+        lambda b: lax.fori_loop(0, steps, body, b),
+        mesh=mesh,
+        in_specs=spec,
+        out_specs=spec,
+    )
+    return jax.jit(local)
+
+
+def _time(fn, arg, repeats: int = 3) -> float:
+    jax.block_until_ready(fn(arg))  # compile + warm
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(arg))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure(mesh: Mesh, size: int, steps: int = 100) -> Dict[str, float]:
+    """Per-generation seconds for exchange-only / full step / pure stencil.
+
+    ``stencil_s`` is the pure-compute ceiling: the torus stencil on an
+    *unsharded single-device* board of one shard's dimensions (what each
+    device computes per generation, minus all communication).  Handing the
+    sharded global board to ``stencil.run`` would instead compile an
+    auto-SPMD program whose rolls insert their own collectives.
+
+    Returns ``{"exchange_s": ..., "step_s": ..., "stencil_s": ...,
+    "exposed_exchange_s": ...}``, all per generation.
+    """
+    rng = np.random.default_rng(0)
+    board_np = (rng.random((size, size)) < 0.35).astype(np.uint8)
+    board = jax.device_put(jnp.asarray(board_np), board_sharding(mesh))
+    t_exch = _time(_exchange_only(mesh, steps), board) / steps
+    t_step = (
+        _time(lambda b: sharded.compiled_evolve(mesh, steps, "explicit")(
+            jnp.array(b, copy=True)
+        ), board)
+        / steps
+    )
+    local_h = size // mesh.shape[ROWS]
+    local_w = size // mesh.shape.get(COLS, 1)
+    shard = jax.device_put(
+        jnp.asarray(board_np[:local_h, :local_w]),
+        mesh.devices.ravel()[0],
+    )
+    t_sten = (
+        _time(lambda b: stencil.run(jnp.array(b, copy=True), steps), shard)
+        / steps
+    )
+    return {
+        "exchange_s": t_exch,
+        "step_s": t_step,
+        "stencil_s": t_sten,
+        "exposed_exchange_s": max(0.0, t_step - t_sten),
+    }
+
+
+def main(argv=None) -> None:
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    size = int(args[0]) if len(args) > 0 else 4096
+    steps = int(args[1]) if len(args) > 1 else 100
+    kind = args[2] if len(args) > 2 else "1d"
+
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    mesh = (
+        mesh_mod.make_mesh_2d() if kind == "2d" else mesh_mod.make_mesh_1d()
+    )
+    out = measure(mesh, size, steps)
+    out.update(
+        {
+            "size": size,
+            "steps": steps,
+            "mesh": dict(mesh.shape),
+            "devices": len(mesh.devices.ravel()),
+        }
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Halo-latency harness: runs on the CPU mesh, returns sane numbers."""
+
+import json
+
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.utils import halobench
+
+
+def test_measure_1d():
+    out = halobench.measure(mesh_mod.make_mesh_1d(4), size=64, steps=4)
+    assert set(out) == {
+        "exchange_s",
+        "step_s",
+        "stencil_s",
+        "exposed_exchange_s",
+    }
+    assert all(v >= 0 for v in out.values())
+    assert out["exchange_s"] > 0 and out["step_s"] > 0
+
+
+def test_measure_2d():
+    out = halobench.measure(mesh_mod.make_mesh_2d((2, 4)), size=64, steps=4)
+    assert out["step_s"] > 0
+
+
+def test_2d_exchange_program_keeps_all_four_ppermutes():
+    """The fold-in must consume every ghost side, or XLA dead-code-eliminates
+    the horizontal phase and the tool silently times a 1-D exchange."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh_mod.make_mesh_2d((2, 4))
+    fn = halobench._exchange_only(mesh, 1)
+    spec = jax.ShapeDtypeStruct(
+        (8, 64),
+        "uint8",
+        sharding=jax.sharding.NamedSharding(mesh, P("rows", "cols")),
+    )
+    hlo = fn.lower(spec).compile().as_text()
+    assert hlo.count("collective-permute") >= 4
+
+
+def test_stencil_baseline_is_single_device():
+    """The compute-ceiling program must be unsharded (no collectives)."""
+    out = halobench.measure(mesh_mod.make_mesh_2d((2, 4)), size=64, steps=2)
+    assert out["stencil_s"] > 0  # measured on the 32×16 shard, device 0
+
+
+def test_main_prints_json(capsys):
+    halobench.main(["64", "4", "1d"])
+    line = capsys.readouterr().out.strip()
+    rec = json.loads(line)
+    assert rec["size"] == 64 and rec["devices"] == 8
+    assert rec["mesh"] == {"rows": 8}

@@ -69,6 +69,54 @@ def test_auto_spmd_matches_single_device(steps):
     np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
 
 
+@pytest.mark.parametrize("num_devices", [1, 2, 4, 8])
+@pytest.mark.parametrize("steps", [1, 7])
+def test_overlap_1d_matches_oracle(num_devices, steps):
+    board = random_board(16, 24, seed=num_devices * 7 + steps)
+    mesh = mesh_mod.make_mesh_1d(num_devices)
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), steps, mesh, mode="overlap")
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (2, 2), (1, 8)])
+def test_overlap_2d_matches_oracle(shape):
+    board = random_board(16, 16, seed=sum(shape) * 3)
+    mesh = mesh_mod.make_mesh_2d(shape, devices=devices()[: shape[0] * shape[1]])
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), 6, mesh, mode="overlap")
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 6))
+
+
+def test_overlap_tiny_shards_fall_back():
+    """Shards with h < 3 (1-D) or min(h, w) < 3 (2-D) are all boundary —
+    the overlap split must degrade to the plain halo step, not miscompute."""
+    board = random_board(16, 16, seed=5)
+    mesh1 = mesh_mod.make_mesh_1d(8)  # h = 2 per shard
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), 4, mesh1, mode="overlap")
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 4))
+    mesh2 = mesh_mod.make_mesh_2d((8, 1), devices=devices()[:8])
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), 4, mesh2, mode="overlap")
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 4))
+
+
+def test_overlap_2d_glider_corner_crossing():
+    board = np.zeros((16, 16), np.uint8)
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+    board[6:9, 6:9] = g
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=devices()[:4])
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), 12, mesh, mode="overlap")
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 12))
+
+
 def test_single_row_shards():
     """h/R == 1: each shard owns exactly one row, so both its halo rows come
     from neighbors and its own row is simultaneously first and last."""
