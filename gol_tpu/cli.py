@@ -70,6 +70,7 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
         choices=["explicit", "overlap", "auto"],
         default="explicit",
     )
+    ext.add_argument("--halo-depth", type=int, default=1, metavar="K")
     ext.add_argument("--outdir", default=".")
     ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
     ext.add_argument("--compat-banner", action="store_true")
@@ -122,6 +123,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             checkpoint_dir=ns.checkpoint_dir,
             mesh=build_mesh(ns.mesh),
             shard_mode=ns.shard_mode,
+            halo_depth=ns.halo_depth,
         )
         report, final_state = rt.run(
             pattern=ns.pattern,

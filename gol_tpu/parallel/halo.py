@@ -35,23 +35,38 @@ def ring(n: int, shift: int):
 
 
 def halo_extend(
-    block: jax.Array, mesh_axes: Sequence[Tuple[int, str, int]]
+    block: jax.Array,
+    mesh_axes: Sequence[Tuple[int, str, int]],
+    depth: int = 1,
 ) -> jax.Array:
-    """Extend ``block`` by one ghost layer on both sides of each given axis.
+    """Extend ``block`` by ``depth`` ghost layers on both sides of each axis.
 
     ``mesh_axes`` is a sequence of ``(array_axis, mesh_axis_name, ring_size)``
     — one entry per array axis to extend, in phase order.  Must be called
     inside ``shard_map`` over a mesh carrying the named axes.  Returns the
-    block grown by 2 along every listed axis.
+    block grown by ``2*depth`` along every listed axis.
+
+    ``depth > 1`` is the temporal-blocking exchange: a ``depth``-deep ghost
+    shell shipped once supplies ``depth`` generations of local stepping
+    (each consuming one layer), so the ring pays 2 ppermutes per axis per
+    ``depth`` generations instead of per generation.  A ghost shell must
+    come entirely from the immediate ring neighbor, so ``depth`` may not
+    exceed the shard's extent along any extended axis.
     """
     ext = block
     for axis, name, n in mesh_axes:
+        if block.shape[axis] < depth:
+            raise ValueError(
+                f"halo depth {depth} exceeds shard extent "
+                f"{block.shape[axis]} along axis {axis} ({name}); the ghost "
+                "shell would need cells from beyond the ring neighbor"
+            )
         last = tuple(
-            slice(-1, None) if a == axis else slice(None)
+            slice(-depth, None) if a == axis else slice(None)
             for a in range(ext.ndim)
         )
         first = tuple(
-            slice(None, 1) if a == axis else slice(None)
+            slice(None, depth) if a == axis else slice(None)
             for a in range(ext.ndim)
         )
         # Receive the ring-predecessor's last slice (our "low" ghost) and the

@@ -58,6 +58,7 @@ class GolRuntime:
     checkpoint_dir: Optional[str] = None
     mesh: Optional[Mesh] = None
     shard_mode: str = "explicit"  # shard_map+ppermute vs XLA auto-SPMD
+    halo_depth: int = 1  # temporal blocking: ghost layers shipped per exchange
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -71,6 +72,39 @@ class GolRuntime:
             )
         if self.checkpoint_every and not self.checkpoint_dir:
             self.checkpoint_dir = "checkpoints"
+        if self.halo_depth < 1:
+            raise ValueError(f"halo_depth must be >= 1, got {self.halo_depth}")
+        if self.halo_depth > 1:
+            if self.mesh is None:
+                raise ValueError(
+                    "halo_depth > 1 (temporal blocking) only applies to "
+                    "sharded runs; pass a mesh"
+                )
+            if self.engine == "bitpack":
+                raise ValueError(
+                    "the bit-packed sharded engine does not support "
+                    "halo_depth > 1 yet; use engine 'dense'/'auto'"
+                )
+            if self.shard_mode != "explicit":
+                raise ValueError(
+                    "halo_depth > 1 requires shard_mode 'explicit' "
+                    f"(got {self.shard_mode!r})"
+                )
+            rows = self.mesh.shape.get(mesh_mod.ROWS, 1)
+            cols = self.mesh.shape.get(mesh_mod.COLS, 1)
+            shard_h = self.geometry.global_height // rows
+            shard_w = self.geometry.global_width // cols
+            # A 2-D mesh halo-extends the width axis even when its cols
+            # axis has size 1 (the ring degenerates to the local wrap), so
+            # the depth limit applies to both shard extents.
+            two_d = mesh_mod.COLS in self.mesh.axis_names
+            limit = min(shard_h, shard_w) if two_d else shard_h
+            if self.halo_depth > limit:
+                raise ValueError(
+                    f"halo_depth {self.halo_depth} exceeds the shard extent "
+                    f"({shard_h}×{shard_w}); the ghost shell must come from "
+                    "the immediate ring neighbor"
+                )
         if self.mesh is not None:
             if self.halo_mode != "fresh":
                 raise ValueError(
@@ -111,7 +145,9 @@ class GolRuntime:
         if name == "dense":
             if self.mesh is not None:
                 return (
-                    sharded_mod.compiled_evolve(self.mesh, steps, self.shard_mode),
+                    sharded_mod.compiled_evolve(
+                        self.mesh, steps, self.shard_mode, self.halo_depth
+                    ),
                     (),
                     (),
                 )

@@ -108,7 +108,7 @@ def measure(mesh: Mesh, size: int, steps: int = 100) -> Dict[str, float]:
     board = jax.device_put(jnp.asarray(board_np), board_sharding(mesh))
     t_exch = _time(_exchange_only(mesh, steps), board) / steps
     t_step = (
-        _time(lambda b: sharded.compiled_evolve(mesh, steps, "explicit")(
+        _time(lambda b: sharded.compiled_evolve(mesh, steps, "explicit", 1)(
             jnp.array(b, copy=True)
         ), board)
         / steps

@@ -57,6 +57,47 @@ def test_runtime_sharded_bitpack_2d_matches_oracle():
     )
 
 
+def test_runtime_deep_halo_matches_oracle():
+    geom = Geometry(size=8, num_ranks=4)  # 32×8 world
+    rt = GolRuntime(
+        geometry=geom, mesh=mesh_mod.make_mesh_1d(4), halo_depth=3
+    )
+    _, state = rt.run(pattern=1, iterations=7)
+    board0 = patterns.init_global(1, 8, 4)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 7)
+    )
+
+
+def test_runtime_deep_halo_rejections():
+    geom = Geometry(size=16, num_ranks=1)
+    with pytest.raises(ValueError, match="sharded runs"):
+        GolRuntime(geometry=geom, halo_depth=2)
+    with pytest.raises(ValueError, match="bit-packed"):
+        GolRuntime(
+            geometry=Geometry(size=32, num_ranks=1),
+            engine="bitpack",
+            mesh=mesh_mod.make_mesh_1d(4),
+            halo_depth=2,
+        )
+    with pytest.raises(ValueError, match="shard extent"):
+        GolRuntime(
+            geometry=geom,
+            mesh=mesh_mod.make_mesh_1d(8),  # shard h = 2
+            halo_depth=3,
+        )
+    # A size-1 cols axis still halo-extends the width axis: the depth limit
+    # must apply to shard width too, eagerly, not at trace time.
+    import jax
+
+    with pytest.raises(ValueError, match="shard extent"):
+        GolRuntime(
+            geometry=Geometry(size=4, num_ranks=4),  # 16×4 world
+            mesh=mesh_mod.make_mesh_2d((1, 1), devices=jax.devices()[:1]),
+            halo_depth=8,  # > shard width 4, <= shard height 16
+        )
+
+
 def test_runtime_bitpack_mesh_rejects_auto_shard_mode():
     with pytest.raises(ValueError, match="explicit"):
         GolRuntime(

@@ -117,6 +117,53 @@ def test_overlap_2d_glider_corner_crossing():
     np.testing.assert_array_equal(got, oracle.run_torus(board, 12))
 
 
+@pytest.mark.parametrize("depth", [2, 3, 4])
+@pytest.mark.parametrize("steps", [1, 4, 7, 9])
+def test_deep_halo_1d_matches_oracle(depth, steps):
+    """Temporal blocking: k-deep ghost bands, k local generations per
+    exchange — including steps not divisible by k (remainder chunk)."""
+    board = random_board(16, 24, seed=depth * 10 + steps)
+    mesh = mesh_mod.make_mesh_1d(4)
+    got = np.asarray(
+        sharded.evolve_sharded(
+            jnp.asarray(board), steps, mesh, halo_depth=depth
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+@pytest.mark.parametrize("depth", [2, 3])
+def test_deep_halo_2d_matches_oracle(depth):
+    board = random_board(16, 16, seed=depth)
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=devices()[:4])
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), 7, mesh, halo_depth=depth)
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 7))
+
+
+def test_deep_halo_glider_through_corner():
+    board = np.zeros((16, 16), np.uint8)
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+    board[6:9, 6:9] = g
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=devices()[:4])
+    got = np.asarray(
+        sharded.evolve_sharded(jnp.asarray(board), 12, mesh, halo_depth=4)
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 12))
+
+
+def test_deep_halo_rejections():
+    mesh = mesh_mod.make_mesh_1d(8)
+    board = jnp.asarray(random_board(16, 16, seed=0))  # shard h = 2
+    with pytest.raises(ValueError, match="halo depth"):
+        sharded.evolve_sharded(board, 4, mesh, halo_depth=3)
+    with pytest.raises(ValueError, match="explicit"):
+        sharded.evolve_sharded(board, 4, mesh, mode="auto", halo_depth=2)
+    with pytest.raises(ValueError, match=">= 1"):
+        sharded.evolve_sharded(board, 4, mesh, halo_depth=0)
+
+
 def test_single_row_shards():
     """h/R == 1: each shard owns exactly one row, so both its halo rows come
     from neighbors and its own row is simultaneously first and last."""
