@@ -5,9 +5,12 @@ baseline target is 1e11 aggregate on a 256-chip v5e pod == 3.90625e8 per
 chip; ``vs_baseline`` is measured-per-chip / per-chip-target, so 1.0 means
 pod-parity pro-rated to this chip and bigger is better.
 
-Runs the best available engine on the real device (TPU under the driver;
-CPU fallback works too), warm-compiled, timing only steady-state execution
-of a multi-generation fori_loop.
+Runs every available engine on the real device (TPU under the driver; CPU
+fallback works too), warm-compiled, timing only steady-state execution of a
+multi-generation fori_loop.  The step count is large (1024) because the
+whole loop is ONE device program: on a tunneled TPU each program invocation
+pays ~130 ms of RPC latency, so short loops measure the tunnel, not the
+chip.
 """
 
 from __future__ import annotations
@@ -21,7 +24,7 @@ import numpy as np
 from gol_tpu.utils.timing import force_ready as _force
 
 SIZE = 16384
-STEPS = 64
+STEPS = 1024
 PER_CHIP_TARGET = 1e11 / 256.0
 
 
@@ -41,10 +44,8 @@ def main() -> None:
 
     from gol_tpu.ops import stencil
 
-    size, steps = SIZE, STEPS
-    # Keep CPU smoke runs tractable; the driver's TPU run uses the full size.
-    if jax.devices()[0].platform == "cpu":
-        size, steps = 2048, 8
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size, steps = (SIZE, STEPS) if on_tpu else (2048, 8)
 
     rng = np.random.default_rng(0)
     board = jnp.asarray((rng.random((size, size)) < 0.35).astype(np.uint8))
@@ -56,12 +57,22 @@ def main() -> None:
         engines["bitpack"] = lambda b, s=steps: bitlife.evolve_dense_io(b, s)
     except ImportError:
         pass
-    try:
-        from gol_tpu.ops import pallas_step
+    if on_tpu:
+        # Pallas interpreter mode (non-TPU) is far too slow to bench.
+        try:
+            from gol_tpu.ops import pallas_bitlife
 
-        engines["pallas"] = lambda b, s=steps: pallas_step.evolve(b, s, 512)
-    except ImportError:
-        pass
+            engines["pallas_bitpack"] = lambda b, s=steps: pallas_bitlife.evolve(
+                b, s, 1024
+            )
+        except ImportError:
+            pass
+        try:
+            from gol_tpu.ops import pallas_step
+
+            engines["pallas"] = lambda b, s=steps: pallas_step.evolve(b, s, 512)
+        except ImportError:
+            pass
     engines["dense"] = lambda b, s=steps: stencil.run(b, s)
 
     results = {}
@@ -74,8 +85,11 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — report, never hide, a dropped engine
             print(f"bench: skipping engine {name!r}: {e!r}", file=sys.stderr)
             continue
+        # The slow engines only contend for the baseline; don't spend
+        # minutes on losers once a fast engine has set the bar.
+        repeats = 3 if not results or name.startswith("pallas") else 2
         work = jnp.array(board, copy=True)
-        dt = _measure(evolve, work, steps)
+        dt = _measure(evolve, work, steps, repeats)
         results[name] = (size * size * steps) / dt
 
     if not results:

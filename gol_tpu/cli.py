@@ -17,7 +17,8 @@ the five positionals:
 - ``--halo {fresh,stale_t0}``: correct torus semantics (default) or the
   reference's as-implemented stale-halo semantics (bug B1) for bit-exact
   output parity.
-- ``--engine {auto,dense,bitpack,pallas}``: stencil implementation tier.
+- ``--engine {auto,dense,bitpack,pallas,pallas_bitpack}``: stencil
+  implementation tier (pallas_bitpack: fused carry-save kernel, fastest).
 - ``--outdir DIR``, ``--profile DIR``, ``--compat-banner``,
   ``--checkpoint-every K`` / ``--resume PATH`` (capability additions).
 
@@ -62,7 +63,9 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--ranks", type=int, default=1)
     ext.add_argument("--halo", choices=["fresh", "stale_t0"], default="fresh")
     ext.add_argument(
-        "--engine", choices=["auto", "dense", "bitpack", "pallas"], default="auto"
+        "--engine",
+        choices=["auto", "dense", "bitpack", "pallas", "pallas_bitpack"],
+        default="auto",
     )
     ext.add_argument("--mesh", choices=["none", "1d", "2d"], default="none")
     ext.add_argument(

@@ -1,0 +1,93 @@
+"""Shared scaffolding for the Pallas stencil kernels.
+
+Both TPU kernels (:mod:`gol_tpu.ops.pallas_step`, dense uint8, and
+:mod:`gol_tpu.ops.pallas_bitlife`, bit-packed int32) use the same plan: the
+board lives in HBM, each grid step DMAs one row-tile plus two
+alignment-sized halo blocks (mod-H source rows — the torus row wrap) into a
+VMEM scratch, and the stencil runs fused over the tile.  This module holds
+the plan's two shared pieces, parameterized on the dtype's Mosaic row
+alignment and the kernel's VMEM bytes-per-board-row:
+
+- :func:`pick_tile` — the validated replacement for the reference's
+  unchecked ``blocksCount = W*H/threadsCount`` (gol-with-cuda.cu:272,
+  bug B5): largest alignment-multiple divisor of the height that fits the
+  VMEM budget and the caller's hint.
+- :func:`load_tile_with_halo` — the 3-DMA scratch fill.  Single-row ghost
+  DMAs at odd offsets fail Mosaic's tiling-divisibility proof, so each halo
+  fetches a full alignment-sized block instead; the extra rows cost a
+  little HBM bandwidth but keep every transfer aligned.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def pick_tile(
+    height: int, width: int, hint: int, align: int, bytes_per_row: int
+) -> int:
+    """Largest divisor of ``height`` <= hint whose working set fits VMEM.
+
+    ``bytes_per_row`` approximates the kernel's live VMEM bytes per board
+    row of width ``width`` (scratch + output + widened temporaries).
+    """
+    if height % align != 0:
+        raise ValueError(
+            f"pallas engine needs board height divisible by {align}, "
+            f"got {height}"
+        )
+    budget = max(align, _VMEM_BUDGET // max(1, bytes_per_row * width))
+    cap = max(align, min(hint, height, budget))
+    for tile in range(cap - cap % align, 0, -align):
+        if height % tile == 0:
+            return tile
+    return align
+
+
+def load_tile_with_halo(board_hbm, scratch, sems, i, *, tile, height, align):
+    """Fill ``scratch`` with [halo-block | body tile | halo-block] rows.
+
+    Scratch layout (all DMA offsets ``align``-row aligned):
+
+    - rows ``[0, align)``: aligned block *ending* in the top halo row
+      (``height - align`` for grid step 0 — the row torus wrap);
+    - rows ``[align, align+tile)``: the body tile;
+    - rows ``[align+tile, align+tile+align)``: aligned block *starting*
+      with the bottom halo row (0 for the last grid step).
+
+    The caller reads the stencil window as
+    ``scratch[align-1 : align+tile+1]``.  Blocks until all three DMAs land.
+    """
+    start = pl.multiple_of(i * tile, align)
+    top = pl.multiple_of(
+        jnp.where(i == 0, height - align, start - align), align
+    )
+    bot = pl.multiple_of(
+        jnp.where(start + tile == height, 0, start + tile), align
+    )
+
+    body_dma = pltpu.make_async_copy(
+        board_hbm.at[pl.ds(start, tile), :],
+        scratch.at[pl.ds(align, tile), :],
+        sems.at[0],
+    )
+    top_dma = pltpu.make_async_copy(
+        board_hbm.at[pl.ds(top, align), :],
+        scratch.at[pl.ds(0, align), :],
+        sems.at[1],
+    )
+    bot_dma = pltpu.make_async_copy(
+        board_hbm.at[pl.ds(bot, align), :],
+        scratch.at[pl.ds(align + tile, align), :],
+        sems.at[2],
+    )
+    body_dma.start()
+    top_dma.start()
+    bot_dma.start()
+    body_dma.wait()
+    top_dma.wait()
+    bot_dma.wait()

@@ -33,7 +33,7 @@ from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils import checkpoint as ckpt_mod
 from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready, maybe_profile
 
-ENGINES = ("auto", "dense", "bitpack", "pallas")
+ENGINES = ("auto", "dense", "bitpack", "pallas", "pallas_bitpack")
 MESH_CHOICES = ("none", "1d", "2d")
 
 
@@ -176,6 +176,10 @@ class GolRuntime:
                 from gol_tpu.ops import pallas_step
 
                 return pallas_step.evolve, (), (steps, self.tile_hint)
+            if name == "pallas_bitpack":
+                from gol_tpu.ops import pallas_bitlife
+
+                return pallas_bitlife.evolve, (), (steps, self.tile_hint)
         except ImportError as e:
             raise ValueError(f"engine {name!r} is not available: {e}") from e
         raise AssertionError(name)
