@@ -36,7 +36,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import bitlife
-from gol_tpu.ops.pallas_common import load_tile_with_halo, pick_tile as _pick
+from gol_tpu.ops.pallas_common import (
+    load_tile_with_halo,
+    pick_tile as _pick,
+    validate_tile,
+)
 
 _ALIGN = 8  # TPU tiling for 32-bit data is (8, 128): 8-row DMA alignment
 _LANE = 128  # Mosaic lane tiling for 32-bit data: packed width granularity
@@ -80,11 +84,7 @@ def _kernel(packed_hbm, out_ref, scratch, sems, *, tile: int, height: int):
 def step_pallas_packed(packed_i32: jax.Array, tile: int) -> jax.Array:
     """One torus generation on an int32-bitcast packed board [H, W/32]."""
     height, nw = packed_i32.shape
-    if height % tile != 0 or tile % _ALIGN != 0:
-        raise ValueError(
-            f"tile {tile} must divide board height {height} and be a "
-            f"multiple of {_ALIGN}"
-        )
+    validate_tile(height, tile, _ALIGN)
     grid = height // tile
     return pl.pallas_call(
         functools.partial(_kernel, tile=tile, height=height),

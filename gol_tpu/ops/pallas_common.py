@@ -48,6 +48,15 @@ def pick_tile(
     return align
 
 
+def validate_tile(height: int, tile: int, align: int) -> None:
+    """Reject tiles that don't divide the height or break DMA alignment."""
+    if height % tile != 0 or tile % align != 0:
+        raise ValueError(
+            f"tile {tile} must divide board height {height} and be a "
+            f"multiple of {align}"
+        )
+
+
 def load_tile_with_halo(board_hbm, scratch, sems, i, *, tile, height, align):
     """Fill ``scratch`` with [halo-block | body tile | halo-block] rows.
 

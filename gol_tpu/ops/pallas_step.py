@@ -35,29 +35,6 @@ import jax.numpy as jnp
 from jax import lax
 
 SUM_DTYPE = jnp.uint8  # neighbor counts fit (max 9)
-_VMEM_BUDGET = 8 * 1024 * 1024  # bytes for scratch + out tile, conservative
-
-
-def pick_tile(height: int, width: int, hint: int) -> int:
-    """Largest divisor of ``height`` that is <= hint and fits VMEM.
-
-    The validated replacement for the reference's unchecked
-    ``blocksCount = W*H/threadsCount`` (gol-with-cuda.cu:272, bug B5).
-    """
-    # Per tile-row VMEM: uint8 scratch+out (~2B/cell) plus the widened
-    # int32 compute temporaries (~12B/cell across live values).
-    if height % _ALIGN != 0:
-        raise ValueError(
-            f"pallas engine needs board height divisible by {_ALIGN}, "
-            f"got {height}"
-        )
-    budget = max(_ALIGN, _VMEM_BUDGET // max(1, 16 * width))
-    cap = max(_ALIGN, min(hint, height, budget))
-    for tile in range(cap - cap % _ALIGN, 0, -_ALIGN):
-        if height % tile == 0:
-            return tile
-    return _ALIGN
-
 
 # TPU tiling for 8-bit data is (32, 128): every DMA row offset must be a
 # multiple of 32 or the transfer touches partial tiles (Mosaic's
@@ -66,47 +43,22 @@ def pick_tile(height: int, width: int, hint: int) -> int:
 _ALIGN = 32
 
 
-def _kernel(board_hbm, out_ref, scratch, sems, *, tile: int, height: int):
-    """Scratch layout (all DMA offsets 8-row aligned, as Mosaic requires):
+def pick_tile(height: int, width: int, hint: int) -> int:
+    """Largest divisor of ``height`` that is <= hint and fits VMEM.
 
-    rows [0, 8)              aligned block ending in the top halo row
-    rows [8, 8+tile)         the body tile
-    rows [8+tile, 16+tile)   aligned block starting with the bottom halo row
-
-    Single-row ghost DMAs at odd offsets fail Mosaic's tiling-divisibility
-    proof, so each halo fetches its full 8-row aligned block instead; the
-    extra rows cost a little HBM bandwidth but keep every transfer aligned.
+    The validated replacement for the reference's unchecked
+    ``blocksCount = W*H/threadsCount`` (gol-with-cuda.cu:272, bug B5).
+    Per tile-row VMEM: uint8 scratch+out (~2B/cell) plus the widened
+    int32 compute temporaries (~12B/cell across live values).
     """
-    i = pl.program_id(0)
-    start = pl.multiple_of(i * tile, _ALIGN)
-    top8 = pl.multiple_of(
-        jnp.where(i == 0, height - _ALIGN, start - _ALIGN), _ALIGN
-    )
-    bot8 = pl.multiple_of(
-        jnp.where(start + tile == height, 0, start + tile), _ALIGN
-    )
+    return _pick(height, width, hint, align=_ALIGN, bytes_per_row=16)
 
-    body_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(start, tile), :],
-        scratch.at[pl.ds(_ALIGN, tile), :],
-        sems.at[0],
+
+def _kernel(board_hbm, out_ref, scratch, sems, *, tile: int, height: int):
+    i = pl.program_id(0)
+    load_tile_with_halo(
+        board_hbm, scratch, sems, i, tile=tile, height=height, align=_ALIGN
     )
-    top_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(top8, _ALIGN), :],
-        scratch.at[pl.ds(0, _ALIGN), :],
-        sems.at[1],
-    )
-    bot_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(bot8, _ALIGN), :],
-        scratch.at[pl.ds(_ALIGN + tile, _ALIGN), :],
-        sems.at[2],
-    )
-    body_dma.start()
-    top_dma.start()
-    bot_dma.start()
-    body_dma.wait()
-    top_dma.wait()
-    bot_dma.wait()
 
     # Mosaic vector ops (roll in particular) need i32 lanes; the DMA'd
     # tile stays uint8 in VMEM (1 byte/cell of HBM traffic), compute
@@ -128,15 +80,17 @@ def _kernel(board_hbm, out_ref, scratch, sems, *, tile: int, height: int):
 from jax.experimental import pallas as pl  # noqa: E402
 from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
+from gol_tpu.ops.pallas_common import (  # noqa: E402
+    load_tile_with_halo,
+    pick_tile as _pick,
+    validate_tile,
+)
+
 
 def step_pallas(board: jax.Array, tile: int) -> jax.Array:
     """One torus generation via the fused Pallas kernel."""
     height, width = board.shape
-    if height % tile != 0 or tile % _ALIGN != 0:
-        raise ValueError(
-            f"tile {tile} must divide board height {height} and be a "
-            f"multiple of {_ALIGN}"
-        )
+    validate_tile(height, tile, _ALIGN)
     grid = height // tile
     return pl.pallas_call(
         functools.partial(_kernel, tile=tile, height=height),
