@@ -80,6 +80,11 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
     ext.add_argument("--checkpoint-dir", default=None)
     ext.add_argument("--resume", default=None, metavar="CKPT")
+    # Multi-host (the `mpirun -np N` analog): connect this process to the
+    # job before any device work; the mesh then spans the whole pod.
+    ext.add_argument("--coordinator", default=None, metavar="HOST:PORT")
+    ext.add_argument("--num-processes", type=int, default=None, metavar="N")
+    ext.add_argument("--process-id", type=int, default=None, metavar="I")
     ns = ext.parse_args(list(argv))
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE)
@@ -100,7 +105,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from gol_tpu.models import patterns
     from gol_tpu.models.state import Geometry
+    from gol_tpu.parallel import multihost
     from gol_tpu.runtime import GolRuntime, build_mesh
+
+    try:
+        topo = multihost.init_multihost(
+            coordinator_address=ns.coordinator,
+            num_processes=ns.num_processes,
+            process_id=ns.process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        print(e)
+        return 255
+
+    if topo.process_count > 1 and ns.mesh == "none":
+        # Without a pod-spanning mesh every process would evolve its own
+        # private single-device world and race to write the same dump and
+        # checkpoint files.
+        print(
+            f"multi-host run ({topo.process_count} processes) requires a "
+            "device mesh; pass --mesh 1d or --mesh 2d"
+        )
+        return 255
 
     try:
         geom = Geometry(size=ns.world_size, num_ranks=ns.ranks)
@@ -140,20 +166,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(e)
         return 255
 
-    # Rank 0's report (gol-main.c:121-128) + closing banner (gol-main.c:132).
-    print(report.duration_line())
-    accelerator = "GPU" if ns.compat_banner else "TPU"
-    print(
-        f"This is the Game of Life running in parallel on a {accelerator} "
-        "on multiple ranks."
-    )
+    # Rank 0's report (gol-main.c:121-128) + closing banner (gol-main.c:132);
+    # only the coordinator prints, exactly as only MPI rank 0 did.
+    if topo.is_coordinator:
+        print(report.duration_line())
+        accelerator = "GPU" if ns.compat_banner else "TPU"
+        print(
+            f"This is the Game of Life running in parallel on a {accelerator} "
+            "on multiple ranks."
+        )
 
     if ns.on_off == 1:
-        from gol_tpu.utils import io as gol_io
+        if topo.process_count > 1:
+            # Each host writes the rank files its shards cover (the MPI
+            # every-rank-writes-its-own-block I/O pattern, gol-main.c:135-139).
+            multihost.write_host_dumps(
+                final_state.board, geom.num_ranks, ns.outdir
+            )
+        else:
+            from gol_tpu.utils import io as gol_io
 
-        gol_io.write_world_dumps(
-            np.asarray(final_state.board), geom.num_ranks, ns.outdir
-        )
+            gol_io.write_world_dumps(
+                np.asarray(final_state.board), geom.num_ranks, ns.outdir
+            )
     return 0
 
 

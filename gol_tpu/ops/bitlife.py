@@ -31,13 +31,17 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from gol_tpu.models.state import CELL_DTYPE
 
 WORD = jnp.uint32
 BITS = 32
-_ONE = jnp.uint32(1)
+# A numpy (not jnp) scalar: creating a device array at import time would
+# initialize the XLA backend, which must not happen before a possible
+# jax.distributed.initialize (multi-host CLI path).
+_ONE = np.uint32(1)
 
 
 def packed_width(width: int) -> int:

@@ -101,8 +101,21 @@ def board_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_board(board, mesh: Mesh):
-    """Place a board onto the mesh with the canonical sharding."""
-    return jax.device_put(board, board_sharding(mesh))
+    """Place a board onto the mesh with the canonical sharding.
+
+    Works on multi-host meshes too: when the mesh spans devices this process
+    cannot address, each host contributes its local shards from its (full)
+    host copy of the board via ``make_array_from_callback`` — the standard
+    multi-process placement path (every host runs the same deterministic
+    init, so the copies agree).
+    """
+    sharding = board_sharding(mesh)
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        board_np = np.asarray(board)
+        return jax.make_array_from_callback(
+            board_np.shape, sharding, lambda idx: board_np[idx]
+        )
+    return jax.device_put(board, sharding)
 
 
 def place_private(arr, sharding: NamedSharding):

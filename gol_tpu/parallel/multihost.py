@@ -1,0 +1,260 @@
+"""Multi-host process topology + per-host sharded I/O (the DCN tier).
+
+The reference scales across nodes with ``mpirun`` process spawning
+(``MPI_Init``/``Comm_rank``/``Comm_size``, gol-main.c:58-62), binds each
+process to a local GPU (``cudaSetDevice(myRank % deviceCount)``,
+gol-with-cuda.cu:296), and has every rank write its own output file
+(gol-main.c:64-73,135-139).  The TPU-native equivalent:
+
+- ``jax.distributed.initialize`` connects the processes (coordinator +
+  process id — the ``mpirun`` analog).  After it, ``jax.devices()`` is the
+  *global* device list, so the same ``Mesh`` constructors in
+  :mod:`gol_tpu.parallel.mesh` span the whole pod; ``lax.ppermute`` hops
+  between co-located chips ride ICI and inter-host hops ride DCN, chosen by
+  XLA — no NCCL/MPI plumbing in user code.
+- Per-host I/O: each process writes the ``Rank_<r>_of_<n>.txt`` files whose
+  data already lives in its addressable shards.  No cross-host gather — the
+  exact I/O pattern of the reference, where each rank dumps its local block.
+  The writer assignment is computed *deterministically on every host* from
+  the sharding's device→index map (``Sharding.devices_indices_map``), so no
+  coordination traffic is needed to agree who writes what.
+- Logical ranks whose rows no single host fully owns (e.g. a 2-D mesh whose
+  column axis crosses hosts) fall back to an XLA replication gather
+  (``jit`` identity with fully-replicated out-sharding — a real collective
+  over ICI/DCN), written by process 0.
+
+Tested for real in ``tests/test_multihost.py``: two OS processes, Gloo
+collectives between them, byte-compared against the single-process run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from gol_tpu.utils import io as gol_io
+
+
+@dataclasses.dataclass(frozen=True)
+class HostTopology:
+    """This process's place in the job — the ``myRank``/``numRank`` analog."""
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Process 0 — the reference's reporting rank (gol-main.c:121)."""
+        return self.process_index == 0
+
+
+def topology() -> HostTopology:
+    return HostTopology(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=len(jax.local_devices()),
+        global_device_count=len(jax.devices()),
+    )
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> HostTopology:
+    """Connect this process to the job (the ``MPI_Init`` analog).
+
+    A no-op returning the current (single-process) topology when no
+    multi-host argument is given — so single-host code paths never pay for
+    this.  Partial flag combinations are rejected rather than silently run
+    as a single-process job: a worker that forgot ``--coordinator`` would
+    otherwise evolve its own private world and clobber the real job's
+    output files.  (On cloud TPU pods, calling with no arguments at all and
+    using ``jax.distributed.initialize()``'s environment auto-detection is
+    still available directly.)
+    """
+    given = (coordinator_address, num_processes, process_id)
+    if all(v is None for v in given):
+        return topology()
+    if any(v is None for v in given):
+        raise ValueError(
+            "multi-host init needs coordinator_address, num_processes, and "
+            f"process_id together; got coordinator={coordinator_address!r}, "
+            f"num_processes={num_processes!r}, process_id={process_id!r}"
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return topology()
+
+
+# -- writer planning ---------------------------------------------------------
+
+
+def _rect(idx, shape) -> Tuple[int, int, int, int]:
+    """Decode a shard's index (tuple of slices) into (r0, r1, c0, c1)."""
+    h, w = shape[0], shape[1] if len(shape) > 1 else 1
+    r = idx[0] if len(idx) > 0 else slice(None)
+    c = idx[1] if len(idx) > 1 else slice(None)
+    return (
+        0 if r.start is None else r.start,
+        h if r.stop is None else r.stop,
+        0 if c.start is None else c.start,
+        w if c.stop is None else c.stop,
+    )
+
+
+def _index_rects(
+    sharding, shape: Tuple[int, ...]
+) -> Dict[int, set]:
+    """Per-process set of (r0, r1, c0, c1) global rectangles it can read.
+
+    Replicated shards dedupe via the set; the remaining rectangles are a
+    disjoint partition of the array (regular grid sharding), so coverage
+    checks reduce to area sums.
+    """
+    rects: Dict[int, set] = defaultdict(set)
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        rects[dev.process_index].add(_rect(idx, shape))
+    return rects
+
+
+def plan_rank_writers(
+    sharding, shape: Tuple[int, int], num_ranks: int
+) -> Tuple[Dict[int, int], List[int]]:
+    """Assign each logical rank's dump file to a writer process.
+
+    Returns ``(writers, gather_ranks)``: ``writers[rank] = process`` for
+    every rank some single process fully covers from its addressable shards
+    (lowest such process index wins, so the assignment is identical on all
+    hosts with zero communication); ``gather_ranks`` lists ranks nobody
+    covers alone (they need a collective gather).
+    """
+    h, w = shape
+    if h % num_ranks:
+        raise ValueError(f"global height {h} not divisible by {num_ranks} ranks")
+    s = h // num_ranks
+    rects = _index_rects(sharding, shape)
+    writers: Dict[int, int] = {}
+    gather: List[int] = []
+    for rank in range(num_ranks):
+        lo, hi = rank * s, (rank + 1) * s
+        need = (hi - lo) * w
+        writer = None
+        for proc in sorted(rects):
+            area = sum(
+                max(0, min(r1, hi) - max(r0, lo)) * (c1 - c0)
+                for (r0, r1, c0, c1) in rects[proc]
+            )
+            if area == need:
+                writer = proc
+                break
+        if writer is None:
+            gather.append(rank)
+        else:
+            writers[rank] = writer
+    return writers, gather
+
+
+def _assemble_rank_block(arr, rank: int, block_h: int) -> np.ndarray:
+    """Stitch one rank's rows from this host's addressable shards."""
+    h, w = arr.shape
+    lo = rank * block_h
+    block = np.empty((block_h, w), dtype=arr.dtype)
+    for shard in arr.addressable_shards:
+        r0, r1, c0, c1 = _rect(shard.index, arr.shape)
+        i0, i1 = max(r0, lo), min(r1, lo + block_h)
+        if i0 >= i1:
+            continue
+        data = np.asarray(shard.data)
+        block[i0 - lo : i1 - lo, c0:c1] = data[i0 - r0 : i1 - r0, :]
+    return block
+
+
+def fetch_global(arr) -> np.ndarray:
+    """Full array on every host, via an XLA replication collective.
+
+    ``jit`` identity with a fully-replicated out-sharding makes XLA insert
+    the all-gather (ICI/DCN as the mesh dictates); afterwards every host
+    holds an addressable copy.  Single-process arrays short-circuit to a
+    plain host transfer.
+    """
+    sharding = getattr(arr, "sharding", None)
+    if jax.process_count() == 1 or sharding is None:
+        return np.asarray(arr)
+    if not isinstance(sharding, NamedSharding):
+        raise ValueError(
+            f"fetch_global needs a NamedSharding to replicate over, got "
+            f"{type(sharding).__name__}"
+        )
+    out = NamedSharding(sharding.mesh, PartitionSpec())
+    replicated = jax.jit(lambda x: x, out_shardings=out)(arr)
+    return np.asarray(replicated.addressable_shards[0].data)
+
+
+def write_host_dumps(
+    global_array,
+    num_ranks: int,
+    directory: str = ".",
+    use_native: bool = True,
+    allow_gather: bool = True,
+) -> List[str]:
+    """Write this host's share of the ``Rank_<r>_of_<n>.txt`` dump files.
+
+    The multi-host equivalent of every MPI rank executing
+    gol-main.c:135-139: each process writes exactly the files whose rows it
+    owns, from addressable shards, with no cross-host traffic.  Ranks nobody
+    fully owns (column axis split across hosts) are gathered collectively —
+    *all* processes must keep calling in that case — and written by
+    process 0.  Returns the paths this process wrote.
+    """
+    h, _ = global_array.shape
+    if h % num_ranks:
+        raise ValueError(f"global height {h} not divisible by {num_ranks} ranks")
+    s = h // num_ranks
+    sharding = getattr(global_array, "sharding", None)
+    me = jax.process_index()
+    written: List[str] = []
+    if sharding is None:
+        if me == 0:
+            return gol_io.write_world_dumps(
+                np.asarray(global_array), num_ranks, directory, use_native
+            )
+        return written
+    writers, gather_ranks = plan_rank_writers(
+        sharding, global_array.shape, num_ranks
+    )
+    for rank, proc in writers.items():
+        if proc != me:
+            continue
+        block = _assemble_rank_block(global_array, rank, s)
+        written.append(
+            gol_io.write_rank_file(block, rank, num_ranks, directory, use_native)
+        )
+    if gather_ranks:
+        if not allow_gather:
+            raise ValueError(
+                f"ranks {gather_ranks} are split across hosts; re-shard, or "
+                "pass allow_gather=True to fetch them collectively"
+            )
+        for rank in gather_ranks:
+            # Collective — every process executes the same gather sequence.
+            full = fetch_global(global_array[rank * s : (rank + 1) * s])
+            if me == 0:
+                written.append(
+                    gol_io.write_rank_file(
+                        full, rank, num_ranks, directory, use_native
+                    )
+                )
+    return written

@@ -229,6 +229,26 @@ class GolRuntime:
 
     def _save_snapshot(self, state: GolState) -> None:
         top0, bottom0 = self._halos if self._halos is not None else (None, None)
+        if jax.process_count() > 1:
+            # Multi-host: replicate the board via an XLA all-gather, write
+            # from process 0 only, and fence so no host races ahead into the
+            # next timed chunk while the file is still being written.
+            from jax.experimental import multihost_utils
+
+            from gol_tpu.parallel import multihost
+
+            board_np = multihost.fetch_global(state.board)
+            if jax.process_index() == 0:
+                ckpt_mod.save(
+                    ckpt_mod.checkpoint_path(
+                        self.checkpoint_dir, int(state.generation)
+                    ),
+                    board_np,
+                    int(state.generation),
+                    self.geometry.num_ranks,
+                )
+            multihost_utils.sync_global_devices("gol_checkpoint")
+            return
         ckpt_mod.save(
             ckpt_mod.checkpoint_path(self.checkpoint_dir, int(state.generation)),
             np.asarray(state.board),

@@ -74,6 +74,14 @@ def force_ready(x) -> None:
     # readback alone only proves shard (0,...,0) finished on a sharded
     # array.  Both together cover single- and multi-device cases.
     jax.block_until_ready(x)
+    if jax.process_count() > 1:
+        # Multi-host: element (0,...,0) may not be addressable here.  A
+        # cross-process barrier is the correct fence — and mirrors the
+        # reference's MPI_Barrier before the timing stop (gol-main.c:118).
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("gol_force_ready")
+        return
     for leaf in jax.tree_util.tree_leaves(x):
         if hasattr(leaf, "ndim"):
             leaf[(0,) * leaf.ndim].item()
