@@ -85,6 +85,10 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--coordinator", default=None, metavar="HOST:PORT")
     ext.add_argument("--num-processes", type=int, default=None, metavar="N")
     ext.add_argument("--process-id", type=int, default=None, metavar="I")
+    # Failure detection + elastic recovery: audit the board every K
+    # generations, roll back and replay on corruption (utils/guard.py).
+    ext.add_argument("--guard-every", type=int, default=0, metavar="K")
+    ext.add_argument("--guard-max-restores", type=int, default=3, metavar="N")
     ns = ext.parse_args(list(argv))
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE)
@@ -138,6 +142,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         if ns.iterations < 0:
             raise ValueError(f"iterations must be >= 0, got {ns.iterations}")
+        if ns.guard_every < 0:
+            raise ValueError(
+                f"--guard-every must be >= 0, got {ns.guard_every} "
+                "(0 disables the guard)"
+            )
     except ValueError as e:
         print(e)
         return 255
@@ -154,15 +163,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             shard_mode=ns.shard_mode,
             halo_depth=ns.halo_depth,
         )
-        report, final_state = rt.run(
-            pattern=ns.pattern,
-            iterations=ns.iterations,
-            resume=ns.resume,
-            profile_dir=ns.profile,
-        )
+        guard_report = None
+        if ns.guard_every > 0:
+            from gol_tpu.utils import guard as guard_mod
+
+            if ns.profile:
+                raise ValueError(
+                    "--profile applies to unguarded runs; drop --guard-every"
+                )
+            report, final_state, guard_report = guard_mod.run_guarded(
+                rt,
+                pattern=ns.pattern,
+                iterations=ns.iterations,
+                config=guard_mod.GuardConfig(
+                    check_every=ns.guard_every,
+                    max_restores=ns.guard_max_restores,
+                ),
+                resume=ns.resume,
+            )
+        else:
+            report, final_state = rt.run(
+                pattern=ns.pattern,
+                iterations=ns.iterations,
+                resume=ns.resume,
+                profile_dir=ns.profile,
+            )
     except (ValueError, OSError) as e:
         # Same clean-error convention as the pre-validation path: bad
-        # --resume paths/shapes, unavailable engines, unwritable dirs.
+        # --resume paths/shapes, unavailable engines, unwritable dirs,
+        # corrupt snapshots, exhausted guard restore budgets (both are
+        # ValueError subclasses).
         print(e)
         return 255
 
@@ -170,6 +200,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # only the coordinator prints, exactly as only MPI rank 0 did.
     if topo.is_coordinator:
         print(report.duration_line())
+        if guard_report is not None:
+            print(guard_report.summary_line())
         accelerator = "GPU" if ns.compat_banner else "TPU"
         print(
             f"This is the Game of Life running in parallel on a {accelerator} "

@@ -81,14 +81,18 @@ def init_multihost(
     using ``jax.distributed.initialize()``'s environment auto-detection is
     still available directly.)
     """
-    given = (coordinator_address, num_processes, process_id)
-    if all(v is None for v in given):
+    trio = (coordinator_address, num_processes, process_id)
+    if all(v is None for v in trio) and local_device_ids is None:
         return topology()
-    if any(v is None for v in given):
+    if any(v is None for v in trio):
+        # Includes local_device_ids given alone: device pinning only means
+        # anything inside a multi-process job, so dropping it silently
+        # (process grabs every local device) would betray the caller.
         raise ValueError(
             "multi-host init needs coordinator_address, num_processes, and "
             f"process_id together; got coordinator={coordinator_address!r}, "
-            f"num_processes={num_processes!r}, process_id={process_id!r}"
+            f"num_processes={num_processes!r}, process_id={process_id!r}, "
+            f"local_device_ids={local_device_ids!r}"
         )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -227,8 +227,25 @@ class GolRuntime:
             self._halos = engine_mod.frozen_halos(board, self.geometry.num_ranks)
         return GolState.create(board, 0)
 
-    def _save_snapshot(self, state: GolState) -> None:
+    def _save_snapshot(
+        self, state: GolState, board_np: Optional[np.ndarray] = None
+    ) -> None:
+        """Persist a snapshot; callers that already hold a host copy of the
+        board (the guarded loop's last-good buffer) pass it via ``board_np``
+        to skip a redundant device fetch / multi-host all-gather."""
         top0, bottom0 = self._halos if self._halos is not None else (None, None)
+        if board_np is not None:
+            ckpt_mod.save(
+                ckpt_mod.checkpoint_path(
+                    self.checkpoint_dir, int(state.generation)
+                ),
+                board_np,
+                int(state.generation),
+                self.geometry.num_ranks,
+                top0=None if top0 is None else np.asarray(top0),
+                bottom0=None if bottom0 is None else np.asarray(bottom0),
+            )
+            return
         if jax.process_count() > 1:
             # Multi-host: replicate the board via an XLA all-gather, write
             # from process 0 only, and fence so no host races ahead into the
@@ -258,6 +275,43 @@ class GolRuntime:
             bottom0=None if bottom0 is None else np.asarray(bottom0),
         )
 
+    # -- shared compile machinery -------------------------------------------
+    def chunk_schedule(self, iterations: int, chunk: int) -> list:
+        """Full chunks of ``chunk`` generations plus one tail."""
+        chunk = min(chunk, iterations) if iterations else 0
+        schedule = []
+        remaining = iterations
+        while remaining > 0:
+            take = min(chunk, remaining)
+            schedule.append(take)
+            remaining -= take
+        return schedule
+
+    def compile_evolvers(self, board, schedule) -> dict:
+        """AOT-compile one evolver per distinct chunk size in ``schedule``.
+
+        Lowers from a ShapeDtypeStruct (no execution, no throwaway board) so
+        callers' timed loops measure steady-state execution only; also warms
+        the ``force_ready`` readback.  Returns ``{take: (compiled, dynamic)}``
+        where the full call is ``compiled(board, *dynamic)``.  Shared by
+        :meth:`run` and the guarded loop (:func:`gol_tpu.utils.guard.
+        run_guarded`), so engine dispatch can never diverge between them.
+        """
+        if self.mesh is not None:
+            spec = jax.ShapeDtypeStruct(
+                board.shape,
+                board.dtype,
+                sharding=mesh_mod.board_sharding(self.mesh),
+            )
+        else:
+            spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
+        evolvers = {}
+        for take in set(schedule):
+            fn, dynamic, static = self._evolve_fn(take)
+            evolvers[take] = (fn.lower(spec, *dynamic, *static).compile(), dynamic)
+        force_ready(board)
+        return evolvers
+
     # -- main entry ---------------------------------------------------------
     def run(
         self,
@@ -272,38 +326,16 @@ class GolRuntime:
             board = state.board
 
         # Chunk schedule: full chunks of `checkpoint_every` plus one tail.
-        chunk = (
-            min(self.checkpoint_every, iterations)
-            if self.checkpoint_every > 0
-            else iterations
+        schedule = self.chunk_schedule(
+            iterations,
+            self.checkpoint_every if self.checkpoint_every > 0 else iterations,
         )
-        schedule = []
-        remaining = iterations
-        while remaining > 0:
-            take = min(chunk, remaining)
-            schedule.append(take)
-            remaining -= take
 
         if self.mesh is not None:
             board = mesh_mod.shard_board(board, self.mesh)
 
         with sw.phase("compile"):
-            evolvers = {}
-            if self.mesh is not None:
-                spec = jax.ShapeDtypeStruct(
-                    board.shape, board.dtype, sharding=mesh_mod.board_sharding(self.mesh)
-                )
-            else:
-                spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
-            for take in set(schedule):
-                fn, dynamic, static = self._evolve_fn(take)
-                # AOT-compile (no execution, no throwaway board) so the timed
-                # loop measures steady-state execution only.
-                compiled = fn.lower(spec, *dynamic, *static).compile()
-                evolvers[take] = (compiled, dynamic)
-            # Warm the force_ready gather too — its first call traces and
-            # compiles a getitem; that belongs in this phase, not "total".
-            force_ready(board)
+            evolvers = self.compile_evolvers(board, schedule)
 
         with maybe_profile(profile_dir):
             for take in schedule:

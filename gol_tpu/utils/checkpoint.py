@@ -30,6 +30,16 @@ class Snapshot:
     bottom0: Optional[np.ndarray] = None
 
 
+class CorruptSnapshotError(ValueError):
+    """The snapshot's stored fingerprint does not match its board."""
+
+
+def _halo_plane(top0: np.ndarray, bottom0: np.ndarray) -> np.ndarray:
+    """Canonical 2-row plane for fingerprinting the frozen halo pair
+    (halos may arrive as (W,) or (1, W))."""
+    return np.stack([np.ravel(top0), np.ravel(bottom0)])
+
+
 def checkpoint_path(directory: str, generation: int) -> str:
     return os.path.join(directory, f"ckpt_{generation:012d}{CKPT_SUFFIX}")
 
@@ -42,15 +52,31 @@ def save(
     top0: Optional[np.ndarray] = None,
     bottom0: Optional[np.ndarray] = None,
 ) -> str:
+    """Write a snapshot atomically, stamped with a content fingerprint.
+
+    The fingerprint (:func:`gol_tpu.utils.guard.fingerprint_np`) makes the
+    file tamper-evident: :func:`load` recomputes and verifies it, so a
+    corrupted snapshot fails loudly instead of silently resuming a wrong
+    world (failure-detection tier 2, SURVEY §5's missing subsystem).
+    """
+    from gol_tpu.utils.guard import fingerprint_np
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    board = np.asarray(board, np.uint8)
     arrays = dict(
-        board=np.asarray(board, np.uint8),
+        board=board,
         generation=np.int64(generation),
         num_ranks=np.int64(num_ranks),
+        fingerprint=np.uint32(fingerprint_np(board)),
     )
     if top0 is not None:
         arrays["top0"] = np.asarray(top0, np.uint8)
         arrays["bottom0"] = np.asarray(bottom0, np.uint8)
+        # The frozen halos evolve the resumed world every generation, so
+        # they need the same tamper evidence as the board itself.
+        arrays["halo_fingerprint"] = np.uint32(
+            fingerprint_np(_halo_plane(arrays["top0"], arrays["bottom0"]))
+        )
     tmp = path + ".tmp.npz"
     np.savez_compressed(tmp, **arrays)
     os.replace(tmp, path)
@@ -58,13 +84,41 @@ def save(
 
 
 def load(path: str) -> Snapshot:
+    """Read a snapshot, verifying its fingerprint when present.
+
+    (Snapshots written before fingerprints existed load without the check.)
+    """
     with np.load(path) as data:
+        board = data["board"].astype(np.uint8)
+        top0 = data["top0"].astype(np.uint8) if "top0" in data else None
+        bottom0 = (
+            data["bottom0"].astype(np.uint8) if "bottom0" in data else None
+        )
+        if "fingerprint" in data:
+            from gol_tpu.utils.guard import fingerprint_np
+
+            stored = int(data["fingerprint"])
+            actual = fingerprint_np(board)
+            if stored != actual:
+                raise CorruptSnapshotError(
+                    f"{path}: stored fingerprint {stored:#010x} != computed "
+                    f"{actual:#010x}; the snapshot is corrupt"
+                )
+            if "halo_fingerprint" in data:
+                stored_h = int(data["halo_fingerprint"])
+                actual_h = fingerprint_np(_halo_plane(top0, bottom0))
+                if stored_h != actual_h:
+                    raise CorruptSnapshotError(
+                        f"{path}: halo fingerprint {stored_h:#010x} != "
+                        f"computed {actual_h:#010x}; the frozen halos are "
+                        "corrupt"
+                    )
         return Snapshot(
-            board=data["board"].astype(np.uint8),
+            board=board,
             generation=int(data["generation"]),
             num_ranks=int(data["num_ranks"]),
-            top0=data["top0"].astype(np.uint8) if "top0" in data else None,
-            bottom0=data["bottom0"].astype(np.uint8) if "bottom0" in data else None,
+            top0=top0,
+            bottom0=bottom0,
         )
 
 

@@ -1,0 +1,280 @@
+"""Failure detection + elastic recovery — a capability addition (SURVEY §5).
+
+The reference has no failure handling at all: errors exit the process, and
+silent data corruption (SDC — a real failure mode on large accelerator
+fleets) would go entirely unnoticed because nothing ever validates the
+evolving board.  This module adds the three standard tiers:
+
+1. **Detection** — a cheap on-device audit of the live board.  The live
+   detector is the cell-value invariant: every cell must be 0/1 (the B3/S23
+   rule can only produce 0/1, so any other value proves corruption in
+   place).  Alongside it the audit records telemetry that external harness
+   checks can compare — the population count and a deterministic content
+   fingerprint (order-independent mod-2^32 mixing, so XLA reduce order
+   cannot change it).  The fingerprint has no in-run oracle (the evolved
+   board's correct hash isn't known in advance); its job is cross-run /
+   cross-replica determinism comparison and checkpoint integrity (tier 2).
+   Note the limit this implies: an in-range flip (1->0 / 0->1) passes the
+   live invariant and is only catchable by comparing fingerprints against
+   a redundant run or replica.  The audit is one small jitted reduce fused
+   over the board — negligible next to a generation chunk — and its scalars
+   are replicated across hosts, so every process takes the same recovery
+   decision with no extra communication.
+2. **Integrity** — the same fingerprint, computed bit-identically in NumPy,
+   rides inside checkpoint files and is re-verified on load, turning the
+   write-only dump culture of the reference into tamper-evident snapshots.
+3. **Elastic recovery** — :func:`run_guarded` evolves in audit-sized chunks,
+   keeps the last known-good state on the host, and on a failed audit rolls
+   back and replays instead of dying; a bounded restore budget converts
+   persistent faults into a clean :class:`GuardError`.
+
+Fault injection for tests/drills is a first-class hook (``fault_hook``),
+because a recovery path that has never fired is a recovery path that does
+not work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gol_tpu.models.state import GolState
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready
+
+# Odd constants -> invertible multiplies mod 2^32; distinct per axis so
+# transposed/rolled boards fingerprint differently.
+_ROW_MIX = np.uint32(0x9E3779B1)
+_COL_MIX = np.uint32(0x85EBCA77)
+_VAL_MIX = np.uint32(0xC2B2AE35)
+
+
+def fingerprint_np(board: np.ndarray) -> int:
+    """Reference NumPy fingerprint (mod 2^32), bit-identical to the device one.
+
+    Each cell contributes ``value * (1 + mix(i) * mix(j))``; contributions
+    are summed mod 2^32.  Addition mod 2^32 is associative and commutative,
+    so any reduction order — NumPy's, XLA's on one chip, or a cross-host
+    psum — produces the same 32-bit result.
+    """
+    board = np.asarray(board)
+    h, w = board.shape
+    total = np.uint32(0)
+    with np.errstate(over="ignore"):
+        cj = (np.arange(w, dtype=np.uint32) * _COL_MIX + np.uint32(1))[None, :]
+        # Row-chunked so the uint32 weight plane never exceeds ~64 MB even
+        # for 65536-wide boards (the device version is fused by XLA and
+        # never materializes weights at all).
+        step = max(1, (16 << 20) // max(w, 1))
+        for r0 in range(0, h, step):
+            r1 = min(h, r0 + step)
+            ri = (np.arange(r0, r1, dtype=np.uint32) * _ROW_MIX + np.uint32(1))[
+                :, None
+            ]
+            weights = np.uint32(1) + ri * cj * _VAL_MIX
+            total = total + np.sum(
+                board[r0:r1].astype(np.uint32) * weights, dtype=np.uint32
+            )
+    return int(total)
+
+
+def _audit_device(board: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(max_cell, population, fingerprint) — one fused on-device reduce."""
+    h, w = board.shape
+    ri = (jnp.arange(h, dtype=jnp.uint32) * _ROW_MIX + jnp.uint32(1))[:, None]
+    cj = (jnp.arange(w, dtype=jnp.uint32) * _COL_MIX + jnp.uint32(1))[None, :]
+    weights = jnp.uint32(1) + ri * cj * _VAL_MIX
+    cells = board.astype(jnp.uint32)
+    return (
+        jnp.max(board),
+        jnp.sum(cells, dtype=jnp.uint32),
+        jnp.sum(cells * weights, dtype=jnp.uint32),
+    )
+
+
+_audit_jit = jax.jit(_audit_device)
+
+
+@dataclasses.dataclass(frozen=True)
+class Audit:
+    """One detection pass over the live board."""
+
+    generation: int
+    ok: bool
+    max_cell: int
+    population: int
+    fingerprint: int
+
+
+def audit_board(board, generation: int = 0) -> Audit:
+    """Run the on-device detector; scalars replicate to every host."""
+    max_cell, pop, fp = _audit_jit(board)
+    max_cell = int(max_cell)
+    return Audit(
+        generation=generation,
+        ok=max_cell <= 1,
+        max_cell=max_cell,
+        population=int(pop),
+        fingerprint=int(fp),
+    )
+
+
+def inject_bitflip(board, row: int, col: int, value: int = 0xA5):
+    """Fault-injection drill: corrupt one cell (device-side functional update).
+
+    ``value`` defaults to an out-of-range byte — the signature of a real
+    bit-flip in uint8 storage, exactly what the invariant detects.
+    """
+    return board.at[row, col].set(jnp.uint8(value))
+
+
+class GuardError(ValueError):
+    """Raised when the restore budget is exhausted (persistent fault).
+
+    A ``ValueError`` subclass so the CLI's existing clean-error handling
+    catches it (same convention as ``CorruptSnapshotError``).
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    check_every: int  # generations between audits (the chunk size)
+    max_restores: int = 3
+    # Test/drill hook: (board, generation_after_chunk) -> board, applied
+    # after each chunk *before* the audit, simulating in-flight corruption.
+    fault_hook: Optional[Callable[[jax.Array, int], jax.Array]] = None
+
+    def __post_init__(self) -> None:
+        if self.check_every < 1:
+            raise ValueError(f"check_every must be >= 1, got {self.check_every}")
+        if self.max_restores < 0:
+            raise ValueError(
+                f"max_restores must be >= 0, got {self.max_restores}"
+            )
+
+
+@dataclasses.dataclass
+class GuardReport:
+    """What the guard saw: every audit, plus the recovery ledger."""
+
+    audits: List[Audit] = dataclasses.field(default_factory=list)
+    failures: int = 0
+    restores: int = 0
+
+    @property
+    def checks(self) -> int:
+        return len(self.audits)
+
+    def summary_line(self) -> str:
+        return (
+            f"GUARD          : {self.checks} checks, {self.failures} failures, "
+            f"{self.restores} restores"
+        )
+
+
+def _fetch_host(board) -> np.ndarray:
+    """Host copy of a (possibly multi-host sharded) board."""
+    from gol_tpu.parallel import multihost
+
+    # fetch_global short-circuits to a plain host transfer when
+    # single-process, and all-gathers across hosts otherwise.
+    return multihost.fetch_global(board)
+
+
+def run_guarded(
+    rt,
+    pattern: int,
+    iterations: int,
+    config: GuardConfig,
+    resume: Optional[str] = None,
+) -> Tuple[RunReport, GolState, GuardReport]:
+    """Evolve with failure detection and rollback-replay recovery.
+
+    Drop-in sibling of :meth:`gol_tpu.runtime.GolRuntime.run`: same engine
+    dispatch and AOT compile phase, but the generation loop is chopped into
+    ``config.check_every``-sized chunks, each followed by an on-device
+    audit.  A failed audit rolls the board back to the last good host copy
+    and replays the chunk; more than ``config.max_restores`` consecutive
+    failures raises :class:`GuardError` (the fault is persistent — retrying
+    cannot help).  With no faults the result is identical to ``rt.run`` —
+    pinned by tests against the unguarded path.
+
+    When the runtime also has ``checkpoint_every`` set, a verified snapshot
+    is persisted at the first audit boundary at or after each interval, so
+    a run killed past its restore budget can still be resumed on fresh
+    hardware from the last audited-good state (only audited boards are ever
+    written — a snapshot can't capture corruption the guard would catch).
+    """
+    sw = Stopwatch()
+    guard = GuardReport()
+    with sw.phase("init"):
+        state = rt.initial_state(pattern, resume)
+        board = state.board
+        if rt.mesh is not None:
+            board = mesh_mod.shard_board(board, rt.mesh)
+
+    schedule: List[int] = rt.chunk_schedule(iterations, config.check_every)
+
+    with sw.phase("compile"):
+        evolvers = rt.compile_evolvers(board, schedule)
+
+    def _place(board_np: np.ndarray):
+        # shard_board/device_put take host numpy directly — no intermediate
+        # local device copy.
+        if rt.mesh is not None:
+            return mesh_mod.shard_board(board_np, rt.mesh)
+        return jax.device_put(board_np)
+
+    generation = int(state.generation)
+    last_good = (_fetch_host(board), generation)
+    next_ckpt = (
+        generation + rt.checkpoint_every if rt.checkpoint_every > 0 else None
+    )
+    i = 0
+    restores_this_chunk = 0
+    while i < len(schedule):
+        take = schedule[i]
+        compiled, dynamic = evolvers[take]
+        with sw.phase("total"):
+            candidate = compiled(board, *dynamic)
+            force_ready(candidate)
+        if config.fault_hook is not None:
+            candidate = config.fault_hook(candidate, generation + take)
+        with sw.phase("audit"):
+            audit = audit_board(candidate, generation + take)
+            guard.audits.append(audit)
+        if not audit.ok:
+            guard.failures += 1
+            restores_this_chunk += 1
+            if restores_this_chunk > config.max_restores:
+                raise GuardError(
+                    f"audit failed at generation {audit.generation} "
+                    f"(max cell {audit.max_cell}) and the restore budget "
+                    f"({config.max_restores}) is exhausted — persistent fault"
+                )
+            guard.restores += 1
+            with sw.phase("restore"):
+                board = _place(last_good[0])
+                generation = last_good[1]
+            continue  # replay the same chunk
+        restores_this_chunk = 0
+        board = candidate
+        generation += take
+        with sw.phase("snapshot"):
+            last_good = (_fetch_host(board), generation)
+        if next_ckpt is not None and generation >= next_ckpt:
+            with sw.phase("checkpoint"):
+                # last_good[0] is this exact board, already on the host —
+                # no second fetch/all-gather.
+                rt._save_snapshot(
+                    GolState.create(board, generation), board_np=last_good[0]
+                )
+            next_ckpt = generation + rt.checkpoint_every
+        i += 1
+
+    report = sw.report(rt.geometry.cell_updates(iterations))
+    return report, GolState.create(board, generation), guard

@@ -1,0 +1,298 @@
+"""Failure-detection + elastic-recovery tests (utils/guard.py).
+
+The recovery path is exercised for real via fault injection — corrupted
+boards must be detected, rolled back, and replayed to the exact result an
+unfaulted run produces.  Snapshot integrity (fingerprint verification on
+load) is drilled by tampering with a written checkpoint.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.models.state import Geometry
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.utils import checkpoint as ckpt_mod
+from gol_tpu.utils import guard
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _rand_board(h, w, seed=0):
+    return np.random.default_rng(seed).integers(0, 2, (h, w), dtype=np.uint8)
+
+
+# -- detection ---------------------------------------------------------------
+
+
+def test_fingerprint_device_matches_numpy():
+    board = _rand_board(37, 53, seed=1)
+    audit = guard.audit_board(jnp.asarray(board))
+    assert audit.fingerprint == guard.fingerprint_np(board)
+    assert audit.ok
+    assert audit.population == int(board.sum())
+    assert audit.max_cell == int(board.max())
+
+
+def test_fingerprint_sensitive_to_any_cell_flip():
+    board = _rand_board(16, 16, seed=2)
+    base = guard.fingerprint_np(board)
+    for (i, j) in [(0, 0), (7, 3), (15, 15)]:
+        flipped = board.copy()
+        flipped[i, j] ^= 1
+        assert guard.fingerprint_np(flipped) != base
+
+
+def test_fingerprint_position_sensitive():
+    a = np.zeros((8, 8), np.uint8)
+    b = np.zeros((8, 8), np.uint8)
+    a[1, 2] = 1
+    b[2, 1] = 1
+    assert guard.fingerprint_np(a) != guard.fingerprint_np(b)
+
+
+def test_fingerprint_chunking_invariant():
+    # The row-chunked loop must agree with a one-shot computation.
+    board = _rand_board(300, 70, seed=3)
+    whole = guard.fingerprint_np(board)
+    ri = (np.arange(300, dtype=np.uint32) * np.uint32(0x9E3779B1) + 1)[:, None]
+    cj = (np.arange(70, dtype=np.uint32) * np.uint32(0x85EBCA77) + 1)[None, :]
+    with np.errstate(over="ignore"):
+        w = np.uint32(1) + ri * cj * np.uint32(0xC2B2AE35)
+        ref = int(np.sum(board.astype(np.uint32) * w, dtype=np.uint32))
+    assert whole == ref
+
+
+def test_audit_detects_out_of_range_cell():
+    board = jnp.asarray(_rand_board(16, 16, seed=4))
+    bad = guard.inject_bitflip(board, 3, 5)
+    audit = guard.audit_board(bad, generation=7)
+    assert not audit.ok
+    assert audit.max_cell == 0xA5
+    assert audit.generation == 7
+
+
+def test_audit_on_sharded_board():
+    mesh = mesh_mod.make_mesh_2d()
+    board = _rand_board(32, 16, seed=5)
+    sharded = jax.device_put(board, mesh_mod.board_sharding(mesh))
+    audit = guard.audit_board(sharded)
+    assert audit.fingerprint == guard.fingerprint_np(board)
+
+
+# -- elastic recovery --------------------------------------------------------
+
+
+def _run_plain(geom, pattern, iterations, **kw):
+    rt = GolRuntime(geometry=geom, **kw)
+    _, state = rt.run(pattern=pattern, iterations=iterations)
+    return np.asarray(state.board)
+
+
+@pytest.mark.parametrize("iterations,check_every", [(10, 3), (8, 8), (5, 1)])
+def test_guarded_no_fault_matches_unguarded(iterations, check_every):
+    geom = Geometry(size=16, num_ranks=2)
+    rt = GolRuntime(geometry=geom)
+    report, state, greport = guard.run_guarded(
+        rt, 4, iterations, guard.GuardConfig(check_every=check_every)
+    )
+    expected = _run_plain(geom, 4, iterations)
+    np.testing.assert_array_equal(np.asarray(state.board), expected)
+    assert greport.failures == 0
+    assert greport.restores == 0
+    assert greport.checks == -(-iterations // check_every)
+    assert int(state.generation) == iterations
+    assert report.cell_updates == geom.cell_updates(iterations)
+
+
+def test_transient_fault_detected_and_recovered():
+    geom = Geometry(size=16, num_ranks=2)
+    fired = []
+
+    def fault_once(board, generation):
+        if generation == 6 and not fired:
+            fired.append(generation)
+            return guard.inject_bitflip(board, 2, 2)
+        return board
+
+    rt = GolRuntime(geometry=geom)
+    _, state, greport = guard.run_guarded(
+        rt, 4, 10, guard.GuardConfig(check_every=3, fault_hook=fault_once)
+    )
+    # Replay after rollback converges to the exact unfaulted result.
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 10)
+    )
+    assert greport.failures == 1
+    assert greport.restores == 1
+    assert fired == [6]
+
+
+def test_persistent_fault_exhausts_budget():
+    geom = Geometry(size=16, num_ranks=1)
+
+    def always_corrupt(board, generation):
+        return guard.inject_bitflip(board, 0, 0)
+
+    rt = GolRuntime(geometry=geom)
+    with pytest.raises(guard.GuardError, match="restore budget"):
+        guard.run_guarded(
+            rt,
+            4,
+            6,
+            guard.GuardConfig(
+                check_every=2, max_restores=2, fault_hook=always_corrupt
+            ),
+        )
+
+
+def test_guarded_sharded_run_matches_unguarded():
+    geom = Geometry(size=16, num_ranks=4)
+    mesh = mesh_mod.make_mesh_1d()
+    rt = GolRuntime(geometry=geom, mesh=mesh)
+    _, state, greport = guard.run_guarded(
+        rt, 4, 6, guard.GuardConfig(check_every=2)
+    )
+    expected = _run_plain(geom, 4, 6)
+    np.testing.assert_array_equal(np.asarray(state.board), expected)
+    assert greport.failures == 0
+
+
+def test_guarded_sharded_recovery():
+    geom = Geometry(size=16, num_ranks=4)
+    mesh = mesh_mod.make_mesh_1d()
+    fired = []
+
+    def fault_once(board, generation):
+        if generation == 4 and not fired:
+            fired.append(generation)
+            return guard.inject_bitflip(board, 10, 3)
+        return board
+
+    rt = GolRuntime(geometry=geom, mesh=mesh)
+    _, state, greport = guard.run_guarded(
+        rt, 4, 8, guard.GuardConfig(check_every=4, fault_hook=fault_once)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 8)
+    )
+    assert greport.restores == 1
+    assert fired == [4]
+
+
+def test_guard_config_validation():
+    with pytest.raises(ValueError, match="check_every"):
+        guard.GuardConfig(check_every=0)
+    with pytest.raises(ValueError, match="max_restores"):
+        guard.GuardConfig(check_every=1, max_restores=-1)
+
+
+# -- snapshot integrity ------------------------------------------------------
+
+
+def test_checkpoint_fingerprint_roundtrip(tmp_path):
+    board = _rand_board(16, 8, seed=6)
+    path = ckpt_mod.save(str(tmp_path / "a.gol.npz"), board, 12, 2)
+    snap = ckpt_mod.load(path)
+    np.testing.assert_array_equal(snap.board, board)
+    assert snap.generation == 12
+
+
+def test_tampered_checkpoint_rejected(tmp_path):
+    board = _rand_board(16, 8, seed=7)
+    path = ckpt_mod.save(str(tmp_path / "b.gol.npz"), board, 5, 1)
+    # Tamper: rewrite with a flipped cell but the ORIGINAL fingerprint.
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays["board"] = arrays["board"].copy()
+    arrays["board"][0, 0] ^= 1
+    np.savez_compressed(path, **arrays)
+    with pytest.raises(ckpt_mod.CorruptSnapshotError, match="fingerprint"):
+        ckpt_mod.load(path)
+
+
+def test_tampered_halo_rejected(tmp_path):
+    board = _rand_board(16, 8, seed=9)
+    halo = _rand_board(2, 8, seed=10)
+    path = ckpt_mod.save(
+        str(tmp_path / "h.gol.npz"), board, 5, 1, top0=halo[0], bottom0=halo[1]
+    )
+    with np.load(path) as data:
+        arrays = {k: data[k] for k in data.files}
+    arrays["top0"] = arrays["top0"].copy()
+    arrays["top0"][0] ^= 1
+    np.savez_compressed(path, **arrays)
+    with pytest.raises(ckpt_mod.CorruptSnapshotError, match="halo"):
+        ckpt_mod.load(path)
+
+
+def test_legacy_checkpoint_without_fingerprint_loads(tmp_path):
+    board = _rand_board(8, 8, seed=8)
+    path = str(tmp_path / "legacy.gol.npz")
+    np.savez_compressed(
+        path,
+        board=board,
+        generation=np.int64(3),
+        num_ranks=np.int64(1),
+    )
+    snap = ckpt_mod.load(path)
+    assert snap.generation == 3
+
+
+def test_guarded_run_writes_checkpoints(tmp_path):
+    geom = Geometry(size=16, num_ranks=2)
+    ckdir = str(tmp_path / "ck")
+    rt = GolRuntime(geometry=geom, checkpoint_every=4, checkpoint_dir=ckdir)
+    _, state, _ = guard.run_guarded(rt, 4, 10, guard.GuardConfig(check_every=3))
+    # Audit boundaries are 3,6,9,10; the first >=4 is 6, then the next
+    # interval target is 6+4=10 -> snapshots at generations 6 and 10.
+    paths = [ckpt_mod.checkpoint_path(ckdir, g) for g in (6, 10)]
+    for p in paths:
+        snap = ckpt_mod.load(p)  # load verifies the fingerprint
+        assert snap.num_ranks == 2
+    # The last snapshot (generation 10) IS the final audited state, and a
+    # resumed runtime accepts it.
+    np.testing.assert_array_equal(
+        ckpt_mod.load(paths[-1]).board, np.asarray(state.board)
+    )
+    rt2 = GolRuntime(geometry=geom)
+    _, state2 = rt2.run(pattern=4, iterations=0, resume=paths[-1])
+    assert int(state2.generation) == 10
+
+
+# -- CLI surface -------------------------------------------------------------
+
+
+def test_cli_guarded_run(tmp_path, capsys, monkeypatch):
+    from gol_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(["4", "16", "6", "64", "1", "--guard-every", "2"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "TOTAL DURATION" in out
+    assert "GUARD          : 3 checks, 0 failures, 0 restores" in out
+    assert (tmp_path / "Rank_0_of_1.txt").exists()
+
+
+def test_cli_rejects_negative_guard_every(capsys):
+    from gol_tpu import cli
+
+    rc = cli.main(["4", "16", "2", "64", "0", "--guard-every", "-5"])
+    assert rc == 255
+    assert "--guard-every" in capsys.readouterr().out
+
+
+def test_cli_guard_rejects_profile(capsys):
+    from gol_tpu import cli
+
+    rc = cli.main(
+        ["4", "16", "2", "64", "0", "--guard-every", "1", "--profile", "/tmp/x"]
+    )
+    assert rc == 255
+    assert "unguarded" in capsys.readouterr().out

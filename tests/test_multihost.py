@@ -57,6 +57,7 @@ def test_init_multihost_noop():
         dict(process_id=1),
         dict(coordinator_address="localhost:1", num_processes=2),
         dict(num_processes=2, process_id=0),
+        dict(local_device_ids=[0]),
     ],
 )
 def test_init_multihost_partial_flags_rejected(kwargs):
