@@ -20,7 +20,7 @@ alignment and the kernel's VMEM bytes-per-board-row:
 
 from __future__ import annotations
 
-import jax.numpy as jnp
+import jax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -57,41 +57,48 @@ def validate_tile(height: int, tile: int, align: int) -> None:
         )
 
 
-def load_tile_with_halo(board_hbm, scratch, sems, i, *, tile, height, align):
-    """Fill ``scratch`` with [halo-block | body tile | halo-block] rows.
+def load_tile_with_halo(
+    board_hbm, scratch, sems, i, *, tile, height, align, pad=None
+):
+    """Fill ``scratch`` with [halo-pad | body tile | halo-pad] rows.
 
-    Scratch layout (all DMA offsets ``align``-row aligned):
+    ``pad`` (default ``align``) is the halo depth in rows, a multiple of
+    ``align`` and at most ``tile`` — deeper pads feed temporally-blocked
+    kernels that run several generations per VMEM residency.  Scratch
+    layout (all DMA offsets ``align``-row aligned):
 
-    - rows ``[0, align)``: aligned block *ending* in the top halo row
-      (``height - align`` for grid step 0 — the row torus wrap);
-    - rows ``[align, align+tile)``: the body tile;
-    - rows ``[align+tile, align+tile+align)``: aligned block *starting*
-      with the bottom halo row (0 for the last grid step).
+    - rows ``[0, pad)``: the block *ending* in the top halo row — source
+      rows ``(start - pad) mod height`` (the torus row wrap; contiguous
+      because ``pad <= tile``);
+    - rows ``[pad, pad+tile)``: the body tile;
+    - rows ``[pad+tile, pad+tile+pad)``: the block *starting* with the
+      bottom halo row (``(start + tile) mod height``).
 
-    The caller reads the stencil window as
-    ``scratch[align-1 : align+tile+1]``.  Blocks until all three DMAs land.
+    A k-generation caller reads the step-``j`` stencil window as
+    ``scratch[pad-(k-j) : pad+tile+(k-j)]``.  Blocks until all three DMAs
+    land.
     """
+    if pad is None:
+        pad = align
     start = pl.multiple_of(i * tile, align)
     top = pl.multiple_of(
-        jnp.where(i == 0, height - align, start - align), align
+        jax.lax.rem(start - pad + height, height), align
     )
-    bot = pl.multiple_of(
-        jnp.where(start + tile == height, 0, start + tile), align
-    )
+    bot = pl.multiple_of(jax.lax.rem(start + tile, height), align)
 
     body_dma = pltpu.make_async_copy(
         board_hbm.at[pl.ds(start, tile), :],
-        scratch.at[pl.ds(align, tile), :],
+        scratch.at[pl.ds(pad, tile), :],
         sems.at[0],
     )
     top_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(top, align), :],
-        scratch.at[pl.ds(0, align), :],
+        board_hbm.at[pl.ds(top, pad), :],
+        scratch.at[pl.ds(0, pad), :],
         sems.at[1],
     )
     bot_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(bot, align), :],
-        scratch.at[pl.ds(align + tile, align), :],
+        board_hbm.at[pl.ds(bot, pad), :],
+        scratch.at[pl.ds(pad + tile, pad), :],
         sems.at[2],
     )
     body_dma.start()

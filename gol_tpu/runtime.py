@@ -228,13 +228,31 @@ class GolRuntime:
         return GolState.create(board, 0)
 
     def _save_snapshot(
-        self, state: GolState, board_np: Optional[np.ndarray] = None
+        self,
+        state: GolState,
+        board_np: Optional[np.ndarray] = None,
+        fingerprint: Optional[int] = None,
     ) -> None:
-        """Persist a snapshot; callers that already hold a host copy of the
-        board (the guarded loop's last-good buffer) pass it via ``board_np``
-        to skip a redundant device fetch / multi-host all-gather."""
+        """Persist a snapshot.
+
+        Callers that already hold a host copy of the board (the guarded
+        loop's last-good buffer) pass it via ``board_np`` to skip a
+        redundant device fetch / multi-host all-gather; likewise a
+        device-computed ``fingerprint`` (the guard audit's) skips the
+        host-side recompute.  Multi-host jobs always write from process 0
+        only, fenced with a global barrier so no host races into the next
+        chunk while the file is mid-write.
+        """
         top0, bottom0 = self._halos if self._halos is not None else (None, None)
-        if board_np is not None:
+        multi = jax.process_count() > 1
+        if board_np is None:
+            if multi:
+                from gol_tpu.parallel import multihost
+
+                board_np = multihost.fetch_global(state.board)
+            else:
+                board_np = np.asarray(state.board)
+        if not multi or jax.process_index() == 0:
             ckpt_mod.save(
                 ckpt_mod.checkpoint_path(
                     self.checkpoint_dir, int(state.generation)
@@ -244,36 +262,12 @@ class GolRuntime:
                 self.geometry.num_ranks,
                 top0=None if top0 is None else np.asarray(top0),
                 bottom0=None if bottom0 is None else np.asarray(bottom0),
+                fingerprint=fingerprint,
             )
-            return
-        if jax.process_count() > 1:
-            # Multi-host: replicate the board via an XLA all-gather, write
-            # from process 0 only, and fence so no host races ahead into the
-            # next timed chunk while the file is still being written.
+        if multi:
             from jax.experimental import multihost_utils
 
-            from gol_tpu.parallel import multihost
-
-            board_np = multihost.fetch_global(state.board)
-            if jax.process_index() == 0:
-                ckpt_mod.save(
-                    ckpt_mod.checkpoint_path(
-                        self.checkpoint_dir, int(state.generation)
-                    ),
-                    board_np,
-                    int(state.generation),
-                    self.geometry.num_ranks,
-                )
             multihost_utils.sync_global_devices("gol_checkpoint")
-            return
-        ckpt_mod.save(
-            ckpt_mod.checkpoint_path(self.checkpoint_dir, int(state.generation)),
-            np.asarray(state.board),
-            int(state.generation),
-            self.geometry.num_ranks,
-            top0=None if top0 is None else np.asarray(top0),
-            bottom0=None if bottom0 is None else np.asarray(bottom0),
-        )
 
     # -- shared compile machinery -------------------------------------------
     def chunk_schedule(self, iterations: int, chunk: int) -> list:

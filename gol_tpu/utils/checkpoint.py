@@ -51,6 +51,7 @@ def save(
     num_ranks: int,
     top0: Optional[np.ndarray] = None,
     bottom0: Optional[np.ndarray] = None,
+    fingerprint: Optional[int] = None,
 ) -> str:
     """Write a snapshot atomically, stamped with a content fingerprint.
 
@@ -58,6 +59,9 @@ def save(
     file tamper-evident: :func:`load` recomputes and verifies it, so a
     corrupted snapshot fails loudly instead of silently resuming a wrong
     world (failure-detection tier 2, SURVEY §5's missing subsystem).
+    Callers that already computed the board's fingerprint on device (the
+    guard audit) pass it in to skip the host-side O(H·W) recompute — it is
+    bit-identical to ``fingerprint_np`` by design.
     """
     from gol_tpu.utils.guard import fingerprint_np
 
@@ -67,7 +71,9 @@ def save(
         board=board,
         generation=np.int64(generation),
         num_ranks=np.int64(num_ranks),
-        fingerprint=np.uint32(fingerprint_np(board)),
+        fingerprint=np.uint32(
+            fingerprint_np(board) if fingerprint is None else fingerprint
+        ),
     )
     if top0 is not None:
         arrays["top0"] = np.asarray(top0, np.uint8)

@@ -268,10 +268,13 @@ def run_guarded(
             last_good = (_fetch_host(board), generation)
         if next_ckpt is not None and generation >= next_ckpt:
             with sw.phase("checkpoint"):
-                # last_good[0] is this exact board, already on the host —
-                # no second fetch/all-gather.
+                # last_good[0] is this exact board, already on the host, and
+                # the audit already fingerprinted it on device — no second
+                # fetch/all-gather, no host-side fingerprint pass.
                 rt._save_snapshot(
-                    GolState.create(board, generation), board_np=last_good[0]
+                    GolState.create(board, generation),
+                    board_np=last_good[0],
+                    fingerprint=audit.fingerprint,
                 )
             next_ckpt = generation + rt.checkpoint_every
         i += 1

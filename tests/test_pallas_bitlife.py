@@ -39,6 +39,45 @@ def test_tile_smaller_than_board():
     np.testing.assert_array_equal(got, oracle.run_torus(board, 4))
 
 
+@pytest.mark.parametrize("k", [2, 5, 8, 16])
+def test_multi_step_matches_sequential(k):
+    """Temporal blocking: k fused generations == k single-step launches."""
+    from jax import lax
+
+    from gol_tpu.ops import bitlife
+
+    board = oracle.random_board(64, 64, seed=20 + k)
+    packed = lax.bitcast_convert_type(
+        bitlife.pack(jnp.asarray(board)), jnp.int32
+    )
+    ref = packed
+    for _ in range(k):
+        ref = pallas_bitlife.step_pallas_packed(ref, 16)
+    got = pallas_bitlife.multi_step_pallas_packed(packed, 16, k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_multi_step_remainder_path():
+    """steps not divisible by the block: full blocks + one remainder launch."""
+    board = oracle.random_board(32, 64, seed=31)
+    got = np.asarray(pallas_bitlife.evolve(jnp.asarray(board), 21, 512))
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 21))
+
+
+def test_multi_step_depth_validation():
+    packed = jnp.zeros((64, 2), jnp.int32)
+    with pytest.raises(ValueError, match="pad"):
+        pallas_bitlife.multi_step_pallas_packed(packed, 8, 16)
+    with pytest.raises(ValueError, match=">= 1"):
+        pallas_bitlife.multi_step_pallas_packed(packed, 8, 0)
+
+
+def test_pick_block_respects_geometry():
+    assert pallas_bitlife._pick_block(1000, 256) == 16
+    assert pallas_bitlife._pick_block(5, 256) == 5
+    assert pallas_bitlife._pick_block(1000, 8) == 8
+
+
 def test_pick_tile():
     assert pallas_bitlife.pick_tile(64, 2, 512) == 64
     assert pallas_bitlife.pick_tile(64, 2, 16) == 16
