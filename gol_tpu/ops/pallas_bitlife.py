@@ -180,8 +180,10 @@ def evolve(board: jax.Array, steps: int, tile_hint: int = 512) -> jax.Array:
     packed_i32 = lax.bitcast_convert_type(packed, jnp.int32)
     height = packed_i32.shape[0]
     # The blocked path prefers its own (smaller) tile: the k-deep scratch
-    # plus temporaries must still fit VMEM.
-    tile = pick_tile(height, nw, min(tile_hint, _BLOCK_TILE))
+    # plus temporaries must still fit VMEM.  Single-step runs keep the
+    # caller's full hint — no pad, no reason to halve the tile.
+    cap = min(tile_hint, _BLOCK_TILE) if steps > 1 else tile_hint
+    tile = pick_tile(height, nw, cap)
     k = _pick_block(steps, tile)
     full, rem = divmod(steps, k)
     packed_i32 = lax.fori_loop(

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import functools
 import json
-import time
 from typing import Dict
 
 import jax
@@ -35,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from gol_tpu.ops import stencil
 from gol_tpu.parallel import sharded
 from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding
+from gol_tpu.utils.timing import time_best
 
 
 @functools.lru_cache(maxsize=32)
@@ -82,13 +82,9 @@ def _exchange_only(mesh: Mesh, steps: int):
 
 
 def _time(fn, arg, repeats: int = 3) -> float:
-    jax.block_until_ready(fn(arg))  # compile + warm
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(arg))
-        best = min(best, time.perf_counter() - t0)
-    return best
+    """Shared warm best-of-N timer; the lambdas passed here copy their own
+    donated inputs, so the same ``arg`` is safe for every repeat."""
+    return time_best(fn, lambda: arg, repeats)
 
 
 def measure(mesh: Mesh, size: int, steps: int = 100) -> Dict[str, float]:

@@ -1,0 +1,122 @@
+"""Weak-scaling efficiency harness (BASELINE.md config 4's metric).
+
+The reference's scaling model is weak scaling by construction: every MPI
+rank owns a fixed ``S×S`` block, so "scaling the domain" means adding ranks
+(global world ``numRank·S × S``, gol-main.c:22,124-125).  This harness
+measures the TPU equivalent: for each device count ``n`` it evolves an
+``(n·S) × S`` board row-sharded over an ``n``-device ring and reports
+
+- aggregate and per-chip cell-updates/sec, and
+- **weak-scaling efficiency**: per-chip throughput at ``n`` devices divided
+  by the 1-device throughput (1.0 = perfect scaling; the loss is the
+  exposed halo-exchange cost, which :mod:`gol_tpu.utils.halobench`
+  attributes in detail).
+
+On this repo's single-real-TPU hosts the sweep runs on the host-local
+virtual CPU mesh (``--xla_force_host_platform_device_count``) — valid for
+the *shape* of the scaling curve and for regression-testing the comm
+structure, not for absolute numbers; on a real pod the same harness runs
+unchanged over ICI.
+
+Run as a module for a JSON report:
+``python -m gol_tpu.utils.scalebench [size_per_chip] [steps] [engine]``
+(engine ``dense`` | ``bitpack``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import packed as packed_mod
+from gol_tpu.parallel import sharded as sharded_mod
+from gol_tpu.utils.timing import time_best
+
+ENGINES = ("dense", "bitpack")
+
+
+def device_counts(limit: Optional[int] = None) -> List[int]:
+    """Powers of two up to the visible device count (always including 1)."""
+    n = len(jax.devices())
+    if limit is not None:
+        n = min(n, limit)
+    counts = [1]
+    while counts[-1] * 2 <= n:
+        counts.append(counts[-1] * 2)
+    return counts
+
+
+def measure_weak_scaling(
+    size_per_chip: int,
+    steps: int,
+    engine: str = "dense",
+    counts: Optional[List[int]] = None,
+) -> List[Dict[str, float]]:
+    """One weak-scaling sweep; returns a row per device count."""
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r}; expected {ENGINES}")
+    counts = device_counts() if counts is None else list(counts)
+    if not counts or counts[0] != 1:
+        # Efficiency is defined against the 1-device throughput; a sweep
+        # that skips it would silently re-baseline on its first row.
+        raise ValueError(f"counts must start at 1, got {counts}")
+    rng = np.random.default_rng(0)
+    rows: List[Dict[str, float]] = []
+    base_per_chip: Optional[float] = None
+    for n in counts:
+        mesh = mesh_mod.make_mesh_1d(num_devices=n)
+        height = n * size_per_chip
+        board_np = (rng.random((height, size_per_chip)) < 0.35).astype(
+            np.uint8
+        )
+        board = mesh_mod.shard_board(jnp.asarray(board_np), mesh)
+        if engine == "bitpack":
+            packed_mod.validate_packed_geometry(board.shape, mesh)
+            evolve = packed_mod.compiled_evolve_packed(mesh, steps)
+        else:
+            evolve = sharded_mod.compiled_evolve(mesh, steps, "explicit", 1)
+        dt = time_best(evolve, lambda b=board: jnp.array(b, copy=True))
+        updates = height * size_per_chip * steps
+        per_chip = updates / dt / n
+        if base_per_chip is None:
+            base_per_chip = per_chip
+        rows.append(
+            {
+                "devices": n,
+                "seconds": dt,
+                "updates_per_s": updates / dt,
+                "per_chip": per_chip,
+                "efficiency": per_chip / base_per_chip,
+            }
+        )
+    return rows
+
+
+def main(argv=None) -> None:
+    import sys
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    size = int(args[0]) if len(args) > 0 else 1024
+    steps = int(args[1]) if len(args) > 1 else 64
+    engine = args[2] if len(args) > 2 else "dense"
+    rows = measure_weak_scaling(size, steps, engine)
+    print(
+        json.dumps(
+            {
+                "size_per_chip": size,
+                "steps": steps,
+                "engine": engine,
+                "platform": jax.devices()[0].platform,
+                "rows": rows,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,27 @@ def force_ready(x) -> None:
             leaf[(0,) * leaf.ndim].item()
 
 
+def time_best(fn, arg_factory, repeats: int = 3) -> float:
+    """Best-of-N wall-clock of ``fn(arg_factory())``, warm-compiled.
+
+    ``arg_factory`` returns a fresh argument per call so donating functions
+    never consume a buffer the next repeat needs.  One untimed call warms
+    compilation; ``force_ready`` fences every timed call.  Shared by the
+    halo-latency and weak-scaling harnesses (bench.py deliberately chains
+    donated boards instead — copying its 256 MB boards through the device
+    tunnel would dominate the measurement).
+    """
+    force_ready(fn(arg_factory()))
+    best = float("inf")
+    for _ in range(repeats):
+        arg = arg_factory()
+        t0 = time.perf_counter()
+        out = fn(arg)
+        force_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 @contextlib.contextmanager
 def maybe_profile(trace_dir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler trace when a directory is given (else no-op).

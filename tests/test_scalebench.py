@@ -1,0 +1,59 @@
+"""Weak-scaling harness tests on the 8-device CPU mesh."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import jax
+
+from gol_tpu.utils import scalebench
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def test_device_counts_powers_of_two():
+    counts = scalebench.device_counts()
+    assert counts[0] == 1
+    assert counts == sorted(counts)
+    assert all(b == 2 * a for a, b in zip(counts, counts[1:]))
+    assert counts[-1] <= len(jax.devices())
+    assert scalebench.device_counts(limit=4) == [1, 2, 4]
+
+
+@pytest.mark.parametrize("engine", ["dense", "bitpack"])
+def test_weak_scaling_rows(engine):
+    size = 128  # multiple of 32, so the same size serves the bitpack engine
+    rows = scalebench.measure_weak_scaling(
+        size, steps=4, engine=engine, counts=[1, 2, 4]
+    )
+    assert [r["devices"] for r in rows] == [1, 2, 4]
+    assert rows[0]["efficiency"] == 1.0
+    for r in rows:
+        assert r["updates_per_s"] > 0
+        assert r["per_chip"] > 0
+        assert r["efficiency"] > 0
+        assert r["updates_per_s"] == pytest.approx(
+            r["per_chip"] * r["devices"]
+        )
+
+
+def test_unknown_engine_rejected():
+    with pytest.raises(ValueError, match="unknown engine"):
+        scalebench.measure_weak_scaling(64, 2, engine="warp")
+
+
+def test_counts_must_start_at_one():
+    with pytest.raises(ValueError, match="start at 1"):
+        scalebench.measure_weak_scaling(64, 2, counts=[2, 4])
+    with pytest.raises(ValueError, match="start at 1"):
+        scalebench.measure_weak_scaling(64, 2, counts=[])
+
+
+def test_main_emits_json(capsys):
+    scalebench.main(["128", "2", "dense"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["engine"] == "dense"
+    assert out["rows"][0]["devices"] == 1
+    assert len(out["rows"]) >= 1
