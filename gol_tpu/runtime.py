@@ -74,16 +74,14 @@ class GolRuntime:
             self.checkpoint_dir = "checkpoints"
         if self.halo_depth < 1:
             raise ValueError(f"halo_depth must be >= 1, got {self.halo_depth}")
+        self._resolved = (
+            self._resolve_auto() if self.engine == "auto" else self.engine
+        )
         if self.halo_depth > 1:
             if self.mesh is None:
                 raise ValueError(
                     "halo_depth > 1 (temporal blocking) only applies to "
                     "sharded runs; pass a mesh"
-                )
-            if self.engine == "bitpack":
-                raise ValueError(
-                    "the bit-packed sharded engine does not support "
-                    "halo_depth > 1 yet; use engine 'dense'/'auto'"
                 )
             if self.shard_mode != "explicit":
                 raise ValueError(
@@ -96,14 +94,22 @@ class GolRuntime:
             shard_w = self.geometry.global_width // cols
             # A 2-D mesh halo-extends the width axis even when its cols
             # axis has size 1 (the ring degenerates to the local wrap), so
-            # the depth limit applies to both shard extents.
+            # the depth limit applies to both shard extents.  The packed
+            # engine's horizontal quantum is the 32-cell word, so its
+            # width-axis extent counts in words.
             two_d = mesh_mod.COLS in self.mesh.axis_names
+            if self._resolved == "bitpack":
+                from gol_tpu.ops import bitlife
+
+                shard_w //= bitlife.BITS
             limit = min(shard_h, shard_w) if two_d else shard_h
             if self.halo_depth > limit:
                 raise ValueError(
                     f"halo_depth {self.halo_depth} exceeds the shard extent "
-                    f"({shard_h}×{shard_w}); the ghost shell must come from "
-                    "the immediate ring neighbor"
+                    f"({shard_h}×{shard_w} rows×"
+                    f"{'words' if self._resolved == 'bitpack' else 'cells'}); "
+                    "the ghost shell must come from the immediate ring "
+                    "neighbor"
                 )
         if self.mesh is not None:
             if self.halo_mode != "fresh":
@@ -118,7 +124,7 @@ class GolRuntime:
                     "auto-SPMD) or 'bitpack' (packed shard_map+ppermute)"
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
-            if self.engine == "bitpack":
+            if self._resolved == "bitpack":
                 if self.shard_mode != "explicit":
                     raise ValueError(
                         "the bit-packed sharded engine has only the explicit "
@@ -131,6 +137,56 @@ class GolRuntime:
         # Frozen t=0 halos, populated for stale_t0 runs at board init.
         self._halos: Optional[Tuple[jax.Array, jax.Array]] = None
 
+    def _resolve_auto(self) -> str:
+        """Pick the fastest engine this run's geometry and mode support.
+
+        Every engine is bit-exact (pinned by the equivalence tests), so
+        'auto' is purely a performance choice — the TPU analog of the
+        reference hard-coding one CUDA kernel:
+
+        - sharded explicit runs take the bit-packed ring engine when the
+          shard width packs into whole words (8× less ppermute wire);
+        - single-device fresh runs take the fused Pallas bit-packed kernel
+          on TPU when the width fills whole lane tiles, else the XLA
+          bit-packed engine when the width packs, else dense;
+        - stale_t0 (reference-compat) and overlap/auto shard modes are
+          dense-only paths.
+        """
+        if self.halo_mode != "fresh":
+            return "dense"
+        geom = (self.geometry.global_height, self.geometry.global_width)
+        if self.mesh is not None:
+            if self.shard_mode != "explicit":
+                return "dense"
+            try:
+                packed_mod.validate_packed_geometry(geom, self.mesh)
+            except ValueError:
+                return "dense"
+            if self.halo_depth > 1 and mesh_mod.COLS in self.mesh.axis_names:
+                # The packed engine's horizontal ghost quantum is the
+                # 32-cell word; if the shard is too narrow in words for the
+                # requested depth, dense (cell-quantum halos) still works.
+                from gol_tpu.ops import bitlife
+
+                cols = self.mesh.shape.get(mesh_mod.COLS, 1)
+                words = self.geometry.global_width // cols // bitlife.BITS
+                if self.halo_depth > words:
+                    return "dense"
+            return "bitpack"
+        from gol_tpu.ops import bitlife
+
+        if geom[1] % bitlife.BITS != 0:
+            return "dense"
+        if jax.default_backend() == "tpu":
+            from gol_tpu.ops import pallas_bitlife
+
+            if (
+                geom[1] % (pallas_bitlife._LANE * bitlife.BITS) == 0
+                and geom[0] % pallas_bitlife._ALIGN == 0
+            ):
+                return "pallas_bitpack"
+        return "bitpack"
+
     # -- engine dispatch ----------------------------------------------------
     def _evolve_fn(self, steps: int):
         """Returns (jitted_fn, dynamic_args, static_args).
@@ -141,7 +197,7 @@ class GolRuntime:
         the compile phase lower from a ShapeDtypeStruct — compiling without
         executing a throwaway evolution.
         """
-        name = "dense" if self.engine == "auto" else self.engine
+        name = self._resolved
         if name == "dense":
             if self.mesh is not None:
                 return (
@@ -165,7 +221,9 @@ class GolRuntime:
             if name == "bitpack":
                 if self.mesh is not None:
                     return (
-                        packed_mod.compiled_evolve_packed(self.mesh, steps),
+                        packed_mod.compiled_evolve_packed(
+                            self.mesh, steps, self.halo_depth
+                        ),
                         (),
                         (),
                     )

@@ -101,6 +101,9 @@ def time_best(fn, arg_factory, repeats: int = 3) -> float:
     best = float("inf")
     for _ in range(repeats):
         arg = arg_factory()
+        # Fence the factory's (async) device work — e.g. a board copy —
+        # so the timed window measures fn alone, not the copy it depends on.
+        force_ready(arg)
         t0 = time.perf_counter()
         out = fn(arg)
         force_ready(out)

@@ -10,6 +10,8 @@ from gol_tpu.runtime import GolRuntime, build_mesh
 from gol_tpu import cli
 from gol_tpu.utils import io as gol_io
 
+import jax as _jax
+
 from tests import oracle
 
 
@@ -69,15 +71,46 @@ def test_runtime_deep_halo_matches_oracle():
     )
 
 
+def test_runtime_deep_halo_bitpack_matches_oracle():
+    """Packed temporal blocking: k-deep word halos, 1-D and 2-D meshes."""
+    geom = Geometry(size=32, num_ranks=4)  # 128×32 world, nw=1 word/shard
+    rt = GolRuntime(
+        geometry=geom,
+        engine="bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        halo_depth=3,
+    )
+    _, state = rt.run(pattern=1, iterations=7)
+    board0 = patterns.init_global(1, 32, 4)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 7)
+    )
+
+    geom2 = Geometry(size=128, num_ranks=1)  # 128×128 over 2×2 blocks
+    rt2 = GolRuntime(
+        geometry=geom2,
+        engine="bitpack",
+        mesh=mesh_mod.make_mesh_2d((2, 2), devices=_jax.devices()[:4]),
+        halo_depth=2,  # <= 2 words of shard width
+    )
+    _, state2 = rt2.run(pattern=1, iterations=5)
+    board0 = patterns.init_global(1, 128, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state2.board), oracle.run_torus(board0, 5)
+    )
+
+
 def test_runtime_deep_halo_rejections():
     geom = Geometry(size=16, num_ranks=1)
     with pytest.raises(ValueError, match="sharded runs"):
         GolRuntime(geometry=geom, halo_depth=2)
-    with pytest.raises(ValueError, match="bit-packed"):
+    # The packed engine's horizontal halo quantum is the 32-cell word: a
+    # 2-D shard one word wide cannot supply a 2-word ghost band.
+    with pytest.raises(ValueError, match="shard extent"):
         GolRuntime(
-            geometry=Geometry(size=32, num_ranks=1),
+            geometry=Geometry(size=64, num_ranks=1),
             engine="bitpack",
-            mesh=mesh_mod.make_mesh_1d(4),
+            mesh=mesh_mod.make_mesh_2d((2, 2), devices=_jax.devices()[:4]),
             halo_depth=2,
         )
     with pytest.raises(ValueError, match="shard extent"):
@@ -162,3 +195,63 @@ def test_cli_mesh_run_writes_correct_dump(capsys, tmp_path):
     for r in range(8):
         _, block = gol_io.read_rank_file(str(tmp_path / f"Rank_{r}_of_8.txt"))
         np.testing.assert_array_equal(block, expected[r * 8 : (r + 1) * 8])
+
+
+def test_auto_engine_resolution():
+    """'auto' is a performance choice; all engines are bit-exact, so it
+    should pick the packed paths whenever the geometry allows."""
+    # Single device, width packs into words -> bitpack (CPU backend; on TPU
+    # the same geometry with lane-filling width resolves to pallas_bitpack).
+    rt = GolRuntime(geometry=Geometry(size=64, num_ranks=1))
+    assert rt._resolved == "bitpack"
+    # Width that doesn't pack -> dense.
+    rt = GolRuntime(geometry=Geometry(size=20, num_ranks=1))
+    assert rt._resolved == "dense"
+    # Reference-compat stale halos are a dense-only path.
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1), halo_mode="stale_t0"
+    )
+    assert rt._resolved == "dense"
+    # Sharded explicit + packable -> packed ring engine.
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=4), mesh=mesh_mod.make_mesh_1d(4)
+    )
+    assert rt._resolved == "bitpack"
+    # Sharded but the shard width doesn't pack -> dense.
+    rt = GolRuntime(
+        geometry=Geometry(size=16, num_ranks=4), mesh=mesh_mod.make_mesh_1d(4)
+    )
+    assert rt._resolved == "dense"
+    # Overlap/auto shard modes are dense-only programs.
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=4),
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="overlap",
+    )
+    assert rt._resolved == "dense"
+
+
+def test_auto_engine_runs_match_oracle():
+    geom = Geometry(size=32, num_ranks=2)
+    rt = GolRuntime(geometry=geom)  # auto -> bitpack on CPU
+    _, state = rt.run(pattern=4, iterations=5)
+    board0 = patterns.init_global(4, 32, 2)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 5)
+    )
+
+
+def test_auto_falls_back_to_dense_for_deep_narrow_halos():
+    """auto must not pick bitpack when the requested halo_depth exceeds the
+    shard's width in packed words (dense cell-quantum halos still work)."""
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),  # 32x32 shards, 1 word wide
+        mesh=mesh_mod.make_mesh_2d((2, 2), devices=_jax.devices()[:4]),
+        halo_depth=4,
+    )
+    assert rt._resolved == "dense"
+    _, state = rt.run(pattern=1, iterations=5)
+    board0 = patterns.init_global(1, 64, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 5)
+    )
