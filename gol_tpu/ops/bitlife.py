@@ -96,6 +96,23 @@ def _row_hsum(row: jax.Array):
     return _full_add(west, row, east)
 
 
+def _sum3_2bit(sa, sc, sb):
+    """Bit-plane sum of three 2-bit numbers -> 4 planes (count 0-9).
+
+    Each argument is a (ones_plane, twos_plane) pair; the result is the
+    little-endian bit-plane tuple of their sum.  Shared by the 2-D rule
+    (count-of-9 from three row sums) and the 3-D engine's column stage.
+    """
+    (s0a, s1a), (s0c, s1c), (s0b, s1b) = sa, sc, sb
+    l0, c_low = _full_add(s0a, s0c, s0b)  # ones plane + carry into twos
+    u, v = _full_add(s1a, s1c, s1b)  # twos-plane sum: u ones, v twos
+    t0 = u ^ c_low
+    carry2 = u & c_low
+    t1 = v ^ carry2
+    t2 = v & carry2
+    return (l0, t0, t1, t2)
+
+
 def _rule_from_row_sums(center, sa, sc, sb):
     """B3/S23 from the three per-row 2-bit horizontal sums.
 
@@ -103,14 +120,7 @@ def _rule_from_row_sums(center, sa, sc, sb):
     center / below stencil rows; builds the 4-bit count-of-9 and applies the
     branchless rule (the if/else chain of gol-with-cuda.cu:239-257).
     """
-    (s0a, s1a), (s0c, s1c), (s0b, s1b) = sa, sc, sb
-    # count-of-9 t = (s0a+s0c+s0b) + 2*(s1a+s1c+s1b); build its bit-planes.
-    l0, c_low = _full_add(s0a, s0c, s0b)  # ones plane + carry into twos
-    u, v = _full_add(s1a, s1c, s1b)  # twos-plane sum: u ones, v twos
-    t0 = u ^ c_low
-    carry2 = u & c_low
-    t1 = v ^ carry2
-    t2 = v & carry2
+    l0, t0, t1, t2 = _sum3_2bit(sa, sc, sb)
     # t = l0 + 2*t0 + 4*t1 + 8*t2;  alive-next = (t==3) | (alive & t==4)
     eq3 = l0 & t0 & ~(t1 | t2)
     eq4 = ~l0 & ~t0 & t1 & ~t2

@@ -18,7 +18,7 @@ every decomposition of the torus (the property the reference's hand-rolled
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -75,3 +75,48 @@ def halo_extend(
         hi = lax.ppermute(ext[first], name, ring(n, -1))
         ext = jnp.concatenate([lo, ext, hi], axis=axis)
     return ext
+
+
+def blocked_local_loop(
+    step: Callable,
+    phases,
+    steps: int,
+    halo_depth: int,
+    pack: Optional[Callable] = None,
+    unpack: Optional[Callable] = None,
+) -> Callable:
+    """Per-shard generation loop with depth-k temporal blocking.
+
+    ``step`` consumes one ghost layer per call (shrink-by-one on every
+    extended axis); each chunk halo-extends by ``k`` and applies ``step``
+    ``k`` times, so the ring pays one exchange per ``k`` generations.
+    ``steps`` is split into full ``halo_depth`` chunks plus one remainder
+    chunk.  Optional ``pack``/``unpack`` convert the shard representation
+    once around the whole loop (the bit-packed engines' dense-in/dense-out
+    contract).  The returned callable is the body for ``shard_map`` —
+    shared by the 2-D and 3-D packed engines so their blocking logic
+    cannot diverge.
+    """
+    if halo_depth < 1:
+        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+
+    def chunk(x, k):
+        ext = halo_extend(x, phases, depth=k)
+        for _ in range(k):  # each generation consumes one ghost layer
+            ext = step(ext)
+        return ext
+
+    full, rem = divmod(steps, halo_depth)
+
+    def local(x):
+        if pack is not None:
+            x = pack(x)
+        if full:
+            x = lax.fori_loop(0, full, lambda _, y: chunk(y, halo_depth), x)
+        if rem:
+            x = chunk(x, rem)
+        if unpack is not None:
+            x = unpack(x)
+        return x
+
+    return local

@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.ops import bitlife
-from gol_tpu.parallel.halo import halo_extend
+from gol_tpu.parallel.halo import blocked_local_loop
 from gol_tpu.parallel.mesh import COLS, ROWS, validate_geometry
 from gol_tpu.parallel.sharded import (
     exchange_block_halos,
@@ -103,8 +103,6 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
     still ~8× fewer bytes on the row axis, break-even on the word axis at
     k=1, and k× fewer ppermute latencies either way.
     """
-    if halo_depth < 1:
-        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
     two_d = COLS in mesh.axis_names
     num_rows = mesh.shape[ROWS]
     num_cols = mesh.shape.get(COLS, 1)
@@ -118,24 +116,10 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
         step = bitlife.step_packed_vext  # consumes a row layer
         spec = P(ROWS, None)
 
-    def chunk(blk, k):
-        ext = halo_extend(blk, phases, depth=k)
-        for _ in range(k):  # each generation consumes one ghost layer
-            ext = step(ext)
-        return ext
-
-    full, rem = divmod(steps, halo_depth)
-
-    def local(board):
-        packed = bitlife.pack(board)
-        if full:
-            packed = lax.fori_loop(
-                0, full, lambda _, p: chunk(p, halo_depth), packed
-            )
-        if rem:
-            packed = chunk(packed, rem)
-        return bitlife.unpack(packed)
-
+    local = blocked_local_loop(
+        step, phases, steps, halo_depth,
+        pack=bitlife.pack, unpack=bitlife.unpack,
+    )
     shmapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
     return jax.jit(shmapped, donate_argnums=0)
 
