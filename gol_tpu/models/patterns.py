@@ -41,7 +41,33 @@ PATTERN_NAMES = {
     2: "ones_in_middle",  # effective: last local row, cols 127-136 (B3/B4)
     3: "ones_at_corners",  # global corners
     4: "spinner_at_corner",  # wrap-spanning blinker on rank 0
+    # Capability additions (the reference exits on ids > 4,
+    # gol-with-cuda.cu:324-326): classic Life objects as long-horizon
+    # correctness probes — a glider's torus transit and a gun's emission
+    # rate catch subtle stencil/wrap bugs that short oscillators cannot.
+    5: "glider",  # south-east glider on rank 0; period-4 (+1,+1) translation
+    6: "r_pentomino",  # methuselah centered on the global world
+    7: "gosper_gun",  # emits one glider every 30 generations
 }
+
+#: (row, col) cells of the capability-addition objects, top-left anchored.
+GLIDER_CELLS = ((0, 1), (1, 2), (2, 0), (2, 1), (2, 2))
+R_PENTOMINO_CELLS = ((0, 1), (0, 2), (1, 0), (1, 1), (2, 1))
+GOSPER_GUN_CELLS = (
+    (0, 24),
+    (1, 22), (1, 24),
+    (2, 12), (2, 13), (2, 20), (2, 21), (2, 34), (2, 35),
+    (3, 11), (3, 15), (3, 20), (3, 21), (3, 34), (3, 35),
+    (4, 0), (4, 1), (4, 10), (4, 16), (4, 20), (4, 21),
+    (5, 0), (5, 1), (5, 10), (5, 14), (5, 16), (5, 17), (5, 22), (5, 24),
+    (6, 10), (6, 16), (6, 24),
+    (7, 11), (7, 15),
+    (8, 12), (8, 13),
+)
+#: Anchor offset for the object patterns; leaves a margin so the object's
+#: first generations don't immediately interact with the wrap.
+OBJECT_OFFSET = 1
+GOSPER_GUN_MIN_SIZE = OBJECT_OFFSET + 36 + 2  # widest extent + tail margin
 
 #: Pattern 2 writes flat indices (H-1)*H+127 .. +136 (gol-with-cuda.cu:108-114);
 #: on a square world that is columns 127..136 of the last row, so any
@@ -65,6 +91,22 @@ def validate_pattern_size(pattern: int, size: int) -> None:
             f"pattern 2 requires worldSize >= {PATTERN2_MIN_SIZE} (the reference "
             f"writes columns {PATTERN2_COL0}..{PATTERN2_COL0 + PATTERN2_NCELLS - 1} "
             f"of the last row and heap-overflows below that; got size={size})"
+        )
+    if pattern == 5 and size < OBJECT_OFFSET + 3 + 1:
+        raise ValueError(
+            f"pattern 5 needs worldSize >= {OBJECT_OFFSET + 4} for the "
+            f"3×3 glider at its anchor plus margin; got size={size}"
+        )
+    if pattern == 6 and size < 4:
+        # Centered, no anchor offset: a 4×4 world fits the 3×3 pentomino.
+        raise ValueError(
+            f"pattern 6 needs worldSize >= 4 for the centered 3×3 "
+            f"R-pentomino; got size={size}"
+        )
+    if pattern == 7 and size < GOSPER_GUN_MIN_SIZE:
+        raise ValueError(
+            f"pattern 7 (Gosper gun) needs worldSize >= {GOSPER_GUN_MIN_SIZE}; "
+            f"got size={size}"
         )
 
 
@@ -100,6 +142,23 @@ def init_local(pattern: int, size: int, rank: int, num_ranks: int) -> np.ndarray
             board[0, 0] = 1
             board[0, 1] = 1
             board[0, size - 1] = 1
+    elif pattern == 5:
+        if rank == 0:
+            for r, c in GLIDER_CELLS:
+                board[OBJECT_OFFSET + r, OBJECT_OFFSET + c] = 1
+    elif pattern == 6:
+        # Centered on the *global* world: only the rank(s) owning those
+        # rows place cells (rank-aware like patterns 3/4).
+        gh = size * num_ranks
+        r0, c0 = gh // 2 - 1, size // 2 - 1
+        for r, c in R_PENTOMINO_CELLS:
+            gr = r0 + r
+            if rank * size <= gr < (rank + 1) * size:
+                board[gr - rank * size, c0 + c] = 1
+    elif pattern == 7:
+        if rank == 0:
+            for r, c in GOSPER_GUN_CELLS:
+                board[OBJECT_OFFSET + r, OBJECT_OFFSET + c] = 1
     return board
 
 
