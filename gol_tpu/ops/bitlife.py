@@ -96,6 +96,39 @@ def _row_hsum(row: jax.Array):
     return _full_add(west, row, east)
 
 
+def _sub_bit(planes, bit: jax.Array):
+    """Bit-plane subtraction of a 1-bit number (borrow ripple).
+
+    Shared by the generalized-rule 2-D engine (count-of-8 from count-of-9)
+    and the 3-D engine (count-of-26 from count-of-27).
+    """
+    out = []
+    borrow = bit
+    for p in planes:
+        out.append(p ^ borrow)
+        borrow = ~p & borrow
+    return tuple(out)
+
+
+def _match_counts(planes, counts) -> jax.Array:
+    """Word mask of cells whose plane-encoded count is in ``counts``.
+
+    The branchless rule evaluator for arbitrary totalistic count sets: one
+    AND-chain of planes/complements per count, OR'd together — every op
+    still advances 32 cells.
+    """
+    zero = jnp.zeros_like(planes[0])
+    out = zero
+    for c in sorted(counts):
+        if c >= 1 << len(planes):
+            raise ValueError(f"count {c} exceeds {len(planes)} planes")
+        m = ~zero
+        for i, p in enumerate(planes):
+            m = m & (p if (c >> i) & 1 else ~p)
+        out = out | m
+    return out
+
+
 def _sum3_2bit(sa, sc, sb):
     """Bit-plane sum of three 2-bit numbers -> 4 planes (count 0-9).
 

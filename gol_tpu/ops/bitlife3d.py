@@ -15,12 +15,14 @@ count is built entirely from bit-plane adders:
    carry-save layer + one ripple add; subtract the center bit with a
    borrow ripple for the count of 26 neighbors.
 
-Unlike the 2-D engine (hard-wired B3/S23, matching the reference's kernel,
-gol-with-cuda.cu:239-257), 3-D rules are parameters
-(:class:`gol_tpu.ops.life3d.Rule3D`), so the update is a bit-plane
-*matcher*: for each count in the birth/survive sets, AND together the five
-planes or their complements according to the count's bits, then OR the
-matches — still branchless, still 32 cells per VPU op.
+3-D rules are parameters (:class:`gol_tpu.ops.life3d.Rule3D` — there is no
+canonical 3-D Conway), so the update is a bit-plane *matcher*: for each
+count in the birth/survive sets, AND together the five planes or their
+complements according to the count's bits, then OR the matches — still
+branchless, still 32 cells per VPU op.  The same matcher powers the 2-D
+generalized-rule engine (:mod:`gol_tpu.ops.rules`); only the default 2-D
+path (:mod:`gol_tpu.ops.bitlife`) hard-wires B3/S23, mirroring the
+reference's kernel (gol-with-cuda.cu:239-257).
 
 ~3 bitwise ops/cell per generation vs ~13 byte-wide ops/cell dense, at
 1/8th the HBM traffic.  Measured on one v5e chip at 512³ via the XLA
@@ -84,28 +86,10 @@ def _sum3_planes(a: Planes, b: Planes, c: Planes, width: int) -> Planes:
     return tuple(out)
 
 
-def _sub_bit(planes: Planes, bit: jax.Array) -> Planes:
-    """Bit-plane subtraction of a 1-bit number (borrow ripple)."""
-    out = []
-    borrow = bit
-    for p in planes:
-        out.append(p ^ borrow)
-        borrow = ~p & borrow
-    return tuple(out)
-
-
-def _match_counts(planes: Planes, counts) -> jax.Array:
-    """Word mask of cells whose plane-encoded count is in ``counts``."""
-    zero = jnp.zeros_like(planes[0])
-    out = zero
-    for c in sorted(counts):
-        if c >= 1 << len(planes):
-            raise ValueError(f"count {c} exceeds {len(planes)} planes")
-        m = ~zero
-        for i, p in enumerate(planes):
-            m = m & (p if (c >> i) & 1 else ~p)
-        out = out | m
-    return out
+# Bit-plane subtraction / count matching live in bitlife (shared with the
+# generalized-rule 2-D engine).
+_sub_bit = bitlife._sub_bit
+_match_counts = bitlife._match_counts
 
 
 def _rule_packed(center: jax.Array, count26: Planes, rule: Rule3D) -> jax.Array:
