@@ -74,6 +74,9 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
         default="explicit",
     )
     ext.add_argument("--halo-depth", type=int, default=1, metavar="K")
+    # Capability addition: any totalistic rule, e.g. --rule B36/S23
+    # (HighLife). B3/S23 (the reference's hard-wired rule) is the default.
+    ext.add_argument("--rule", default=None, metavar="B<d>/S<d>")
     ext.add_argument("--outdir", default=".")
     ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
     ext.add_argument("--compat-banner", action="store_true")
@@ -162,6 +165,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             mesh=build_mesh(ns.mesh),
             shard_mode=ns.shard_mode,
             halo_depth=ns.halo_depth,
+            rule=ns.rule,
         )
         guard_report = None
         if ns.guard_every > 0:

@@ -89,7 +89,11 @@ def step_rule_packed(packed: jax.Array, rule: Rule2D) -> jax.Array:
 
     Same data flow as :func:`gol_tpu.ops.bitlife.step_packed` up to the
     4-plane count-of-9; the Conway-specific eq3/eq4 tail is replaced by the
-    generic subtract-center + plane-match evaluator.
+    generic plane matcher.  The center subtraction is free via the same
+    identity the hard-wired kernel uses (``t==3 | alive & t==4``): for dead
+    cells count-of-9 == count-of-8, for alive cells it is count-of-8 + 1,
+    so birth matches against B and survival against {s+1 for s in S}
+    (still <= 9, fits the 4 planes) — no borrow ripple in the hot loop.
     """
     s = bitlife._row_hsum(packed)
     count9 = bitlife._sum3_2bit(
@@ -97,9 +101,8 @@ def step_rule_packed(packed: jax.Array, rule: Rule2D) -> jax.Array:
         s,
         tuple(jnp.roll(p, -1, axis=-2) for p in s),
     )
-    count8 = bitlife._sub_bit(count9, packed)
-    born = bitlife._match_counts(count8, rule.birth)
-    keep = bitlife._match_counts(count8, rule.survive)
+    born = bitlife._match_counts(count9, rule.birth)
+    keep = bitlife._match_counts(count9, {c + 1 for c in rule.survive})
     return (~packed & born) | (packed & keep)
 
 

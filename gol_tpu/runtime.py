@@ -59,6 +59,7 @@ class GolRuntime:
     mesh: Optional[Mesh] = None
     shard_mode: str = "explicit"  # shard_map+ppermute vs XLA auto-SPMD
     halo_depth: int = 1  # temporal blocking: ghost layers shipped per exchange
+    rule: Optional[str] = None  # B/S rulestring; None = B3/S23 fast paths
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -74,6 +75,30 @@ class GolRuntime:
             self.checkpoint_dir = "checkpoints"
         if self.halo_depth < 1:
             raise ValueError(f"halo_depth must be >= 1, got {self.halo_depth}")
+        self._rule = None
+        if self.rule is not None:
+            from gol_tpu.ops import rules as rules_mod
+
+            parsed = rules_mod.parse_rulestring(self.rule)
+            if parsed != rules_mod.CONWAY:
+                # B3/S23 stays on the hard-wired fast paths; other rules
+                # run the generic evaluators (single-device, fresh halos).
+                if self.mesh is not None:
+                    raise ValueError(
+                        "custom rules are single-device for now; drop --mesh "
+                        f"(got rule {parsed.rulestring()} with a mesh)"
+                    )
+                if self.halo_mode != "fresh":
+                    raise ValueError(
+                        "custom rules have no stale_t0 reference-compat mode "
+                        "(the reference only implements B3/S23)"
+                    )
+                if self.engine in ("pallas", "pallas_bitpack"):
+                    raise ValueError(
+                        f"engine {self.engine!r} is hard-wired to B3/S23; "
+                        "use 'auto'/'dense'/'bitpack' with a custom rule"
+                    )
+                self._rule = parsed
         self._resolved = (
             self._resolve_auto() if self.engine == "auto" else self.engine
         )
@@ -155,6 +180,11 @@ class GolRuntime:
         if self.halo_mode != "fresh":
             return "dense"
         geom = (self.geometry.global_height, self.geometry.global_width)
+        if self._rule is not None:
+            # Generic rules have dense and packed evaluators only.
+            from gol_tpu.ops import bitlife
+
+            return "bitpack" if geom[1] % bitlife.BITS == 0 else "dense"
         if self.mesh is not None:
             if self.shard_mode != "explicit":
                 return "dense"
@@ -198,6 +228,12 @@ class GolRuntime:
         executing a throwaway evolution.
         """
         name = self._resolved
+        if self._rule is not None:
+            from gol_tpu.ops import rules as rules_mod
+
+            if name == "bitpack":
+                return rules_mod.evolve_rule_dense_io, (), (steps, self._rule)
+            return rules_mod.run_rule, (), (steps, self._rule)
         if name == "dense":
             if self.mesh is not None:
                 return (
@@ -265,6 +301,16 @@ class GolRuntime:
                 raise ValueError(
                     f"checkpoint board {snap.board.shape} != configured {expected}"
                 )
+            mine = None if self._rule is None else self._rule.rulestring()
+            if snap.rule != mine:
+                # Same semantic-drift guard as the frozen halos below: a
+                # resumed world must keep evolving under the rule that
+                # produced it.
+                raise ValueError(
+                    f"checkpoint was written by a {snap.rule or 'B3/S23'} "
+                    f"run; this run is configured for {mine or 'B3/S23'} — "
+                    "pass the matching --rule to resume"
+                )
             if self.halo_mode == "stale_t0":
                 if snap.top0 is None:
                     raise ValueError(
@@ -321,6 +367,7 @@ class GolRuntime:
                 top0=None if top0 is None else np.asarray(top0),
                 bottom0=None if bottom0 is None else np.asarray(bottom0),
                 fingerprint=fingerprint,
+                rule=None if self._rule is None else self._rule.rulestring(),
             )
         if multi:
             from jax.experimental import multihost_utils

@@ -28,6 +28,7 @@ class Snapshot:
     num_ranks: int
     top0: Optional[np.ndarray] = None  # frozen halos, stale_t0 runs only
     bottom0: Optional[np.ndarray] = None
+    rule: Optional[str] = None  # B/S rulestring for custom-rule runs
 
 
 class CorruptSnapshotError(ValueError):
@@ -52,6 +53,7 @@ def save(
     top0: Optional[np.ndarray] = None,
     bottom0: Optional[np.ndarray] = None,
     fingerprint: Optional[int] = None,
+    rule: Optional[str] = None,
 ) -> str:
     """Write a snapshot atomically, stamped with a content fingerprint.
 
@@ -75,6 +77,10 @@ def save(
             fingerprint_np(board) if fingerprint is None else fingerprint
         ),
     )
+    if rule is not None:
+        # Like the frozen halos, the rule changes the semantics of every
+        # resumed generation; record it so resume can refuse a mismatch.
+        arrays["rule"] = np.asarray(rule)
     if top0 is not None:
         arrays["top0"] = np.asarray(top0, np.uint8)
         arrays["bottom0"] = np.asarray(bottom0, np.uint8)
@@ -125,6 +131,7 @@ def load(path: str) -> Snapshot:
             num_ranks=int(data["num_ranks"]),
             top0=top0,
             bottom0=bottom0,
+            rule=str(data["rule"]) if "rule" in data else None,
         )
 
 

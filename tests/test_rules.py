@@ -95,3 +95,119 @@ def test_highlife_replicator_differs_from_conway():
     c = np.asarray(rules.run_rule(jnp.asarray(board), 8, rules.CONWAY))
     h = np.asarray(rules.run_rule(jnp.asarray(board), 8, rules.HIGHLIFE))
     assert (c != h).any()  # B6 births must kick in on a dense random board
+
+
+# -- runtime / CLI surface ---------------------------------------------------
+
+
+def test_runtime_rule_matches_library():
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1), rule="B36/S23"
+    )
+    assert rt._resolved == "bitpack"  # generic packed evaluator
+    _, state = rt.run(pattern=6, iterations=8)
+    from gol_tpu.models import patterns
+
+    board0 = jnp.asarray(patterns.init_global(6, 32, 1))
+    np.testing.assert_array_equal(
+        np.asarray(state.board),
+        np.asarray(rules.run_rule(board0, 8, rules.HIGHLIFE)),
+    )
+
+
+def test_runtime_conway_rulestring_keeps_fast_paths():
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(geometry=Geometry(size=32, num_ranks=1), rule="B3/S23")
+    assert rt._rule is None  # hard-wired engines still used
+
+
+def test_runtime_rule_rejections():
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.runtime import GolRuntime
+
+    with pytest.raises(ValueError, match="single-device"):
+        GolRuntime(
+            geometry=Geometry(size=32, num_ranks=4),
+            mesh=mesh_mod.make_mesh_1d(4),
+            rule="B36/S23",
+        )
+    with pytest.raises(ValueError, match="hard-wired"):
+        GolRuntime(
+            geometry=Geometry(size=32, num_ranks=1),
+            engine="pallas_bitpack",
+            rule="B36/S23",
+        )
+    with pytest.raises(ValueError, match="stale_t0|compat"):
+        GolRuntime(
+            geometry=Geometry(size=32, num_ranks=1),
+            halo_mode="stale_t0",
+            rule="B2/S",
+        )
+    with pytest.raises(ValueError, match="malformed"):
+        GolRuntime(geometry=Geometry(size=32, num_ranks=1), rule="wat")
+
+
+def test_cli_rule_flag(tmp_path, capsys, monkeypatch):
+    from gol_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(["6", "32", "8", "64", "1", "--rule", "B36/S23"])
+    assert rc == 0
+    assert "TOTAL DURATION" in capsys.readouterr().out
+    from gol_tpu.models import patterns
+    from gol_tpu.utils import io as gol_io
+
+    _, block = gol_io.read_rank_file(str(tmp_path / "Rank_0_of_1.txt"))
+    board0 = jnp.asarray(patterns.init_global(6, 32, 1))
+    np.testing.assert_array_equal(
+        block, np.asarray(rules.run_rule(board0, 8, rules.HIGHLIFE))
+    )
+
+
+def test_rule_checkpoint_resume_guard(tmp_path):
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    ckdir = str(tmp_path / "ck")
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        rule="B36/S23",
+        checkpoint_every=4,
+        checkpoint_dir=ckdir,
+    )
+    _, state = rt.run(pattern=6, iterations=8)
+    path = ckpt_mod.checkpoint_path(ckdir, 8)
+    assert ckpt_mod.load(path).rule == "B36/S23"
+
+    # Resuming without the rule (implicit B3/S23) must refuse.
+    rt2 = GolRuntime(geometry=Geometry(size=32, num_ranks=1))
+    with pytest.raises(ValueError, match="B36/S23"):
+        rt2.run(pattern=6, iterations=1, resume=path)
+    # With a different custom rule: refuse.
+    rt3 = GolRuntime(geometry=Geometry(size=32, num_ranks=1), rule="B2/S")
+    with pytest.raises(ValueError, match="B36/S23"):
+        rt3.run(pattern=6, iterations=1, resume=path)
+    # With the matching rule: resumes and continues identically.
+    rt4 = GolRuntime(geometry=Geometry(size=32, num_ranks=1), rule="B36/S23")
+    _, state4 = rt4.run(pattern=6, iterations=0, resume=path)
+    np.testing.assert_array_equal(
+        np.asarray(state4.board), np.asarray(state.board)
+    )
+    # And a Conway checkpoint refuses a custom-rule resume.
+    rt5 = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck2"),
+    )
+    rt5.run(pattern=4, iterations=2)
+    conway_path = ckpt_mod.checkpoint_path(str(tmp_path / "ck2"), 2)
+    rt6 = GolRuntime(geometry=Geometry(size=32, num_ranks=1), rule="B2/S")
+    with pytest.raises(ValueError, match="B3/S23"):
+        rt6.run(pattern=4, iterations=1, resume=conway_path)
