@@ -101,9 +101,68 @@ def step_rule_packed(packed: jax.Array, rule: Rule2D) -> jax.Array:
         s,
         tuple(jnp.roll(p, -1, axis=-2) for p in s),
     )
+    return _rule_from_count9(packed, count9, rule)
+
+
+def step_rule_halo_rows(ext: jax.Array, rule: Rule2D) -> jax.Array:
+    """One ``rule`` generation of a row-halo-extended block ``ext[h+2, w]``.
+
+    Ghost rows carry the vertical periodicity; columns wrap locally (width
+    axis unsharded) — the generic-rule analog of
+    :func:`gol_tpu.ops.stencil.step_halo_rows`.  Shrinks by one row layer,
+    so it composes with depth-k halos for temporal blocking.
+    """
+    from gol_tpu.ops.life3d import rule3d
+
+    v = ext[:-2] + ext[1:-1] + ext[2:]
+    h3 = v + jnp.roll(v, 1, axis=1) + jnp.roll(v, -1, axis=1)
+    center = ext[1:-1]
+    return rule3d(center, h3 - center, rule)
+
+
+def step_rule_halo_full(ext: jax.Array, rule: Rule2D) -> jax.Array:
+    """One ``rule`` generation of a fully halo-extended block ``ext[h+2, w+2]``.
+
+    No wrap is applied — the halo ring (corners included) carries all
+    periodicity; the generic-rule analog of
+    :func:`gol_tpu.ops.stencil.step_halo_full`.  Shrinks by one layer on
+    both axes.
+    """
+    from gol_tpu.ops.life3d import rule3d
+
+    v = ext[:-2] + ext[1:-1] + ext[2:]
+    h3 = v[:, :-2] + v[:, 1:-1] + v[:, 2:]
+    center = ext[1:-1, 1:-1]
+    return rule3d(center, h3 - center, rule)
+
+
+def _rule_from_count9(packed: jax.Array, count9, rule: Rule2D) -> jax.Array:
+    """Generic rule on packed words from the 4-plane count-of-9.
+
+    Uses the +1 identity (see :func:`step_rule_packed`) so no borrow
+    ripple is needed.
+    """
     born = bitlife._match_counts(count9, rule.birth)
     keep = bitlife._match_counts(count9, {c + 1 for c in rule.survive})
     return (~packed & born) | (packed & keep)
+
+
+def step_rule_packed_vext(ext: jax.Array, rule: Rule2D) -> jax.Array:
+    """Generic-rule packed step of a row-halo-extended block ``ext[h+2, nw]``."""
+    s0, s1 = bitlife._row_hsum(ext)
+    count9 = bitlife._sum3_2bit(
+        (s0[:-2], s1[:-2]), (s0[1:-1], s1[1:-1]), (s0[2:], s1[2:])
+    )
+    return _rule_from_count9(ext[1:-1], count9, rule)
+
+
+def step_rule_packed_halo_full(ext: jax.Array, rule: Rule2D) -> jax.Array:
+    """Generic-rule packed step with ghost word columns ``ext[h+2, nw+2]``."""
+    s0, s1 = bitlife._row_hsum_ext(ext)
+    count9 = bitlife._sum3_2bit(
+        (s0[:-2], s1[:-2]), (s0[1:-1], s1[1:-1]), (s0[2:], s1[2:])
+    )
+    return _rule_from_count9(ext[1:-1, 1:-1], count9, rule)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=(0,))

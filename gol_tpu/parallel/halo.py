@@ -120,3 +120,40 @@ def blocked_local_loop(
         return x
 
     return local
+
+
+def build_ring_engine(
+    mesh,
+    steps: int,
+    halo_depth: int,
+    step_1d: Callable,
+    step_2d: Callable,
+    pack: Optional[Callable] = None,
+    unpack: Optional[Callable] = None,
+):
+    """jit'ed shard_map ring engine over a 1-D or 2-D board mesh.
+
+    The one builder behind the packed Conway engine and the generic-rule
+    engines: picks the row-only or row+column phase list from the mesh's
+    axes, wires the matching shrink-by-one ``step`` through
+    :func:`blocked_local_loop`, and returns the donated-input jitted
+    program.  Keeping this in one place means a change to the mesh-phase
+    or donation conventions cannot diverge between engines.
+    """
+    from gol_tpu.parallel.mesh import COLS, ROWS
+    from jax.sharding import PartitionSpec as P
+
+    num_rows = mesh.shape[ROWS]
+    num_cols = mesh.shape.get(COLS, 1)
+    if COLS in mesh.axis_names:
+        phases = ((0, ROWS, num_rows), (1, COLS, num_cols))
+        step, spec = step_2d, P(ROWS, COLS)
+    else:
+        phases = ((0, ROWS, num_rows),)
+        step, spec = step_1d, P(ROWS, None)
+
+    local = blocked_local_loop(
+        step, phases, steps, halo_depth, pack=pack, unpack=unpack
+    )
+    shmapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    return jax.jit(shmapped, donate_argnums=0)

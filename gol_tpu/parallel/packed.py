@@ -30,12 +30,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from gol_tpu.ops import bitlife
-from gol_tpu.parallel.halo import blocked_local_loop
-from gol_tpu.parallel.mesh import COLS, ROWS, validate_geometry
+from gol_tpu.parallel.halo import build_ring_engine
+from gol_tpu.parallel.mesh import COLS, validate_geometry
 from gol_tpu.parallel.sharded import (
     exchange_block_halos,
     exchange_row_halos,
@@ -103,25 +102,15 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
     still ~8× fewer bytes on the row axis, break-even on the word axis at
     k=1, and k× fewer ppermute latencies either way.
     """
-    two_d = COLS in mesh.axis_names
-    num_rows = mesh.shape[ROWS]
-    num_cols = mesh.shape.get(COLS, 1)
-
-    if two_d:
-        phases = ((0, ROWS, num_rows), (1, COLS, num_cols))
-        step = bitlife.step_packed_halo_full  # consumes a row + word-column
-        spec = P(ROWS, COLS)
-    else:
-        phases = ((0, ROWS, num_rows),)
-        step = bitlife.step_packed_vext  # consumes a row layer
-        spec = P(ROWS, None)
-
-    local = blocked_local_loop(
-        step, phases, steps, halo_depth,
-        pack=bitlife.pack, unpack=bitlife.unpack,
+    return build_ring_engine(
+        mesh,
+        steps,
+        halo_depth,
+        step_1d=bitlife.step_packed_vext,  # consumes a row layer
+        step_2d=bitlife.step_packed_halo_full,  # row + word-column layer
+        pack=bitlife.pack,
+        unpack=bitlife.unpack,
     )
-    shmapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
-    return jax.jit(shmapped, donate_argnums=0)
 
 
 def evolve_sharded_packed(board: jax.Array, steps: int, mesh: Mesh) -> jax.Array:

@@ -82,11 +82,13 @@ class GolRuntime:
             parsed = rules_mod.parse_rulestring(self.rule)
             if parsed != rules_mod.CONWAY:
                 # B3/S23 stays on the hard-wired fast paths; other rules
-                # run the generic evaluators (single-device, fresh halos).
-                if self.mesh is not None:
+                # run the generic evaluators (fresh halos; sharded via the
+                # explicit ring engine).
+                if self.mesh is not None and self.shard_mode != "explicit":
                     raise ValueError(
-                        "custom rules are single-device for now; drop --mesh "
-                        f"(got rule {parsed.rulestring()} with a mesh)"
+                        "custom rules shard via the explicit ring engine "
+                        f"only; shard_mode {self.shard_mode!r} is a "
+                        "Conway-specific program"
                     )
                 if self.halo_mode != "fresh":
                     raise ValueError(
@@ -180,8 +182,10 @@ class GolRuntime:
         if self.halo_mode != "fresh":
             return "dense"
         geom = (self.geometry.global_height, self.geometry.global_width)
-        if self._rule is not None:
-            # Generic rules have dense and packed evaluators only.
+        if self.mesh is None and self._rule is not None:
+            # Generic rules have dense and packed evaluators (no pallas);
+            # the mesh branch below is rule-agnostic — the ruled sharded
+            # engine exists in both dense and packed forms.
             from gol_tpu.ops import bitlife
 
             return "bitpack" if geom[1] % bitlife.BITS == 0 else "dense"
@@ -231,6 +235,20 @@ class GolRuntime:
         if self._rule is not None:
             from gol_tpu.ops import rules as rules_mod
 
+            if self.mesh is not None:
+                from gol_tpu.parallel import ruled
+
+                return (
+                    ruled.compiled_evolve_rule(
+                        self.mesh,
+                        steps,
+                        self._rule,
+                        name == "bitpack",
+                        self.halo_depth,
+                    ),
+                    (),
+                    (),
+                )
             if name == "bitpack":
                 return rules_mod.evolve_rule_dense_io, (), (steps, self._rule)
             return rules_mod.run_rule, (), (steps, self._rule)
