@@ -1,0 +1,62 @@
+"""Pallas 3-D kernel vs the XLA bit-packed 3-D engine (interpret mode on CPU)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.ops import bitlife3d, life3d, pallas_bitlife3d
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _rand_vol(d, h, w, seed=0):
+    return np.random.default_rng(seed).integers(0, 2, (d, h, w), np.uint8)
+
+
+@pytest.mark.parametrize("rule", [life3d.BAYS_4555, life3d.BAYS_5766])
+@pytest.mark.parametrize("steps", [1, 3])
+def test_matches_xla_packed(rule, steps):
+    vol = _rand_vol(16, 8, 64, seed=steps + len(rule.survive))
+    got = np.asarray(pallas_bitlife3d.evolve3d(jnp.asarray(vol), steps, rule))
+    ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), steps, rule))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_temporal_blocking_matches_sequential():
+    vol = _rand_vol(16, 8, 32, seed=9)
+    pt = jax.lax.bitcast_convert_type(
+        bitlife3d.pack3d(jnp.asarray(vol)), jnp.int32
+    ).transpose(0, 2, 1)
+    ref = pt
+    for _ in range(5):
+        ref = pallas_bitlife3d.multi_step_pallas_packed3d(ref, 8, 1)
+    got = pallas_bitlife3d.multi_step_pallas_packed3d(pt, 8, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_remainder_path():
+    vol = _rand_vol(8, 8, 32, seed=3)
+    got = np.asarray(pallas_bitlife3d.evolve3d(jnp.asarray(vol), 11))
+    ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 11))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_tile_and_depth_validation():
+    pt = jnp.zeros((16, 2, 32), jnp.int32)
+    with pytest.raises(ValueError, match="tile"):
+        pallas_bitlife3d.multi_step_pallas_packed3d(pt, 12, 1)
+    with pytest.raises(ValueError, match="pad"):
+        pallas_bitlife3d.multi_step_pallas_packed3d(pt, 8, 16)
+    with pytest.raises(ValueError, match=">= 1"):
+        pallas_bitlife3d.multi_step_pallas_packed3d(pt, 8, 0)
+    with pytest.raises(ValueError, match="divisible"):
+        pallas_bitlife3d.pick_tile3d(12, 2, 32)
+
+
+def test_pick_tile3d_budget():
+    assert pallas_bitlife3d.pick_tile3d(512, 16, 512) == 32
+    assert pallas_bitlife3d.pick_tile3d(16, 2, 32) == 16
