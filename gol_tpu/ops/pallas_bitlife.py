@@ -64,11 +64,15 @@ def _lsr(x: jax.Array, r: int) -> jax.Array:
     return (x >> r) & jnp.int32((1 << (32 - r)) - 1)
 
 
-def _one_generation(ext: jax.Array) -> jax.Array:
+def _one_generation(ext: jax.Array, rule=None) -> jax.Array:
     """One packed generation over an extended row window (shrinks by 2 rows).
 
     Per-row 3-cell horizontal sums once per extended row (bit planes),
     column wrap via a lane roll with carry bits crossing words by shifts.
+    ``rule=None`` runs the hard-wired B3/S23 tail (the reference's rule,
+    two ops cheaper); a ``Rule2D`` runs the generic plane matcher on the
+    count-of-9 with the +1 survive identity (see
+    :func:`gol_tpu.ops.rules.step_rule_packed`).
     """
     nw = ext.shape[1]
     prev_word = pltpu.roll(ext, 1, axis=1)
@@ -76,17 +80,21 @@ def _one_generation(ext: jax.Array) -> jax.Array:
     west = (ext << 1) | _lsr(prev_word, 31)
     east = _lsr(ext, 1) | (next_word << 31)
     s0, s1 = bitlife._full_add(west, ext, east)
-    return bitlife._rule_from_row_sums(
-        ext[1:-1],
-        (s0[:-2], s1[:-2]),
-        (s0[1:-1], s1[1:-1]),
-        (s0[2:], s1[2:]),
+    sa = (s0[:-2], s1[:-2])
+    sc = (s0[1:-1], s1[1:-1])
+    sb = (s0[2:], s1[2:])
+    if rule is None:
+        return bitlife._rule_from_row_sums(ext[1:-1], sa, sc, sb)
+    from gol_tpu.ops.rules import _rule_from_count9
+
+    return _rule_from_count9(
+        ext[1:-1], bitlife._sum3_2bit(sa, sc, sb), rule
     )
 
 
 def _kernel(
     packed_hbm, out_ref, scratch, sems, *, tile: int, height: int, k: int,
-    pad: int,
+    pad: int, rule=None,
 ):
     """k torus generations per VMEM residency (temporal blocking).
 
@@ -105,14 +113,18 @@ def _kernel(
     for j in range(k):
         a = pad - (k - j)
         b = pad + tile + (k - j)
-        scratch[a + 1 : b - 1] = _one_generation(scratch[a:b])
+        scratch[a + 1 : b - 1] = _one_generation(scratch[a:b], rule)
     out_ref[:] = scratch[pad : pad + tile]
 
 
 def multi_step_pallas_packed(
-    packed_i32: jax.Array, tile: int, k: int
+    packed_i32: jax.Array, tile: int, k: int, rule=None
 ) -> jax.Array:
-    """k fused torus generations on an int32-bitcast packed board [H, W/32]."""
+    """k fused torus generations on an int32-bitcast packed board [H, W/32].
+
+    ``rule`` (a :class:`gol_tpu.ops.rules.Rule2D`, hashable) switches the
+    kernel tail to the generic plane matcher; None keeps hard-wired B3/S23.
+    """
     height, nw = packed_i32.shape
     validate_tile(height, tile, _ALIGN)
     if k < 1:
@@ -125,7 +137,7 @@ def multi_step_pallas_packed(
     grid = height // tile
     return pl.pallas_call(
         functools.partial(
-            _kernel, tile=tile, height=height, k=k, pad=pad
+            _kernel, tile=tile, height=height, k=k, pad=pad, rule=rule
         ),
         grid=(grid,),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
@@ -153,16 +165,20 @@ _BLOCK = 16
 _BLOCK_TILE = 256
 
 
-def _pick_block(steps: int, tile: int) -> int:
-    """Largest supported temporal depth <= _BLOCK for this tile."""
-    k = min(_BLOCK, steps, tile)
+def _pick_block(steps: int, tile: int, block: int = _BLOCK) -> int:
+    """Largest supported temporal depth <= ``block`` for this tile.
+
+    Shared with the 3-D kernel (which passes its own smaller cap)."""
+    k = min(block, steps, tile)
     while k > 1 and -(-k // _ALIGN) * _ALIGN > tile:
         k -= 1
     return max(1, k)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=(0,))
-def evolve(board: jax.Array, steps: int, tile_hint: int = 512) -> jax.Array:
+@functools.partial(jax.jit, static_argnums=(1, 2, 3), donate_argnums=(0,))
+def evolve(
+    board: jax.Array, steps: int, tile_hint: int = 512, rule=None
+) -> jax.Array:
     """Dense uint8 in/out; pack, evolve fused-packed, unpack — one program.
 
     Generations run in temporally-blocked groups of up to ``_BLOCK`` per
@@ -187,8 +203,11 @@ def evolve(board: jax.Array, steps: int, tile_hint: int = 512) -> jax.Array:
     k = _pick_block(steps, tile)
     full, rem = divmod(steps, k)
     packed_i32 = lax.fori_loop(
-        0, full, lambda _, p: multi_step_pallas_packed(p, tile, k), packed_i32
+        0,
+        full,
+        lambda _, p: multi_step_pallas_packed(p, tile, k, rule),
+        packed_i32,
     )
     if rem:
-        packed_i32 = multi_step_pallas_packed(packed_i32, tile, rem)
+        packed_i32 = multi_step_pallas_packed(packed_i32, tile, rem, rule)
     return bitlife.unpack(lax.bitcast_convert_type(packed_i32, jnp.uint32))

@@ -38,6 +38,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import bitlife, bitlife3d
 from gol_tpu.ops.life3d import BAYS_4555, Rule3D
+from gol_tpu.ops.pallas_bitlife import _lsr, _pick_block
+from gol_tpu.ops.pallas_common import (
+    load_tile_with_halo,
+    pick_tile as _pick,
+    validate_tile,
+)
 
 _ALIGN = 8  # plane-axis DMA alignment for 32-bit data
 _LANE = 128  # Mosaic lane tiling: H must fill whole lane tiles
@@ -45,11 +51,6 @@ _LANE = 128  # Mosaic lane tiling: H must fill whole lane tiles
 # tree (Mosaic schedules the rest out of the live set): bytes per plane of
 # the tile, per (word, lane) element.
 _BYTES_PER_PLANE = 24
-
-
-def _lsr(x: jax.Array, r: int) -> jax.Array:
-    """Logical shift right on int32 lanes (mask off the sign extension)."""
-    return (x >> r) & jnp.int32((1 << (32 - r)) - 1)
 
 
 def _one_generation(
@@ -87,23 +88,10 @@ def _one_generation(
 def _kernel(
     vol_hbm, out_ref, scratch, sems, *, tile, depth, k, pad, birth, survive
 ):
-    i = pl.program_id(0)
-    start = pl.multiple_of(i * tile, _ALIGN)
-    top = pl.multiple_of(lax.rem(start - pad + depth, depth), _ALIGN)
-    bot = pl.multiple_of(lax.rem(start + tile, depth), _ALIGN)
-    body = pltpu.make_async_copy(
-        vol_hbm.at[pl.ds(start, tile)], scratch.at[pl.ds(pad, tile)], sems.at[0]
+    load_tile_with_halo(
+        vol_hbm, scratch, sems, pl.program_id(0),
+        tile=tile, height=depth, align=_ALIGN, pad=pad,
     )
-    t = pltpu.make_async_copy(
-        vol_hbm.at[pl.ds(top, pad)], scratch.at[pl.ds(0, pad)], sems.at[1]
-    )
-    b = pltpu.make_async_copy(
-        vol_hbm.at[pl.ds(bot, pad)],
-        scratch.at[pl.ds(pad + tile, pad)],
-        sems.at[2],
-    )
-    body.start(); t.start(); b.start()
-    body.wait(); t.wait(); b.wait()
     for j in range(k):
         lo = pad - (k - j)
         hi = pad + tile + (k - j)
@@ -118,11 +106,7 @@ def multi_step_pallas_packed3d(
 ) -> jax.Array:
     """k fused torus generations on a transposed packed volume [D, nw, H]."""
     depth, nw, h = packed_t.shape
-    if depth % tile or tile % _ALIGN:
-        raise ValueError(
-            f"tile {tile} must divide volume depth {depth} and be a "
-            f"multiple of {_ALIGN}"
-        )
+    validate_tile(depth, tile, _ALIGN)
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
     pad = -(-k // _ALIGN) * _ALIGN
@@ -157,22 +141,16 @@ def multi_step_pallas_packed3d(
 # Benchmarked on v5e at 512³: blocking is marginal (VPU-bound) but k=8
 # still wins slightly; the tile is VMEM-budget-limited.
 _BLOCK = 8
-_VMEM_BUDGET = 8 * 1024 * 1024
 
 
 def pick_tile3d(depth: int, nw: int, h: int) -> int:
-    """Largest _ALIGN-multiple divisor of depth whose working set fits VMEM."""
-    if depth % _ALIGN:
-        raise ValueError(
-            f"pallas 3-D engine needs volume depth divisible by {_ALIGN}, "
-            f"got {depth}"
-        )
-    budget = max(_ALIGN, _VMEM_BUDGET // max(1, _BYTES_PER_PLANE * nw * h))
-    cap = max(_ALIGN, min(depth, budget))
-    for tile in range(cap - cap % _ALIGN, 0, -_ALIGN):
-        if depth % tile == 0:
-            return tile
-    return _ALIGN
+    """Largest _ALIGN-multiple divisor of depth whose working set fits VMEM.
+
+    Delegates to the shared :func:`gol_tpu.ops.pallas_common.pick_tile`
+    with a plane "width" of nw*h elements and this kernel's live-bytes
+    estimate — one budget algorithm for the 2-D and 3-D kernels.
+    """
+    return _pick(depth, nw * h, depth, _ALIGN, _BYTES_PER_PLANE)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=(0,))
@@ -197,10 +175,7 @@ def evolve3d(
         bitlife3d.pack3d(vol), jnp.int32
     ).transpose(0, 2, 1)
     tile = pick_tile3d(d, nw, h)
-    k = min(_BLOCK, steps, tile)
-    while k > 1 and -(-k // _ALIGN) * _ALIGN > tile:
-        k -= 1
-    k = max(1, k)
+    k = _pick_block(steps, tile, _BLOCK)
     full, rem = divmod(steps, k)
     packed_t = lax.fori_loop(
         0,

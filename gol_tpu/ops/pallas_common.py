@@ -62,6 +62,10 @@ def load_tile_with_halo(
 ):
     """Fill ``scratch`` with [halo-pad | body tile | halo-pad] rows.
 
+    Rank-agnostic: slices are taken on the leading axis only, so the same
+    loader serves the 2-D kernels' [H, nw] row tiles and the 3-D kernel's
+    [D, nw, H] plane tiles.
+
     ``pad`` (default ``align``) is the halo depth in rows, a multiple of
     ``align`` and at most ``tile`` — deeper pads feed temporally-blocked
     kernels that run several generations per VMEM residency.  Scratch
@@ -87,18 +91,18 @@ def load_tile_with_halo(
     bot = pl.multiple_of(jax.lax.rem(start + tile, height), align)
 
     body_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(start, tile), :],
-        scratch.at[pl.ds(pad, tile), :],
+        board_hbm.at[pl.ds(start, tile)],
+        scratch.at[pl.ds(pad, tile)],
         sems.at[0],
     )
     top_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(top, pad), :],
-        scratch.at[pl.ds(0, pad), :],
+        board_hbm.at[pl.ds(top, pad)],
+        scratch.at[pl.ds(0, pad)],
         sems.at[1],
     )
     bot_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(bot, pad), :],
-        scratch.at[pl.ds(pad + tile, pad), :],
+        board_hbm.at[pl.ds(bot, pad)],
+        scratch.at[pl.ds(pad + tile, pad)],
         sems.at[2],
     )
     body_dma.start()

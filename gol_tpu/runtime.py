@@ -95,10 +95,11 @@ class GolRuntime:
                         "custom rules have no stale_t0 reference-compat mode "
                         "(the reference only implements B3/S23)"
                     )
-                if self.engine in ("pallas", "pallas_bitpack"):
+                if self.engine == "pallas":
                     raise ValueError(
-                        f"engine {self.engine!r} is hard-wired to B3/S23; "
-                        "use 'auto'/'dense'/'bitpack' with a custom rule"
+                        "engine 'pallas' (dense kernel) is hard-wired to "
+                        "B3/S23; use 'auto'/'dense'/'bitpack'/"
+                        "'pallas_bitpack' with a custom rule"
                     )
                 self._rule = parsed
         self._resolved = (
@@ -182,13 +183,6 @@ class GolRuntime:
         if self.halo_mode != "fresh":
             return "dense"
         geom = (self.geometry.global_height, self.geometry.global_width)
-        if self.mesh is None and self._rule is not None:
-            # Generic rules have dense and packed evaluators (no pallas);
-            # the mesh branch below is rule-agnostic — the ruled sharded
-            # engine exists in both dense and packed forms.
-            from gol_tpu.ops import bitlife
-
-            return "bitpack" if geom[1] % bitlife.BITS == 0 else "dense"
         if self.mesh is not None:
             if self.shard_mode != "explicit":
                 return "dense"
@@ -248,6 +242,21 @@ class GolRuntime:
                     ),
                     (),
                     (),
+                )
+            if name == "pallas_bitpack":
+                try:
+                    from gol_tpu.ops import pallas_bitlife
+                except ImportError as e:
+                    # Same friendly-error contract as the Conway dispatch
+                    # below for the identical engine selection.
+                    raise ValueError(
+                        f"engine {name!r} is not available: {e}"
+                    ) from e
+
+                return (
+                    pallas_bitlife.evolve,
+                    (),
+                    (steps, self.tile_hint, self._rule),
                 )
             if name == "bitpack":
                 return rules_mod.evolve_rule_dense_io, (), (steps, self._rule)
