@@ -1,0 +1,213 @@
+"""3-D Life driver: the volume counterpart of the 2-D reference CLI.
+
+A capability addition (the reference is strictly 2-D), styled after the
+same surface so the two drivers feel like one tool:
+
+    python -m gol_tpu.cli3d <pattern> <size> <iterations> <threads> <on_off>
+        [--rule NAME|B../S..] [--engine {auto,dense,bitpack,pallas}]
+        [--mesh {none,3d}] [--outdir DIR]
+
+Patterns: 0 all-zeros, 1 all-ones, 2 random (density 0.3, fixed seed 0 —
+deterministic across engines and meshes).  ``size`` is the cube edge
+D = H = W; ``threads`` is accepted for surface parity with the 2-D driver
+and validated (>0, fixing the reference's bug-B5 class) but tiling is
+chosen automatically by the engines.
+Rules default to Bays 4555 (named: ``bays4555``, ``bays5766``, or any
+``B<counts>/S<counts>`` with comma-separated multi-digit counts, e.g.
+``B5/S4,5``).  With ``on_off=1`` the final volume is written to
+``World3D_of_<n>.npy`` in ``--outdir`` (NumPy format — there is no
+reference 3-D dump format to match).
+
+Prints the reference-style duration line plus the live-cell population
+(the 3-D analog of eyeballing rank dumps).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+from gol_tpu.cli import atoi
+
+ENGINES3D = ("auto", "dense", "bitpack", "pallas")
+
+USAGE3D = (
+    "gol3d requires 5 arguments: pattern number (0 zeros, 1 ones, 2 "
+    "random), cube edge size, iterations, threads per block and "
+    "output-on-off e.g. python -m gol_tpu.cli3d 2 64 10 512 0 \n"
+)
+
+_RULE3D_RE = re.compile(r"^B([\d,]*)/S([\d,]*)$", re.IGNORECASE)
+
+
+def parse_rule3d(text: str):
+    """Named rule or ``B<counts>/S<counts>`` (comma-separated counts 0-26)."""
+    from gol_tpu.ops import life3d
+
+    named = {"bays4555": life3d.BAYS_4555, "bays5766": life3d.BAYS_5766}
+    if text.lower() in named:
+        return named[text.lower()]
+    m = _RULE3D_RE.match(text.strip())
+    if not m:
+        raise ValueError(
+            f"malformed 3-D rule {text!r}; expected a name "
+            f"({', '.join(sorted(named))}) or B<counts>/S<counts> with "
+            "comma-separated counts, e.g. B5/S4,5"
+        )
+
+    def counts(group: str):
+        return frozenset(int(t) for t in group.split(",") if t)
+
+    rule = life3d.Rule3D(birth=counts(m.group(1)), survive=counts(m.group(2)))
+    if any(c > 26 for c in rule.birth | rule.survive):
+        raise ValueError(f"3-D rule {text!r} has counts > 26")
+    return rule
+
+
+def init_volume(pattern: int, size: int) -> np.ndarray:
+    if pattern == 0:
+        return np.zeros((size, size, size), np.uint8)
+    if pattern == 1:
+        return np.ones((size, size, size), np.uint8)
+    if pattern == 2:
+        rng = np.random.default_rng(0)
+        return (rng.random((size, size, size)) < 0.3).astype(np.uint8)
+    raise ValueError(f"Pattern {pattern} has not been implemented")
+
+
+def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
+    """(compiled, place) for the chosen engine/mesh.
+
+    ``compiled`` is AOT-lowered from a ShapeDtypeStruct — like
+    ``GolRuntime.compile_evolvers``, compilation never executes a throwaway
+    evolution — and donates its input; ``place`` puts the host volume on
+    device(s) with the sharding the compiled program expects.
+    """
+    import jax
+
+    spec_shape = (size, size, size)
+    if mesh is not None:
+        from gol_tpu.parallel import sharded3d
+
+        if engine == "pallas":
+            raise ValueError("engine 'pallas' is single-device; drop --mesh")
+        packable = True
+        try:
+            sharded3d.validate_geometry3d_packed(spec_shape, mesh)
+        except ValueError:
+            packable = False
+        if engine == "bitpack" and not packable:
+            raise ValueError(
+                "engine 'bitpack' needs the x-shard width to pack into "
+                f"whole 32-cell words (size {size} over mesh "
+                f"{dict(mesh.shape)})"
+            )
+        if packable and engine in ("auto", "bitpack"):
+            fn = sharded3d.compiled_evolve3d_packed(mesh, steps, rule)
+        else:
+            sharded3d.validate_geometry3d(spec_shape, mesh)
+            fn = sharded3d.compiled_evolve3d(mesh, steps, rule)
+        sharding = sharded3d.volume_sharding(mesh)
+        spec = jax.ShapeDtypeStruct(spec_shape, np.uint8, sharding=sharding)
+        place = lambda v: jax.device_put(v, sharding)
+        return fn.lower(spec).compile(), place
+
+    if engine == "auto":
+        if (
+            jax.default_backend() == "tpu"
+            and size % 128 == 0
+            and size % 32 == 0
+        ):
+            engine = "pallas"
+        elif size % 32 == 0:
+            engine = "bitpack"
+        else:
+            engine = "dense"
+    if engine == "pallas":
+        from gol_tpu.ops import pallas_bitlife3d
+
+        fn = pallas_bitlife3d.evolve3d
+    elif engine == "bitpack":
+        from gol_tpu.ops import bitlife3d
+
+        fn = bitlife3d.evolve3d_dense_io
+    else:
+        from gol_tpu.ops import life3d
+
+        fn = life3d.run3d
+    spec = jax.ShapeDtypeStruct(spec_shape, np.uint8)
+    return fn.lower(spec, steps, rule).compile(), jax.device_put
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ext = argparse.ArgumentParser(prog="gol3d", add_help=True)
+    ext.add_argument("positionals", nargs="*", metavar="ARG")
+    ext.add_argument("--rule", default="bays4555")
+    ext.add_argument("--engine", choices=ENGINES3D, default="auto")
+    ext.add_argument("--mesh", choices=["none", "3d"], default="none")
+    ext.add_argument("--outdir", default=".")
+    ns = ext.parse_args(argv)
+    if len(ns.positionals) != 5:
+        sys.stdout.write(USAGE3D)
+        return 255
+    pattern = atoi(ns.positionals[0])
+    size = atoi(ns.positionals[1])
+    iterations = atoi(ns.positionals[2])
+    threads = atoi(ns.positionals[3])
+    on_off = atoi(ns.positionals[4])
+
+    try:
+        if size <= 0:
+            raise ValueError(f"size must be positive, got {size}")
+        if iterations < 0:
+            raise ValueError(f"iterations must be >= 0, got {iterations}")
+        if threads <= 0:
+            raise ValueError(f"threads per block must be positive, got {threads}")
+        rule = parse_rule3d(ns.rule)
+        vol = init_volume(pattern, size)
+
+        mesh = None
+        if ns.mesh == "3d":
+            from gol_tpu.parallel import mesh as mesh_mod
+
+            mesh = mesh_mod.make_mesh_3d()
+
+        from gol_tpu.utils.timing import Stopwatch, force_ready
+
+        sw = Stopwatch()
+        if iterations > 0:
+            with sw.phase("compile"):
+                compiled, place = _build_evolver(
+                    ns.engine, mesh, iterations, rule, size
+                )
+                board = place(vol)
+                force_ready(board)
+            with sw.phase("total"):
+                out = compiled(board)
+                force_ready(out)
+        else:
+            out = vol
+        out_np = np.asarray(out)
+    except ValueError as e:
+        print(e)
+        return 255
+
+    report = sw.report(size**3 * iterations)
+    print(report.duration_line())
+    print(f"POPULATION     : {int(out_np.sum())} live cells of {size**3}")
+    print("This is 3-D Life running on a TPU (capability addition).")
+    if on_off == 1:
+        os.makedirs(ns.outdir, exist_ok=True)
+        path = os.path.join(ns.outdir, "World3D_of_1.npy")
+        np.save(path, out_np)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,12 +165,14 @@ _BLOCK = 16
 _BLOCK_TILE = 256
 
 
-def _pick_block(steps: int, tile: int, block: int = _BLOCK) -> int:
+def _pick_block(
+    steps: int, tile: int, block: int = _BLOCK, align: int = _ALIGN
+) -> int:
     """Largest supported temporal depth <= ``block`` for this tile.
 
-    Shared with the 3-D kernel (which passes its own smaller cap)."""
+    Shared with the 3-D kernel (which passes its own cap and alignment)."""
     k = min(block, steps, tile)
-    while k > 1 and -(-k // _ALIGN) * _ALIGN > tile:
+    while k > 1 and -(-k // align) * align > tile:
         k -= 1
     return max(1, k)
 

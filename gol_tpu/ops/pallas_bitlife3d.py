@@ -175,7 +175,7 @@ def evolve3d(
         bitlife3d.pack3d(vol), jnp.int32
     ).transpose(0, 2, 1)
     tile = pick_tile3d(d, nw, h)
-    k = _pick_block(steps, tile, _BLOCK)
+    k = _pick_block(steps, tile, _BLOCK, _ALIGN)
     full, rem = divmod(steps, k)
     packed_t = lax.fori_loop(
         0,

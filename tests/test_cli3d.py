@@ -1,0 +1,87 @@
+"""3-D driver surface: engines agree, dumps load, validation fires."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu import cli3d
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def test_parse_rule3d():
+    r = cli3d.parse_rule3d("bays4555")
+    assert r.birth == frozenset({5}) and r.survive == frozenset({4, 5})
+    r = cli3d.parse_rule3d("B5,6/S4,5,26")
+    assert r.birth == frozenset({5, 6})
+    assert r.survive == frozenset({4, 5, 26})
+    with pytest.raises(ValueError, match="malformed"):
+        cli3d.parse_rule3d("5/45")
+    with pytest.raises(ValueError, match="> 26"):
+        cli3d.parse_rule3d("B27/S")
+
+
+@pytest.mark.parametrize("engine", ["dense", "bitpack"])
+def test_engines_agree_on_dump(tmp_path, engine, capsys):
+    rc = cli3d.main(
+        ["2", "32", "3", "64", "1", "--engine", engine, "--outdir",
+         str(tmp_path / engine)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "TOTAL DURATION" in out and "POPULATION" in out
+
+
+def test_engine_dumps_are_identical(tmp_path):
+    for engine in ("dense", "bitpack"):
+        assert (
+            cli3d.main(
+                ["2", "32", "3", "64", "1", "--engine", engine, "--outdir",
+                 str(tmp_path / engine)]
+            )
+            == 0
+        )
+    a = np.load(tmp_path / "dense" / "World3D_of_1.npy")
+    b = np.load(tmp_path / "bitpack" / "World3D_of_1.npy")
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_3d_cli_matches_single(tmp_path):
+    assert (
+        cli3d.main(
+            ["2", "32", "2", "64", "1", "--mesh", "3d", "--outdir",
+             str(tmp_path / "mesh")]
+        )
+        == 0
+    )
+    assert (
+        cli3d.main(
+            ["2", "32", "2", "64", "1", "--engine", "dense", "--outdir",
+             str(tmp_path / "single")]
+        )
+        == 0
+    )
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "mesh" / "World3D_of_1.npy"),
+        np.load(tmp_path / "single" / "World3D_of_1.npy"),
+    )
+
+
+def test_validation(capsys):
+    assert cli3d.main(["9", "16", "1", "64", "0"]) == 255
+    assert "not been implemented" in capsys.readouterr().out
+    assert cli3d.main(["2", "16", "1", "64", "0", "--rule", "wat"]) == 255
+    assert cli3d.main(["2", "16", "1", "0", "0"]) == 255
+    assert cli3d.main(["2", "16"]) == 255  # wrong arg count -> usage
+
+
+def test_zero_iterations(tmp_path, capsys):
+    rc = cli3d.main(
+        ["1", "16", "0", "64", "1", "--outdir", str(tmp_path)]
+    )
+    assert rc == 0
+    vol = np.load(tmp_path / "World3D_of_1.npy")
+    assert vol.sum() == 16**3
