@@ -159,6 +159,31 @@ _WORKER = textwrap.dedent(
     """
 )
 
+# 2-D mesh over 2 processes with a single logical rank: the rank's rows
+# span both hosts, so neither covers it alone — the dump must take the
+# collective gather fallback (process 0 writes).  --guard-every exercises
+# the audit + last-good snapshotting across processes (replicated scalars,
+# fetch_global all-gathers).
+_WORKER_2D_GUARDED = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import cli
+    pid = sys.argv[1]
+    rc = cli.main([
+        "4", "16", "5", "16", "1",
+        "--ranks", "1", "--mesh", "2d",
+        "--coordinator", sys.argv[2],
+        "--num-processes", "2", "--process-id", pid,
+        "--outdir", sys.argv[3],
+        "--guard-every", "2",
+    ])
+    sys.exit(rc)
+    """
+)
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -166,34 +191,49 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_two_workers(worker_src: str, argv_tail) -> list:
+    """Launch two coordinator-connected worker processes, return
+    [(rc, stdout, stderr), ...].  Workers are killed on timeout/failure so
+    a deadlocked jax.distributed barrier can't leak processes holding the
+    port for the rest of the session."""
+    coord = f"localhost:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers pick their own device counts
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker_src, str(i), coord, *argv_tail],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=repo,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    return outs
+
+
 def test_two_process_cli_matches_single_process(tmp_path):
     """Full CLI across 2 processes (4 global devices): ppermute halo rings
     over the process boundary, per-host rank-file writes, a multi-host
     checkpoint — outputs byte-identical to the single-process run."""
-    coord = f"localhost:{_free_port()}"
     out_mh = tmp_path / "mh"
     out_sp = tmp_path / "sp"
     ckpt = tmp_path / "ckpt"
     out_mh.mkdir()
 
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # workers pick their own device counts
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(i), coord, str(out_mh), str(ckpt)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            env=env,
-            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        outs.append((p.returncode, out.decode(), err.decode()))
-    for rc, out, err in outs:
-        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    outs = _run_two_workers(_WORKER, [str(out_mh), str(ckpt)])
 
     # Only the coordinator reports (reference: rank 0, gol-main.c:121-128).
     assert "TOTAL DURATION" in outs[0][1]
@@ -219,3 +259,27 @@ def test_two_process_cli_matches_single_process(tmp_path):
     snap = ckpt_mod.load(ckpt_mod.checkpoint_path(str(ckpt), 3))
     assert snap.generation == 3
     assert snap.board.shape == (32, 8)
+
+
+def test_two_process_2d_mesh_guarded_gather_dump(tmp_path):
+    """2-D mesh across 2 processes + --guard-every: the single rank's rows
+    span both hosts, forcing the collective gather-fallback dump; audits
+    and last-good snapshots run multi-process.  Output byte-matches the
+    single-process run."""
+    out_mh = tmp_path / "mh"
+    out_sp = tmp_path / "sp"
+    out_mh.mkdir()
+
+    outs = _run_two_workers(_WORKER_2D_GUARDED, [str(out_mh)])
+    assert "GUARD          : 3 checks, 0 failures, 0 restores" in outs[0][1]
+    assert "GUARD" not in outs[1][1]  # only the coordinator reports
+
+    from gol_tpu import cli
+
+    assert (
+        cli.main(["4", "16", "5", "16", "1", "--ranks", "1", "--outdir",
+                  str(out_sp)])
+        == 0
+    )
+    name = gol_io.rank_filename(0, 1)
+    assert (out_mh / name).read_bytes() == (out_sp / name).read_bytes()
