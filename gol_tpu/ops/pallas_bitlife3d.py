@@ -39,18 +39,10 @@ from jax.experimental.pallas import tpu as pltpu
 from gol_tpu.ops import bitlife, bitlife3d
 from gol_tpu.ops.life3d import BAYS_4555, Rule3D
 from gol_tpu.ops.pallas_bitlife import _lsr, _pick_block
-from gol_tpu.ops.pallas_common import (
-    load_tile_with_halo,
-    pick_tile as _pick,
-    validate_tile,
-)
+from gol_tpu.ops.pallas_common import load_tile_with_halo, validate_tile
 
 _ALIGN = 8  # plane-axis DMA alignment for 32-bit data
 _LANE = 128  # Mosaic lane tiling: H must fill whole lane tiles
-# ~6 live int32 [tile, nw, H] temporaries at any point in the fused adder
-# tree (Mosaic schedules the rest out of the live set): bytes per plane of
-# the tile, per (word, lane) element.
-_BYTES_PER_PLANE = 24
 
 
 def _one_generation(
@@ -141,16 +133,36 @@ def multi_step_pallas_packed3d(
 # Benchmarked on v5e at 512³: blocking is marginal (VPU-bound) but k=8
 # still wins slightly; the tile is VMEM-budget-limited.
 _BLOCK = 8
+# Scoped-VMEM feasibility model, calibrated against the compiler: ~9 live
+# int32 arrays of the full halo-extended window at the scheduler's peak
+# (the 1024³ failure measured 26.8 MB for a 24-plane window of 32×1024
+# words — 9 × 24 × 32768 × 4 = 28 MB predicts it; 512³'s 48-plane window
+# of 8192 words predicts 14 MB, which compiles).  Mosaic's hard scoped
+# limit is 16 MB.
+_SCOPED_LIMIT = 16 * 1024 * 1024
+_LIVE_WINDOWS = 9
 
 
-def pick_tile3d(depth: int, nw: int, h: int) -> int:
-    """Largest _ALIGN-multiple divisor of depth whose working set fits VMEM.
+def pick_tile3d(depth: int, nw: int, h: int, pad: int = _ALIGN) -> int:
+    """Largest _ALIGN-multiple divisor of ``depth`` whose halo-extended
+    window (tile + 2*pad planes of nw×h words) fits scoped VMEM.
 
-    Delegates to the shared :func:`gol_tpu.ops.pallas_common.pick_tile`
-    with a plane "width" of nw*h elements and this kernel's live-bytes
-    estimate — one budget algorithm for the 2-D and 3-D kernels.
+    Returns 0 when no tile fits — a single plane is too large (huge
+    ``nw*h``); callers fall back to the XLA packed path.
     """
-    return _pick(depth, nw * h, depth, _ALIGN, _BYTES_PER_PLANE)
+    if depth % _ALIGN:
+        raise ValueError(
+            f"pallas 3-D engine needs volume depth divisible by {_ALIGN}, "
+            f"got {depth}"
+        )
+    max_window = _SCOPED_LIMIT // (_LIVE_WINDOWS * 4 * nw * h)
+    cap = min(max_window - 2 * pad, depth)
+    if cap < _ALIGN:
+        return 0
+    for tile in range(cap - cap % _ALIGN, 0, -_ALIGN):
+        if depth % tile == 0:
+            return tile
+    return 0
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2), donate_argnums=(0,))
@@ -171,10 +183,17 @@ def evolve3d(
                 "pallas 3-D engine needs the H axis to fill whole "
                 f"{_LANE}-lane tiles on TPU: got H={h}"
             )
+    tile = pick_tile3d(d, nw, h)
+    if tile == 0:
+        # A single (nw, H) word plane is too large for the scoped-VMEM
+        # window (e.g. 1024³): take the XLA packed path instead — same
+        # bit-exact result, still one compiled program.
+        return bitlife3d.unpack3d(
+            bitlife3d.run3d_packed(bitlife3d.pack3d(vol), steps, rule)
+        )
     packed_t = lax.bitcast_convert_type(
         bitlife3d.pack3d(vol), jnp.int32
     ).transpose(0, 2, 1)
-    tile = pick_tile3d(d, nw, h)
     k = _pick_block(steps, tile, _BLOCK, _ALIGN)
     full, rem = divmod(steps, k)
     packed_t = lax.fori_loop(
