@@ -25,11 +25,11 @@ path (:mod:`gol_tpu.ops.bitlife`) hard-wires B3/S23, mirroring the
 reference's kernel (gol-with-cuda.cu:239-257).
 
 ~3 bitwise ops/cell per generation vs ~13 byte-wide ops/cell dense, at
-1/8th the HBM traffic.  Measured on one v5e chip at 512³ via the XLA
-lowering: 1.64e10 vs 1.13e10 cell-updates/s dense (1.46×) — XLA
-materializes the plane temporaries between fusions, so the full 8× is
-left to a future Pallas fusion of the adder tree (the 2-D engine's
-:mod:`gol_tpu.ops.pallas_bitlife` treatment).
+1/8th the HBM traffic.  Measured on one v5e chip via the XLA lowering:
+3.4e10 cell-updates/s at 512³ (~3× dense), 5.6e10 at 1024³ — XLA
+materializes the plane temporaries between fusions, which the fused
+kernel (:mod:`gol_tpu.ops.pallas_bitlife3d`) avoids where its plane
+window fits VMEM.
 """
 
 from __future__ import annotations
