@@ -1,0 +1,108 @@
+"""Property-based tests (Hypothesis) — SURVEY §4's prescription.
+
+Random geometries (including non-square, the reference's blind spot — its
+index math is square-only, bugs B3/B4) and random boards, checked against
+the structurally-independent NumPy oracle and against algebraic properties
+of the torus step itself:
+
+- engine == oracle on arbitrary boards/steps;
+- composition: ``run(b, m+n) == run(run(b, m), n)``;
+- symmetry equivariance: the torus is homogeneous and isotropic, so the
+  step commutes with translations (rolls), transposition, and flips;
+- packed == dense wherever the width packs.
+
+Each property is a whole family of tests the example-based suite samples
+only pointwise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.ops import bitlife, stencil
+
+from tests import oracle
+
+jax.config.update("jax_platforms", "cpu")
+
+_SETTINGS = dict(max_examples=25, deadline=None)
+
+
+def _board(h, w, seed):
+    return oracle.random_board(h, w, seed=seed)
+
+
+dims = st.integers(min_value=4, max_value=48)
+seeds = st.integers(min_value=0, max_value=2**31 - 1)
+steps = st.integers(min_value=0, max_value=6)
+
+
+@given(h=dims, w=dims, seed=seeds, n=steps)
+@settings(**_SETTINGS)
+def test_stencil_matches_oracle_any_geometry(h, w, seed, n):
+    board = _board(h, w, seed)
+    got = np.asarray(stencil.run(jnp.asarray(board), n))
+    np.testing.assert_array_equal(got, oracle.run_torus(board, n))
+
+
+@given(h=dims, w=dims, seed=seeds, m=steps, n=steps)
+@settings(**_SETTINGS)
+def test_step_composition(h, w, seed, m, n):
+    board = jnp.asarray(_board(h, w, seed))
+    a = stencil.run(jnp.array(board, copy=True), m + n)
+    b = stencil.run(stencil.run(jnp.array(board, copy=True), m), n)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@given(h=dims, w=dims, seed=seeds, dy=st.integers(-8, 8), dx=st.integers(-8, 8))
+@settings(**_SETTINGS)
+def test_translation_equivariance(h, w, seed, dy, dx):
+    """step(roll(b)) == roll(step(b)) — the torus has no special origin."""
+    board = _board(h, w, seed)
+    rolled = np.roll(board, (dy, dx), axis=(0, 1))
+    a = np.asarray(stencil.step(jnp.asarray(rolled)))
+    b = np.roll(np.asarray(stencil.step(jnp.asarray(board))), (dy, dx), (0, 1))
+    np.testing.assert_array_equal(a, b)
+
+
+@given(h=dims, w=dims, seed=seeds)
+@settings(**_SETTINGS)
+def test_symmetry_equivariance(h, w, seed):
+    """The 8-neighbor rule is isotropic: step commutes with transpose/flips."""
+    board = _board(h, w, seed)
+    stepped = np.asarray(stencil.step(jnp.asarray(board)))
+    np.testing.assert_array_equal(
+        np.asarray(stencil.step(jnp.asarray(board.T))), stepped.T
+    )
+    for axis in (0, 1):
+        np.testing.assert_array_equal(
+            np.asarray(stencil.step(jnp.asarray(np.flip(board, axis)))),
+            np.flip(stepped, axis),
+        )
+
+
+@given(h=dims, words=st.integers(1, 3), seed=seeds, n=st.integers(1, 4))
+@settings(**_SETTINGS)
+def test_packed_matches_dense_property(h, words, seed, n):
+    board = _board(h, words * bitlife.BITS, seed)
+    got = np.asarray(bitlife.evolve_dense_io(jnp.asarray(board), n))
+    ref = np.asarray(stencil.run(jnp.asarray(board), n))
+    np.testing.assert_array_equal(got, ref)
+
+
+@given(h=dims, w=dims)
+@settings(**_SETTINGS)
+def test_dead_board_stays_dead(h, w):
+    board = jnp.zeros((h, w), jnp.uint8)
+    assert int(np.asarray(stencil.run(board, 3)).sum()) == 0
+
+
+@given(h=st.integers(4, 32), w=st.integers(4, 32))
+@settings(**_SETTINGS)
+def test_full_board_dies_of_overpopulation(h, w):
+    board = jnp.ones((h, w), jnp.uint8)
+    assert int(np.asarray(stencil.step(board)).sum()) == 0
