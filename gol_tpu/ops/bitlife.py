@@ -207,6 +207,40 @@ def _row_hsum_ext(rows: jax.Array):
     return _full_add(west, cur, east)
 
 
+def step_packed_overlap_rows(
+    block: jax.Array, top: jax.Array, bottom: jax.Array
+) -> jax.Array:
+    """Packed row-sharded step structured for comm/compute overlap.
+
+    The packed analog of :func:`gol_tpu.ops.stencil.step_halo_rows_overlap`:
+    interior rows (1..h-2) are computed from the local block alone — their
+    horizontal bit-plane sums have no data dependency on the exchange that
+    delivered ``top``/``bottom`` — so XLA's latency-hiding scheduler can run
+    the ring ppermutes concurrently with the bulk of the adder tree; only
+    the two boundary rows wait.  Local horizontal sums are computed once
+    and reused by both interior and boundary rows.
+    """
+    h = block.shape[0]
+    if h < 3:
+        # Every row is a boundary row; nothing to overlap.
+        ext = jnp.concatenate([top[None], block, bottom[None]], axis=0)
+        return step_packed_vext(ext)
+    s0, s1 = _row_hsum(block)
+    t = _row_hsum(top)  # depends on the exchange
+    b = _row_hsum(bottom)
+    interior = _rule_from_row_sums(
+        block[1:-1],
+        (s0[:-2], s1[:-2]),
+        (s0[1:-1], s1[1:-1]),
+        (s0[2:], s1[2:]),
+    )
+    row0 = _rule_from_row_sums(block[0], t, (s0[0], s1[0]), (s0[1], s1[1]))
+    rown = _rule_from_row_sums(
+        block[-1], (s0[-2], s1[-2]), (s0[-1], s1[-1]), b
+    )
+    return jnp.concatenate([row0[None], interior, rown[None]], axis=0)
+
+
 def step_packed_halo_full(ext: jax.Array) -> jax.Array:
     """One packed generation given a fully halo-extended block.
 

@@ -30,11 +30,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.ops import bitlife
 from gol_tpu.parallel.halo import build_ring_engine
-from gol_tpu.parallel.mesh import COLS, validate_geometry
+from gol_tpu.parallel.mesh import COLS, ROWS, validate_geometry
 from gol_tpu.parallel.sharded import (
     exchange_block_halos,
     exchange_row_halos,
@@ -82,6 +83,39 @@ def step_packed_halo_blocks(
     """
     ext = exchange_block_halos(block, num_rows, num_cols)  # [h+2, nw+2]
     return bitlife.step_packed_halo_full(ext)
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_evolve_packed_overlap(mesh: Mesh, steps: int):
+    """Packed 1-D ring evolve in comm/compute-overlap form.
+
+    Counterpart of the dense engine's ``--shard-mode overlap``
+    (:func:`gol_tpu.parallel.sharded.compiled_evolve`): interior rows
+    never wait on the halo ppermutes.  1-D row meshes only — the 2-D
+    packed boundary ring needs word-carry edge columns whose overlap form
+    has no payoff at word granularity.  Single-layer halos (overlap's
+    interior/boundary split assumes depth 1).
+    """
+    if COLS in mesh.axis_names:
+        raise ValueError(
+            "packed overlap mode is 1-D (row-ring) only; use shard_mode "
+            "'explicit' on 2-D meshes"
+        )
+    num_rows = mesh.shape[ROWS]
+
+    def body(_, blk):
+        top, bottom = exchange_row_halos(blk, num_rows)
+        return bitlife.step_packed_overlap_rows(blk, top, bottom)
+
+    def local(board):
+        packed = bitlife.pack(board)
+        packed = lax.fori_loop(0, steps, body, packed)
+        return bitlife.unpack(packed)
+
+    shmapped = jax.shard_map(
+        local, mesh=mesh, in_specs=P(ROWS, None), out_specs=P(ROWS, None)
+    )
+    return jax.jit(shmapped, donate_argnums=0)
 
 
 @functools.lru_cache(maxsize=64)

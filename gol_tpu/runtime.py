@@ -153,11 +153,20 @@ class GolRuntime:
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
             if self._resolved == "bitpack":
-                if self.shard_mode != "explicit":
+                if self.shard_mode == "auto":
                     raise ValueError(
-                        "the bit-packed sharded engine has only the explicit "
-                        "shard_map+ppermute program; shard_mode "
-                        f"{self.shard_mode!r} applies to engine 'dense'/'auto'"
+                        "the bit-packed sharded engine has no auto-SPMD "
+                        "program; shard_mode 'auto' applies to engine "
+                        "'dense'"
+                    )
+                if (
+                    self.shard_mode == "overlap"
+                    and mesh_mod.COLS in self.mesh.axis_names
+                ):
+                    raise ValueError(
+                        "packed overlap mode is 1-D (row-ring) only; use "
+                        "shard_mode 'explicit' on 2-D meshes or engine "
+                        "'dense'"
                     )
                 packed_mod.validate_packed_geometry(shape, self.mesh)
             else:
@@ -184,8 +193,13 @@ class GolRuntime:
             return "dense"
         geom = (self.geometry.global_height, self.geometry.global_width)
         if self.mesh is not None:
-            if self.shard_mode != "explicit":
-                return "dense"
+            if self.shard_mode == "auto":
+                return "dense"  # auto-SPMD exists for the dense step only
+            if (
+                self.shard_mode == "overlap"
+                and mesh_mod.COLS in self.mesh.axis_names
+            ):
+                return "dense"  # packed overlap is 1-D only
             try:
                 packed_mod.validate_packed_geometry(geom, self.mesh)
             except ValueError:
@@ -283,6 +297,14 @@ class GolRuntime:
         try:
             if name == "bitpack":
                 if self.mesh is not None:
+                    if self.shard_mode == "overlap":
+                        return (
+                            packed_mod.compiled_evolve_packed_overlap(
+                                self.mesh, steps
+                            ),
+                            (),
+                            (),
+                        )
                     return (
                         packed_mod.compiled_evolve_packed(
                             self.mesh, steps, self.halo_depth

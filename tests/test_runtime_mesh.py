@@ -132,7 +132,7 @@ def test_runtime_deep_halo_rejections():
 
 
 def test_runtime_bitpack_mesh_rejects_auto_shard_mode():
-    with pytest.raises(ValueError, match="explicit"):
+    with pytest.raises(ValueError, match="auto-SPMD"):
         GolRuntime(
             geometry=Geometry(size=32, num_ranks=1),
             engine="bitpack",
@@ -222,11 +222,18 @@ def test_auto_engine_resolution():
         geometry=Geometry(size=16, num_ranks=4), mesh=mesh_mod.make_mesh_1d(4)
     )
     assert rt._resolved == "dense"
-    # Overlap/auto shard modes are dense-only programs.
+    # Overlap on a packable 1-D ring now has a packed program; auto-SPMD
+    # remains a dense-only program.
     rt = GolRuntime(
         geometry=Geometry(size=64, num_ranks=4),
         mesh=mesh_mod.make_mesh_1d(4),
         shard_mode="overlap",
+    )
+    assert rt._resolved == "bitpack"
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=4),
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="auto",
     )
     assert rt._resolved == "dense"
 

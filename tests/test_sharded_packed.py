@@ -101,3 +101,56 @@ def test_caller_board_not_consumed():
     np.testing.assert_array_equal(
         np.asarray(out), oracle.run_torus(np.asarray(board), 1)
     )
+
+
+def test_packed_overlap_matches_oracle():
+    from gol_tpu.parallel import packed
+
+    board = oracle.random_board(32, 64, seed=21)
+    mesh = mesh_mod.make_mesh_1d()
+    from gol_tpu.parallel.sharded import place_private
+
+    got = np.asarray(
+        packed.compiled_evolve_packed_overlap(mesh, 6)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 6))
+
+
+def test_packed_overlap_rejects_2d_mesh():
+    from gol_tpu.parallel import packed
+
+    with pytest.raises(ValueError, match="1-D"):
+        packed.compiled_evolve_packed_overlap(mesh_mod.make_mesh_2d(), 2)
+
+
+def test_runtime_packed_overlap_end_to_end():
+    from gol_tpu.models import patterns
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    geom = Geometry(size=32, num_ranks=4)
+    rt = GolRuntime(
+        geometry=geom,
+        engine="bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="overlap",
+    )
+    _, state = rt.run(pattern=4, iterations=6)
+    board0 = patterns.init_global(4, 32, 4)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 6)
+    )
+    # auto resolves to the packed overlap engine on a packable 1-D mesh.
+    rt2 = GolRuntime(
+        geometry=geom, mesh=mesh_mod.make_mesh_1d(4), shard_mode="overlap"
+    )
+    assert rt2._resolved == "bitpack"
+    # ...but 2-D overlap stays dense (packed overlap is 1-D only).
+    rt3 = GolRuntime(
+        geometry=Geometry(size=256, num_ranks=1),
+        mesh=mesh_mod.make_mesh_2d(),
+        shard_mode="overlap",
+    )
+    assert rt3._resolved == "dense"
