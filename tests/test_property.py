@@ -106,3 +106,49 @@ def test_dead_board_stays_dead(h, w):
 def test_full_board_dies_of_overpopulation(h, w):
     board = jnp.ones((h, w), jnp.uint8)
     assert int(np.asarray(stencil.step(board)).sum()) == 0
+
+
+# -- 3-D families ------------------------------------------------------------
+
+from gol_tpu.ops import bitlife3d, life3d  # noqa: E402
+
+dims3 = st.integers(min_value=4, max_value=12)
+
+
+@given(d=dims3, h=dims3, words=st.integers(1, 2), seed=seeds,
+       n=st.integers(0, 3))
+@settings(**_SETTINGS)
+def test_packed3d_matches_dense_property(d, h, words, seed, n):
+    rng = np.random.default_rng(seed)
+    vol = rng.integers(0, 2, (d, h, words * bitlife.BITS), np.uint8)
+    got = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), n))
+    ref = jnp.asarray(vol)
+    for _ in range(n):
+        ref = life3d.step3d(ref)
+    np.testing.assert_array_equal(got, np.asarray(ref))
+
+
+@given(d=dims3, seed=seeds)
+@settings(**_SETTINGS)
+def test_step3d_axis_permutation_equivariance(d, seed):
+    """The 26-neighbor totalistic rule is isotropic: step commutes with any
+    permutation of the volume axes (cube volumes)."""
+    rng = np.random.default_rng(seed)
+    vol = rng.integers(0, 2, (d, d, d), np.uint8)
+    stepped = np.asarray(life3d.step3d(jnp.asarray(vol)))
+    for perm in ((1, 0, 2), (2, 1, 0), (1, 2, 0)):
+        np.testing.assert_array_equal(
+            np.asarray(life3d.step3d(jnp.asarray(vol.transpose(perm)))),
+            stepped.transpose(perm),
+        )
+
+
+@given(d=dims3, h=dims3, w=dims3, seed=seeds,
+       shift=st.integers(-4, 4), axis=st.integers(0, 2))
+@settings(**_SETTINGS)
+def test_step3d_translation_equivariance(d, h, w, seed, shift, axis):
+    rng = np.random.default_rng(seed)
+    vol = rng.integers(0, 2, (d, h, w), np.uint8)
+    a = np.asarray(life3d.step3d(jnp.asarray(np.roll(vol, shift, axis))))
+    b = np.roll(np.asarray(life3d.step3d(jnp.asarray(vol))), shift, axis)
+    np.testing.assert_array_equal(a, b)
