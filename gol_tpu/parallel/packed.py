@@ -147,6 +147,105 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
     )
 
 
+@functools.lru_cache(maxsize=64)
+def compiled_evolve_packed_pallas(
+    mesh: Mesh, steps: int, halo_depth: int = 8, tile_hint: int = 256,
+    rule=None,
+):
+    """Sharded evolve running the fused Pallas kernel per shard.
+
+    The flagship multi-chip configuration: per chunk, one ``halo_extend``
+    ring exchange ships a ``halo_depth``-deep packed ghost band
+    (``lax.ppermute`` over ICI), then the shard steps ``halo_depth``
+    generations inside a single Pallas launch
+    (:func:`gol_tpu.ops.pallas_bitlife.multi_step_pallas_packed_ext` — the
+    no-wrap variant; the exchanged band replaces the torus DMA).  1-D row
+    meshes only (the kernel's lane word-ring assumes the width axis is
+    unsharded); ``halo_depth`` must be a multiple of 8 (DMA row
+    alignment).  A non-multiple remainder of ``steps`` runs on the jnp
+    packed step.  Optional ``rule`` switches the kernel tail to the
+    generic plane matcher.
+    """
+    from gol_tpu.ops import pallas_bitlife
+
+    if COLS in mesh.axis_names:
+        raise ValueError(
+            "the sharded Pallas engine is 1-D (row-ring) only; use engine "
+            "'bitpack' on 2-D meshes"
+        )
+    if halo_depth < 8 or halo_depth % 8:
+        raise ValueError(
+            f"the sharded Pallas engine needs halo_depth to be a multiple "
+            f"of 8 (DMA row alignment), got {halo_depth}"
+        )
+    from gol_tpu.parallel.halo import halo_extend
+
+    num_rows = mesh.shape[ROWS]
+    phases = ((0, ROWS, num_rows),)
+    full, rem = divmod(steps, halo_depth)
+
+    def chunk(p_u32, tile):
+        # Bit-identical int32 view only around the kernel; the jnp packed
+        # ops stay on uint32 (their right-shifts must be logical).
+        ext = lax.bitcast_convert_type(
+            halo_extend(p_u32, phases, depth=halo_depth), jnp.int32
+        )
+        out = pallas_bitlife.multi_step_pallas_packed_ext(
+            ext, tile, halo_depth, rule
+        )
+        return lax.bitcast_convert_type(out, jnp.uint32)
+
+    def jnp_step(ext):
+        if rule is None:
+            return bitlife.step_packed_vext(ext)
+        from gol_tpu.ops import rules as rules_mod
+
+        return rules_mod.step_rule_packed_vext(ext, rule)
+
+    def local(board):
+        h, w = board.shape  # per-shard block (static under shard_map)
+        if jax.default_backend() == "tpu" and (w // bitlife.BITS) % 128:
+            raise ValueError(
+                "the sharded Pallas engine needs each shard's packed width "
+                "to fill whole 128-lane tiles on TPU: shard width must be "
+                f"a multiple of {128 * bitlife.BITS}, got {w}"
+            )
+        if h % 8 or h < halo_depth:
+            raise ValueError(
+                f"the sharded Pallas engine needs shard height (got {h}) "
+                f"to be a multiple of 8 and >= the exchanged band depth "
+                f"{halo_depth}"
+            )
+        packed = bitlife.pack(board)
+        tile = pallas_bitlife.pick_tile(
+            packed.shape[0], packed.shape[1], tile_hint
+        )
+        if full:
+            packed = lax.fori_loop(
+                0, full, lambda _, p: chunk(p, tile), packed
+            )
+        if rem:
+            # One depth-rem exchange feeds all leftover generations (the
+            # blocked-chunk pattern of halo.blocked_local_loop), instead of
+            # rem separate ppermute pairs.
+            ext = halo_extend(packed, phases, depth=rem)
+            for _ in range(rem):  # each step consumes one ghost layer
+                ext = jnp_step(ext)
+            packed = ext
+        return bitlife.unpack(packed)
+
+    # check_vma=False: pallas_call's out ShapeDtypeStruct carries no
+    # varying-mesh-axes annotation, and the kernel is already per-shard.
+    shmapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(ROWS, None),
+        out_specs=P(ROWS, None),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=0)
+
+
 def evolve_sharded_packed(board: jax.Array, steps: int, mesh: Mesh) -> jax.Array:
     """Evolve a dense board over ``mesh`` with the bit-packed engine.
 

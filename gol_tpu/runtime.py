@@ -145,14 +145,33 @@ class GolRuntime:
                     "stale_t0 (reference-compat) runs are single-device only; "
                     "its blocks evolve independently so a mesh adds nothing"
                 )
-            if self.engine not in ("auto", "dense", "bitpack"):
+            if self.engine not in ("auto", "dense", "bitpack", "pallas_bitpack"):
                 raise ValueError(
                     f"engine {self.engine!r} has no sharded path; with a "
                     "mesh use 'dense'/'auto' (shard_map+ppermute or "
-                    "auto-SPMD) or 'bitpack' (packed shard_map+ppermute)"
+                    "auto-SPMD), 'bitpack' (packed shard_map+ppermute), or "
+                    "'pallas_bitpack' (fused kernel per shard, 1-D meshes)"
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
-            if self._resolved == "bitpack":
+            if self._resolved == "pallas_bitpack":
+                if mesh_mod.COLS in self.mesh.axis_names:
+                    raise ValueError(
+                        "the sharded Pallas engine is 1-D (row-ring) only; "
+                        "use engine 'bitpack' on 2-D meshes"
+                    )
+                if self.shard_mode != "explicit":
+                    raise ValueError(
+                        "the sharded Pallas engine has only the explicit "
+                        f"ring program (got shard_mode {self.shard_mode!r})"
+                    )
+                if self.halo_depth > 1 and self.halo_depth % 8:
+                    raise ValueError(
+                        "the sharded Pallas engine needs halo_depth to be "
+                        "a multiple of 8 (DMA row alignment), got "
+                        f"{self.halo_depth}"
+                    )
+                packed_mod.validate_packed_geometry(shape, self.mesh)
+            elif self._resolved == "bitpack":
                 if self.shard_mode == "auto":
                     raise ValueError(
                         "the bit-packed sharded engine has no auto-SPMD "
@@ -214,6 +233,26 @@ class GolRuntime:
                 words = self.geometry.global_width // cols // bitlife.BITS
                 if self.halo_depth > words:
                     return "dense"
+            if (
+                jax.default_backend() == "tpu"
+                and self.shard_mode == "explicit"
+                and mesh_mod.COLS not in self.mesh.axis_names
+                and (self.halo_depth == 1 or self.halo_depth % 8 == 0)
+            ):
+                # Fused kernel per shard when the shard geometry allows:
+                # lane-filling width, aligned shard height, and room for
+                # the 8-deep exchanged ghost band.
+                from gol_tpu.ops import bitlife, pallas_bitlife
+
+                rows = self.mesh.shape[mesh_mod.ROWS]
+                shard_h = self.geometry.global_height // rows
+                depth = 8 if self.halo_depth == 1 else self.halo_depth
+                if (
+                    geom[1] % (pallas_bitlife._LANE * bitlife.BITS) == 0
+                    and shard_h % pallas_bitlife._ALIGN == 0
+                    and depth <= shard_h
+                ):
+                    return "pallas_bitpack"
             return "bitpack"
         from gol_tpu.ops import bitlife
 
@@ -240,6 +279,20 @@ class GolRuntime:
         executing a throwaway evolution.
         """
         name = self._resolved
+        if name == "pallas_bitpack" and self.mesh is not None:
+            # Fused kernel per shard over the ppermute ring; a custom rule
+            # rides the same program via the kernel's generic tail.
+            return (
+                packed_mod.compiled_evolve_packed_pallas(
+                    self.mesh,
+                    steps,
+                    8 if self.halo_depth == 1 else self.halo_depth,
+                    self.tile_hint,
+                    self._rule,
+                ),
+                (),
+                (),
+            )
         if self._rule is not None:
             from gol_tpu.ops import rules as rules_mod
 

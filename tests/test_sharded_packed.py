@@ -154,3 +154,73 @@ def test_runtime_packed_overlap_end_to_end():
         shard_mode="overlap",
     )
     assert rt3._resolved == "dense"
+
+
+# -- fused Pallas kernel per shard (interpret mode on CPU) -------------------
+
+
+@pytest.mark.parametrize("steps", [8, 16, 19])  # incl. a jnp remainder tail
+def test_sharded_pallas_matches_oracle(steps):
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(64, 64, seed=33)
+    mesh = mesh_mod.make_mesh_1d(4)  # shard height 16, >= the 8-deep band
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, steps)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+def test_sharded_pallas_rejects_bad_geometry():
+    from gol_tpu.parallel import packed
+
+    with pytest.raises(ValueError, match="1-D"):
+        packed.compiled_evolve_packed_pallas(mesh_mod.make_mesh_2d(), 8)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        packed.compiled_evolve_packed_pallas(
+            mesh_mod.make_mesh_1d(4), 8, halo_depth=4
+        )
+
+
+def test_sharded_pallas_custom_rule():
+    from gol_tpu.ops import rules
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(32, 64, seed=34)
+    mesh = mesh_mod.make_mesh_1d(2)  # shard height 16
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(
+            mesh, 8, rule=rules.HIGHLIFE
+        )(place_private(jnp.asarray(board), mesh))
+    )
+    ref = np.asarray(rules.run_rule(jnp.asarray(board), 8, rules.HIGHLIFE))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_runtime_sharded_pallas_end_to_end():
+    from gol_tpu.models import patterns
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    geom = Geometry(size=32, num_ranks=4)  # 128x32, shard height 32
+    rt = GolRuntime(
+        geometry=geom,
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+    )
+    _, state = rt.run(pattern=4, iterations=10)
+    board0 = patterns.init_global(4, 32, 4)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 10)
+    )
+    # 2-D mesh rejected for this engine.
+    with pytest.raises(ValueError, match="1-D"):
+        GolRuntime(
+            geometry=Geometry(size=256, num_ranks=1),
+            engine="pallas_bitpack",
+            mesh=mesh_mod.make_mesh_2d(),
+        )
