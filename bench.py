@@ -73,6 +73,18 @@ def main() -> None:
             engines["pallas"] = lambda b, s=steps: pallas_step.evolve(b, s, 512)
         except ImportError:
             pass
+        try:
+            # The flagship multi-chip program on this chip's 1-ring: the
+            # fused kernel per shard behind an 8-deep ppermute exchange.
+            from gol_tpu.parallel import mesh as mesh_mod
+            from gol_tpu.parallel import packed as packed_mod
+
+            ring = mesh_mod.make_mesh_1d(1)
+            engines["pallas_ring"] = lambda b, s=steps: (
+                packed_mod.compiled_evolve_packed_pallas(ring, s)(b)
+            )
+        except ImportError:
+            pass
     engines["dense"] = lambda b, s=steps: stencil.run(b, s)
 
     results = {}
