@@ -11,13 +11,19 @@ pure-functional double buffer via XLA input/output aliasing instead of
 pointer swaps.
 
 Layer map (mirrors SURVEY.md §1 of the reference):
-  L1 CLI/driver            -> gol_tpu.cli (+ native/gol_driver.cpp)
-  L2 distributed halo comm -> gol_tpu.parallel.halo (lax.ppermute rings)
-  L3 step orchestration    -> gol_tpu.parallel.engine / gol_tpu.ops.stencil.run
+  L1 CLI/driver            -> gol_tpu.cli, gol_tpu.cli3d (+ native/gol_driver.cpp)
+  L2 distributed halo comm -> gol_tpu.parallel.halo (lax.ppermute rings);
+                              multi-host via gol_tpu.parallel.multihost
+  L3 step orchestration    -> gol_tpu.runtime / parallel.{sharded,packed,
+                              ruled,sharded3d} engines (+ guarded loop in
+                              utils.guard)
   L4 device memory/runtime -> XLA HBM arrays + donation (no explicit mgmt)
-  L5 compute kernel        -> gol_tpu.ops.stencil / ops.pallas_step / ops.bitlife
-  L6 init patterns         -> gol_tpu.models.patterns
-  L7 observability/output  -> gol_tpu.utils.io / utils.timing
+  L5 compute kernel        -> gol_tpu.ops.{stencil,bitlife,rules,life3d,
+                              bitlife3d} with fused Pallas tiers
+                              (pallas_step, pallas_bitlife, pallas_bitlife3d)
+  L6 init patterns         -> gol_tpu.models.patterns (0-4 reference, 5-7 added)
+  L7 observability/output  -> gol_tpu.utils.{io,timing,halobench,scalebench,
+                              checkpoint,guard}
 """
 
 __version__ = "0.1.0"
