@@ -20,7 +20,9 @@ unchanged over ICI.
 
 Run as a module for a JSON report:
 ``python -m gol_tpu.utils.scalebench [size_per_chip] [steps] [engine]``
-(engine ``dense`` | ``bitpack``).
+(engine ``dense`` | ``bitpack`` | ``pallas`` — the last is the flagship
+fused-kernel-per-shard program; on TPU it needs ``size_per_chip`` to be a
+multiple of 4096 so the packed width fills whole 128-lane tiles).
 """
 
 from __future__ import annotations
@@ -37,7 +39,7 @@ from gol_tpu.parallel import packed as packed_mod
 from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils.timing import time_best
 
-ENGINES = ("dense", "bitpack")
+ENGINES = ("dense", "bitpack", "pallas")
 
 
 def device_counts(limit: Optional[int] = None) -> List[int]:
@@ -75,7 +77,23 @@ def measure_weak_scaling(
             np.uint8
         )
         board = mesh_mod.shard_board(jnp.asarray(board_np), mesh)
-        if engine == "bitpack":
+        if engine == "pallas":
+            # The flagship multi-chip program (fused kernel per shard over
+            # the ring).  Meaningful curves need a real TPU — interpret
+            # mode is far too slow.  Surface the kernel's TPU lane
+            # constraint here, early, instead of deep inside tracing.
+            if (
+                jax.default_backend() == "tpu"
+                and (size_per_chip // 32) % 128
+            ):
+                raise ValueError(
+                    "engine 'pallas' on TPU needs size_per_chip to be a "
+                    f"multiple of 4096 (128-lane packed width); got "
+                    f"{size_per_chip}"
+                )
+            packed_mod.validate_packed_geometry(board.shape, mesh)
+            evolve = packed_mod.compiled_evolve_packed_pallas(mesh, steps)
+        elif engine == "bitpack":
             packed_mod.validate_packed_geometry(board.shape, mesh)
             evolve = packed_mod.compiled_evolve_packed(mesh, steps)
         else:

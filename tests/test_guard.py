@@ -296,3 +296,22 @@ def test_cli_guard_rejects_profile(capsys):
     )
     assert rc == 255
     assert "unguarded" in capsys.readouterr().out
+
+
+def test_guarded_flagship_sharded_pallas():
+    """run_guarded over the fused-kernel-per-shard engine (interpret mode):
+    audits, rollback bookkeeping, and the final board all line up."""
+    geom = Geometry(size=32, num_ranks=4)  # 128x32, shard height 32
+    rt = GolRuntime(
+        geometry=geom,
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        halo_depth=8,
+    )
+    _, state, greport = guard.run_guarded(
+        rt, 4, 16, guard.GuardConfig(check_every=8)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 16)
+    )
+    assert greport.checks == 2 and greport.failures == 0

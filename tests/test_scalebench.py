@@ -57,3 +57,13 @@ def test_main_emits_json(capsys):
     assert out["engine"] == "dense"
     assert out["rows"][0]["devices"] == 1
     assert len(out["rows"]) >= 1
+
+
+def test_weak_scaling_pallas_engine():
+    """The flagship sharded-Pallas program through the harness (interpret
+    mode; tiny sweep — on a real pod this is the curve that matters)."""
+    rows = scalebench.measure_weak_scaling(
+        64, steps=8, engine="pallas", counts=[1, 2]
+    )
+    assert [r["devices"] for r in rows] == [1, 2]
+    assert all(r["updates_per_s"] > 0 for r in rows)
