@@ -16,7 +16,8 @@ inside single compiled programs so host round-trips don't pollute the
 numbers.
 
 Run as a module for a JSON report:
-``python -m gol_tpu.utils.halobench [size] [steps] [mesh {1d,2d}]``.
+``python -m gol_tpu.utils.halobench [size] [steps] [mesh {1d,2d}]
+[engine {dense,bitpack}]``.
 """
 
 from __future__ import annotations
@@ -87,7 +88,12 @@ def _time(fn, arg, repeats: int = 3) -> float:
     return time_best(fn, lambda: arg, repeats)
 
 
-def measure(mesh: Mesh, size: int, steps: int = 100) -> Dict[str, float]:
+ENGINES = ("dense", "bitpack")
+
+
+def measure(
+    mesh: Mesh, size: int, steps: int = 100, engine: str = "dense"
+) -> Dict[str, float]:
     """Per-generation seconds for exchange-only / full step / pure stencil.
 
     ``stencil_s`` is the pure-compute ceiling: the torus stencil on an
@@ -96,18 +102,30 @@ def measure(mesh: Mesh, size: int, steps: int = 100) -> Dict[str, float]:
     sharded global board to ``stencil.run`` would instead compile an
     auto-SPMD program whose rolls insert their own collectives.
 
+    ``engine="bitpack"`` attributes the packed ring engine instead: the
+    full step is :func:`gol_tpu.parallel.packed.compiled_evolve_packed`
+    (packed-word halos — 8× less wire) and the compute ceiling the packed
+    single-device evolve; ``exchange_s`` still times dense-row ppermutes,
+    an upper bound on the packed exchange's wire time.
+
     Returns ``{"exchange_s": ..., "step_s": ..., "stencil_s": ...,
     "exposed_exchange_s": ...}``, all per generation.
     """
+    if engine not in ENGINES:
+        raise ValueError(f"unknown engine {engine!r}; expected {ENGINES}")
     rng = np.random.default_rng(0)
     board_np = (rng.random((size, size)) < 0.35).astype(np.uint8)
     board = jax.device_put(jnp.asarray(board_np), board_sharding(mesh))
     t_exch = _time(_exchange_only(mesh, steps), board) / steps
+    if engine == "bitpack":
+        from gol_tpu.parallel import packed as packed_mod
+
+        packed_mod.validate_packed_geometry(board.shape, mesh)
+        step_fn = packed_mod.compiled_evolve_packed(mesh, steps)
+    else:
+        step_fn = sharded.compiled_evolve(mesh, steps, "explicit", 1)
     t_step = (
-        _time(lambda b: sharded.compiled_evolve(mesh, steps, "explicit", 1)(
-            jnp.array(b, copy=True)
-        ), board)
-        / steps
+        _time(lambda b: step_fn(jnp.array(b, copy=True)), board) / steps
     )
     local_h = size // mesh.shape[ROWS]
     local_w = size // mesh.shape.get(COLS, 1)
@@ -115,9 +133,14 @@ def measure(mesh: Mesh, size: int, steps: int = 100) -> Dict[str, float]:
         jnp.asarray(board_np[:local_h, :local_w]),
         mesh.devices.ravel()[0],
     )
+    if engine == "bitpack":
+        from gol_tpu.ops import bitlife
+
+        sten_fn = lambda b: bitlife.evolve_dense_io(b, steps)
+    else:
+        sten_fn = lambda b: stencil.run(b, steps)
     t_sten = (
-        _time(lambda b: stencil.run(jnp.array(b, copy=True), steps), shard)
-        / steps
+        _time(lambda b: sten_fn(jnp.array(b, copy=True)), shard) / steps
     )
     return {
         "exchange_s": t_exch,
@@ -134,19 +157,21 @@ def main(argv=None) -> None:
     size = int(args[0]) if len(args) > 0 else 4096
     steps = int(args[1]) if len(args) > 1 else 100
     kind = args[2] if len(args) > 2 else "1d"
+    engine = args[3] if len(args) > 3 else "dense"
 
     from gol_tpu.parallel import mesh as mesh_mod
 
     mesh = (
         mesh_mod.make_mesh_2d() if kind == "2d" else mesh_mod.make_mesh_1d()
     )
-    out = measure(mesh, size, steps)
+    out = measure(mesh, size, steps, engine)
     out.update(
         {
             "size": size,
             "steps": steps,
             "mesh": dict(mesh.shape),
             "devices": len(mesh.devices.ravel()),
+            "engine": engine,
         }
     )
     print(json.dumps(out))

@@ -67,6 +67,19 @@ def measure_weak_scaling(
         # Efficiency is defined against the 1-device throughput; a sweep
         # that skips it would silently re-baseline on its first row.
         raise ValueError(f"counts must start at 1, got {counts}")
+    if engine == "pallas" and jax.default_backend() == "tpu":
+        # Surface the fused kernel's lane constraint early (it otherwise
+        # raises deep inside shard_map tracing).  Loop-invariant: the
+        # width axis is unsharded on the 1-D row mesh.
+        from gol_tpu.ops import bitlife, pallas_bitlife
+
+        lane_cells = pallas_bitlife._LANE * bitlife.BITS
+        if size_per_chip % lane_cells:
+            raise ValueError(
+                "engine 'pallas' on TPU needs size_per_chip to be a "
+                f"multiple of {lane_cells} (128-lane packed width); got "
+                f"{size_per_chip}"
+            )
     rng = np.random.default_rng(0)
     rows: List[Dict[str, float]] = []
     base_per_chip: Optional[float] = None
@@ -80,17 +93,7 @@ def measure_weak_scaling(
         if engine == "pallas":
             # The flagship multi-chip program (fused kernel per shard over
             # the ring).  Meaningful curves need a real TPU — interpret
-            # mode is far too slow.  Surface the kernel's TPU lane
-            # constraint here, early, instead of deep inside tracing.
-            if (
-                jax.default_backend() == "tpu"
-                and (size_per_chip // 32) % 128
-            ):
-                raise ValueError(
-                    "engine 'pallas' on TPU needs size_per_chip to be a "
-                    f"multiple of 4096 (128-lane packed width); got "
-                    f"{size_per_chip}"
-                )
+            # mode is far too slow.
             packed_mod.validate_packed_geometry(board.shape, mesh)
             evolve = packed_mod.compiled_evolve_packed_pallas(mesh, steps)
         elif engine == "bitpack":

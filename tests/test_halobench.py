@@ -52,3 +52,23 @@ def test_main_prints_json(capsys):
     rec = json.loads(line)
     assert rec["size"] == 64 and rec["devices"] == 8
     assert rec["mesh"] == {"rows": 8}
+
+
+def test_measure_bitpack_engine():
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.utils import halobench
+
+    out = halobench.measure(mesh_mod.make_mesh_1d(), 256, steps=4,
+                            engine="bitpack")
+    assert out["step_s"] > 0 and out["stencil_s"] > 0
+    assert out["exposed_exchange_s"] >= 0
+
+
+def test_measure_rejects_unknown_engine():
+    import pytest
+
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.utils import halobench
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        halobench.measure(mesh_mod.make_mesh_1d(), 64, 2, engine="warp")
