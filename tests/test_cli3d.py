@@ -85,3 +85,20 @@ def test_zero_iterations(tmp_path, capsys):
     assert rc == 0
     vol = np.load(tmp_path / "World3D_of_1.npy")
     assert vol.sum() == 16**3
+
+
+def test_sharded_3d_custom_rule(tmp_path):
+    """--mesh 3d + a custom rule through the packed sharded path."""
+    a = cli3d.main(
+        ["2", "32", "2", "64", "1", "--mesh", "3d", "--rule", "B5,6/S4,5",
+         "--outdir", str(tmp_path / "mesh")]
+    )
+    b = cli3d.main(
+        ["2", "32", "2", "64", "1", "--engine", "dense", "--rule",
+         "B5,6/S4,5", "--outdir", str(tmp_path / "single")]
+    )
+    assert a == 0 and b == 0
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "mesh" / "World3D_of_1.npy"),
+        np.load(tmp_path / "single" / "World3D_of_1.npy"),
+    )

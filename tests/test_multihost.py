@@ -283,3 +283,44 @@ def test_two_process_2d_mesh_guarded_gather_dump(tmp_path):
     )
     name = gol_io.rank_filename(0, 1)
     assert (out_mh / name).read_bytes() == (out_sp / name).read_bytes()
+
+
+# The flagship engine (fused Pallas kernel per shard, interpret mode on
+# CPU) across a REAL process boundary: ppermute ghost bands over Gloo feed
+# the kernel's no-wrap path on each host.
+_WORKER_PALLAS = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import cli
+    pid = sys.argv[1]
+    rc = cli.main([
+        "4", "32", "9", "16", "1",
+        "--ranks", "4", "--mesh", "1d", "--engine", "pallas_bitpack",
+        "--coordinator", sys.argv[2],
+        "--num-processes", "2", "--process-id", pid,
+        "--outdir", sys.argv[3],
+    ])
+    sys.exit(rc)
+    """
+)
+
+
+def test_two_process_flagship_pallas_engine(tmp_path):
+    out_mh = tmp_path / "mh"
+    out_sp = tmp_path / "sp"
+    out_mh.mkdir()
+    _run_two_workers(_WORKER_PALLAS, [str(out_mh)])
+
+    from gol_tpu import cli
+
+    assert (
+        cli.main(["4", "32", "9", "16", "1", "--ranks", "4", "--outdir",
+                  str(out_sp)])
+        == 0
+    )
+    for r in range(4):
+        name = gol_io.rank_filename(r, 4)
+        assert (out_mh / name).read_bytes() == (out_sp / name).read_bytes()
