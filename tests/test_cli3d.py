@@ -88,13 +88,17 @@ def test_zero_iterations(tmp_path, capsys):
 
 
 def test_sharded_3d_custom_rule(tmp_path):
-    """--mesh 3d + a custom rule through the packed sharded path."""
+    """--mesh 3d + a custom rule through the packed sharded path.
+
+    Size 64 over the 2x2x2 mesh gives x-shards 32 cells wide — exactly
+    one packed word — so auto takes compiled_evolve3d_packed (size 32
+    would silently fall back to the dense sharded engine)."""
     a = cli3d.main(
-        ["2", "32", "2", "64", "1", "--mesh", "3d", "--rule", "B5,6/S4,5",
+        ["2", "64", "2", "64", "1", "--mesh", "3d", "--rule", "B5,6/S4,5",
          "--outdir", str(tmp_path / "mesh")]
     )
     b = cli3d.main(
-        ["2", "32", "2", "64", "1", "--engine", "dense", "--rule",
+        ["2", "64", "2", "64", "1", "--engine", "dense", "--rule",
          "B5,6/S4,5", "--outdir", str(tmp_path / "single")]
     )
     assert a == 0 and b == 0
