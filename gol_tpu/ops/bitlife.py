@@ -207,6 +207,80 @@ def _row_hsum_ext(rows: jax.Array):
     return _full_add(west, cur, east)
 
 
+def _row_hsum_nowrap(rows: jax.Array):
+    """Per-lane 3-cell horizontal sums with zero edge carries (no wrap).
+
+    The no-torus variant of :func:`_row_hsum` for windows that do *not* own
+    the full board width: west/east carry bits cross adjacent array words,
+    and the window's outermost bit per side reads a 0 instead of wrapping.
+    Callers tolerate garbage in an edge band — each generation grows the
+    band by one *bit* per side (the stencil light cone), so a window with
+    ``g`` ghost bits per side keeps an exact interior for ``g`` generations.
+    Width is preserved (unlike :func:`_row_hsum_ext`, which consumes a whole
+    ghost word per side per call).
+    """
+    zero = jnp.zeros_like(rows[..., :1])
+    prev_word = jnp.concatenate([zero, rows[..., :-1]], axis=-1)
+    next_word = jnp.concatenate([rows[..., 1:], zero], axis=-1)
+    west = (rows << 1) | (prev_word >> (BITS - 1))
+    east = (rows >> 1) | (next_word << (BITS - 1))
+    return _full_add(west, rows, east)
+
+
+def step_packed_vext_nowrap(ext: jax.Array) -> jax.Array:
+    """Packed step of a no-wrap window ``ext[r+2, nww]``: shrinks one row
+    layer per side; width is preserved with horizontal exactness shrinking
+    one *bit* per side per call (see :func:`_row_hsum_nowrap`).
+
+    The building block of the 2-D-mesh sharded Pallas engine
+    (:func:`gol_tpu.parallel.packed.compiled_evolve_packed_pallas`): both
+    its edge-word repair strips and its remainder steps are windows onto a
+    column-sharded board, where neither wrap nor whole-word halo
+    consumption is wanted.
+    """
+    s0, s1 = _row_hsum_nowrap(ext)
+    return _rule_from_row_sums(
+        ext[1:-1],
+        (s0[:-2], s1[:-2]),
+        (s0[1:-1], s1[1:-1]),
+        (s0[2:], s1[2:]),
+    )
+
+
+def _row_hsum_nowrap_t(cols: jax.Array):
+    """Transposed twin of :func:`_row_hsum_nowrap`: packed words on axis -2,
+    board rows on axis -1.
+
+    Built for narrow strips (a few words wide, many rows tall): in the
+    natural ``[rows, words]`` layout a 3-word strip wastes ~98% of each
+    128-wide TPU lane tile, while transposed the long row axis fills the
+    lanes.  Leading batch axes broadcast (stacked independent strips) —
+    word adjacency never crosses a batch boundary because the shift is a
+    zero-filled concat along axis -2 only.
+    """
+    zero = jnp.zeros_like(cols[..., :1, :])
+    prev_word = jnp.concatenate([zero, cols[..., :-1, :]], axis=-2)
+    next_word = jnp.concatenate([cols[..., 1:, :], zero], axis=-2)
+    west = (cols << 1) | (prev_word >> (BITS - 1))
+    east = (cols >> 1) | (next_word << (BITS - 1))
+    return _full_add(west, cols, east)
+
+
+def step_packed_vext_nowrap_t(ext_t: jax.Array) -> jax.Array:
+    """Transposed no-wrap packed step: ``ext_t[..., nww, r+2] -> [..., nww, r]``.
+
+    Same semantics as :func:`step_packed_vext_nowrap` with the word and row
+    axes swapped (see :func:`_row_hsum_nowrap_t`).
+    """
+    s0, s1 = _row_hsum_nowrap_t(ext_t)
+    return _rule_from_row_sums(
+        ext_t[..., 1:-1],
+        (s0[..., :-2], s1[..., :-2]),
+        (s0[..., 1:-1], s1[..., 1:-1]),
+        (s0[..., 2:], s1[..., 2:]),
+    )
+
+
 def step_packed_overlap_rows(
     block: jax.Array, top: jax.Array, bottom: jax.Array
 ) -> jax.Array:

@@ -158,15 +158,26 @@ def step_pallas_packed(packed_i32: jax.Array, tile: int) -> jax.Array:
     return multi_step_pallas_packed(packed_i32, tile, 1)
 
 
-def _kernel_ext(ext_hbm, out_ref, scratch, sems, *, tile: int, k: int,
-                rule=None):
+def _kernel_ext(*refs, tile: int, k: int, rule=None):
     """k generations of one tile of a halo-extended (no-wrap) board.
 
     The input already carries k ghost rows on each side (a sharded
     engine's ppermute exchange materialized them), so the window for tile
     ``i`` is the contiguous rows ``[i*tile, i*tile + tile + 2k)`` of the
     extended array — one aligned DMA, no mod-H arithmetic.
+
+    With an ``edges`` input (the 2-D-mesh sharded engine), the caller's
+    pre-computed exact edge word-columns overwrite lanes ``0`` and
+    ``nw-1`` during the same output store — the kernel's local column
+    wrap is wrong in those words' outer k bits, and merging the fix here
+    costs two masked lane stores instead of a separate full-lane-tile
+    read-modify-write scatter pass over the output in HBM.
     """
+    if len(refs) == 4:
+        ext_hbm, out_ref, scratch, sems = refs
+        edges_ref = None
+    else:
+        ext_hbm, edges_ref, out_ref, scratch, sems = refs
     i = pl.program_id(0)
     start = pl.multiple_of(i * tile, _ALIGN)
     dma = pltpu.make_async_copy(
@@ -181,10 +192,14 @@ def _kernel_ext(ext_hbm, out_ref, scratch, sems, *, tile: int, k: int,
         b = tile + 2 * k - j
         scratch[a + 1 : b - 1] = _one_generation(scratch[a:b], rule)
     out_ref[:] = scratch[k : k + tile]
+    if edges_ref is not None:
+        nw = out_ref.shape[1]
+        out_ref[:, 0:1] = edges_ref[:, 0:1]
+        out_ref[:, nw - 1 : nw] = edges_ref[:, 1:2]
 
 
 def multi_step_pallas_packed_ext(
-    ext_i32: jax.Array, tile: int, k: int, rule=None
+    ext_i32: jax.Array, tile: int, k: int, rule=None, edges_i32=None
 ) -> jax.Array:
     """k fused generations on a k-deep row-halo-extended packed board.
 
@@ -194,6 +209,10 @@ def multi_step_pallas_packed_ext(
     program).  Columns wrap locally, so this is the 1-D row-decomposition
     kernel.  ``k`` must be a multiple of the DMA row alignment so every
     tile window stays aligned.  Returns the updated interior ``[h, W/32]``.
+
+    ``edges_i32[h, 2]`` (optional, the 2-D-mesh path) holds the exact
+    post-step left/right edge word-columns; they replace lanes 0 and nw-1
+    of the output inside the kernel (see :func:`_kernel_ext`).
     """
     if k < 1 or k % _ALIGN:
         raise ValueError(
@@ -203,10 +222,17 @@ def multi_step_pallas_packed_ext(
     height = ext_i32.shape[0] - 2 * k
     nw = ext_i32.shape[1]
     validate_tile(height, tile, _ALIGN)
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    operands = [ext_i32]
+    if edges_i32 is not None:
+        in_specs.append(
+            pl.BlockSpec((tile, 2), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        )
+        operands.append(edges_i32)
     return pl.pallas_call(
         functools.partial(_kernel_ext, tile=tile, k=k, rule=rule),
         grid=(height // tile,),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (tile, nw), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
@@ -216,7 +242,7 @@ def multi_step_pallas_packed_ext(
             pltpu.SemaphoreType.DMA((1,)),
         ],
         interpret=jax.default_backend() != "tpu",
-    )(ext_i32)
+    )(*operands)
 
 
 # Benchmarked sweet spot on v5e at 16384² (see module docstring): deeper

@@ -156,6 +156,31 @@ def step_rule_packed_vext(ext: jax.Array, rule: Rule2D) -> jax.Array:
     return _rule_from_count9(ext[1:-1], count9, rule)
 
 
+def step_rule_packed_vext_nowrap(ext: jax.Array, rule: Rule2D) -> jax.Array:
+    """Generic-rule packed step of a no-wrap window (width-preserving).
+
+    The rule-generic twin of
+    :func:`gol_tpu.ops.bitlife.step_packed_vext_nowrap`: shrinks one row
+    layer per side, horizontal exactness shrinks one bit per side per call.
+    """
+    s0, s1 = bitlife._row_hsum_nowrap(ext)
+    count9 = bitlife._sum3_2bit(
+        (s0[:-2], s1[:-2]), (s0[1:-1], s1[1:-1]), (s0[2:], s1[2:])
+    )
+    return _rule_from_count9(ext[1:-1], count9, rule)
+
+
+def step_rule_packed_vext_nowrap_t(ext_t: jax.Array, rule: Rule2D) -> jax.Array:
+    """Transposed generic-rule no-wrap packed step (words on axis -2)."""
+    s0, s1 = bitlife._row_hsum_nowrap_t(ext_t)
+    count9 = bitlife._sum3_2bit(
+        (s0[..., :-2], s1[..., :-2]),
+        (s0[..., 1:-1], s1[..., 1:-1]),
+        (s0[..., 2:], s1[..., 2:]),
+    )
+    return _rule_from_count9(ext_t[..., 1:-1], count9, rule)
+
+
 def step_rule_packed_halo_full(ext: jax.Array, rule: Rule2D) -> jax.Array:
     """Generic-rule packed step with ghost word columns ``ext[h+2, nw+2]``."""
     s0, s1 = bitlife._row_hsum_ext(ext)

@@ -37,14 +37,17 @@ def ring(n: int, shift: int):
 def halo_extend(
     block: jax.Array,
     mesh_axes: Sequence[Tuple[int, str, int]],
-    depth: int = 1,
+    depth=1,
 ) -> jax.Array:
     """Extend ``block`` by ``depth`` ghost layers on both sides of each axis.
 
     ``mesh_axes`` is a sequence of ``(array_axis, mesh_axis_name, ring_size)``
     — one entry per array axis to extend, in phase order.  Must be called
     inside ``shard_map`` over a mesh carrying the named axes.  Returns the
-    block grown by ``2*depth`` along every listed axis.
+    block grown by ``2*depth`` along every listed axis.  ``depth`` may also
+    be a sequence, one depth per listed axis — engines whose halo quantum
+    differs per axis (the 2-D sharded Pallas engine ships a k-row temporal
+    band but a 1-word column band) exchange both in one call.
 
     ``depth > 1`` is the temporal-blocking exchange: a ``depth``-deep ghost
     shell shipped once supplies ``depth`` generations of local stepping
@@ -53,8 +56,17 @@ def halo_extend(
     come entirely from the immediate ring neighbor, so ``depth`` may not
     exceed the shard's extent along any extended axis.
     """
+    depths = (
+        (depth,) * len(mesh_axes)
+        if isinstance(depth, int)
+        else tuple(depth)
+    )
+    if len(depths) != len(mesh_axes):
+        raise ValueError(
+            f"{len(depths)} depths for {len(mesh_axes)} extended axes"
+        )
     ext = block
-    for axis, name, n in mesh_axes:
+    for (axis, name, n), depth in zip(mesh_axes, depths):
         if block.shape[axis] < depth:
             raise ValueError(
                 f"halo depth {depth} exceeds shard extent "

@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu.ops import bitlife
-from gol_tpu.parallel.halo import build_ring_engine
+from gol_tpu.parallel.halo import build_ring_engine, ring
 from gol_tpu.parallel.mesh import COLS, ROWS, validate_geometry
 from gol_tpu.parallel.sharded import (
     exchange_block_halos,
@@ -159,39 +159,59 @@ def compiled_evolve_packed_pallas(
     (``lax.ppermute`` over ICI), then the shard steps ``halo_depth``
     generations inside a single Pallas launch
     (:func:`gol_tpu.ops.pallas_bitlife.multi_step_pallas_packed_ext` — the
-    no-wrap variant; the exchanged band replaces the torus DMA).  1-D row
-    meshes only (the kernel's lane word-ring assumes the width axis is
-    unsharded); ``halo_depth`` must be a multiple of 8 (DMA row
-    alignment).  A non-multiple remainder of ``steps`` runs on the jnp
-    packed step.  Optional ``rule`` switches the kernel tail to the
-    generic plane matcher.
+    no-wrap variant; the exchanged band replaces the torus DMA).
+    ``halo_depth`` must be a multiple of 8 (DMA row alignment).  A
+    non-multiple remainder of ``steps`` runs on the jnp packed step.
+    Optional ``rule`` switches the kernel tail to the generic plane
+    matcher.
+
+    On **2-D block meshes** (BASELINE config 3's decomposition) the
+    exchange grows a second phase: the k-row temporal band vertically, then
+    a single ghost *word* column of the row-extended block horizontally
+    (corner words ride the second hop).  The kernel itself still runs at
+    the lane-aligned shard width with its local column wrap — wrong at the
+    shard's vertical seams, but the wrongness is confined by the stencil
+    light cone to the outer ``k`` bits of the two edge words (k <= 32 = one
+    word).  Those two word columns are then recomputed exactly from 3-word
+    strips (96-bit no-wrap windows: every edge-word bit sits >= 32 bits
+    from both window boundaries) and spliced over the kernel's output.  The
+    strips are O(rows) work that XLA can schedule concurrently with the
+    kernel — the whole horizontal fix-up costs ~3/nw of the kernel's
+    compute and none of its latency.
     """
     from gol_tpu.ops import pallas_bitlife
 
-    if COLS in mesh.axis_names:
-        raise ValueError(
-            "the sharded Pallas engine is 1-D (row-ring) only; use engine "
-            "'bitpack' on 2-D meshes"
-        )
+    two_d = COLS in mesh.axis_names
     if halo_depth < 8 or halo_depth % 8:
         raise ValueError(
             f"the sharded Pallas engine needs halo_depth to be a multiple "
             f"of 8 (DMA row alignment), got {halo_depth}"
         )
+    if two_d and halo_depth > bitlife.BITS:
+        raise ValueError(
+            f"on a 2-D mesh the sharded Pallas engine ships a 1-word "
+            f"column band whose bit light cone supports halo_depth <= "
+            f"{bitlife.BITS}, got {halo_depth}"
+        )
     from gol_tpu.parallel.halo import halo_extend
 
     num_rows = mesh.shape[ROWS]
+    num_cols = mesh.shape.get(COLS, 1)
     phases = ((0, ROWS, num_rows),)
+    phases2d = ((0, ROWS, num_rows), (1, COLS, num_cols))
     full, rem = divmod(steps, halo_depth)
 
-    def chunk(p_u32, tile):
+    def kernel(ext_u32, tile, k, edges_u32=None):
         # Bit-identical int32 view only around the kernel; the jnp packed
         # ops stay on uint32 (their right-shifts must be logical).
-        ext = lax.bitcast_convert_type(
-            halo_extend(p_u32, phases, depth=halo_depth), jnp.int32
-        )
         out = pallas_bitlife.multi_step_pallas_packed_ext(
-            ext, tile, halo_depth, rule
+            lax.bitcast_convert_type(ext_u32, jnp.int32),
+            tile,
+            k,
+            rule,
+            None
+            if edges_u32 is None
+            else lax.bitcast_convert_type(edges_u32, jnp.int32),
         )
         return lax.bitcast_convert_type(out, jnp.uint32)
 
@@ -201,6 +221,72 @@ def compiled_evolve_packed_pallas(
         from gol_tpu.ops import rules as rules_mod
 
         return rules_mod.step_rule_packed_vext(ext, rule)
+
+    def jnp_step_nowrap(ext):
+        if rule is None:
+            return bitlife.step_packed_vext_nowrap(ext)
+        from gol_tpu.ops import rules as rules_mod
+
+        return rules_mod.step_rule_packed_vext_nowrap(ext, rule)
+
+    def jnp_step_nowrap_t(ext_t):
+        if rule is None:
+            return bitlife.step_packed_vext_nowrap_t(ext_t)
+        from gol_tpu.ops import rules as rules_mod
+
+        return rules_mod.step_rule_packed_vext_nowrap_t(ext_t, rule)
+
+    def chunk(p_u32, tile):
+        return kernel(
+            halo_extend(p_u32, phases, depth=halo_depth), tile, halo_depth
+        )
+
+    def chunk2d(p_u32, tile):
+        ext = halo_extend(p_u32, phases, depth=halo_depth)  # rows only
+        # Horizontal phase of the two-phase exchange: the edge word-columns
+        # of the already row-extended block (corner words ride this second
+        # hop).  One transpose pulls all four boundary columns into
+        # lane-major layout up front, so the ppermutes and the strip steps
+        # below never touch a [rows, 1] array (which would waste 127/128 of
+        # every lane tile); the kernel input stays the row-extended block
+        # itself, so no full-width rematerialization either.
+        edges_t = jnp.concatenate([ext[:, :2], ext[:, -2:]], axis=1).T
+        left_ghost_t = lax.ppermute(edges_t[3:4], COLS, ring(num_cols, 1))
+        right_ghost_t = lax.ppermute(edges_t[0:1], COLS, ring(num_cols, -1))
+        # Exact edge words from 3-word strips (ghost + edge + 1 interior),
+        # stacked so both sides share one op chain.
+        strips = jnp.stack(
+            [
+                jnp.concatenate([left_ghost_t, edges_t[0:2]], axis=0),
+                jnp.concatenate([edges_t[2:4], right_ghost_t], axis=0),
+            ]
+        )  # [2 sides, 3 words, h + 2k rows]
+        for _ in range(halo_depth):  # each step consumes one ghost row layer
+            strips = jnp_step_nowrap_t(strips)
+        edges = jnp.stack([strips[0, 1], strips[1, 1]], axis=1)  # [h, 2]
+        # Kernel at the lane-aligned shard width; its local column wrap is
+        # wrong at the vertical seams, confined by the light cone to the
+        # outer halo_depth bits of the two edge words — which the kernel
+        # overwrites with `edges` during its own output store.
+        return kernel(ext, tile, halo_depth, edges)
+
+    def tail(p_u32):
+        # One depth-rem exchange feeds all leftover generations (the
+        # blocked-chunk pattern of halo.blocked_local_loop), instead of
+        # rem separate ppermute pairs.
+        ext = halo_extend(p_u32, phases, depth=rem)
+        for _ in range(rem):  # each step consumes one ghost layer
+            ext = jnp_step(ext)
+        return ext
+
+    def tail2d(p_u32):
+        # rem < halo_depth <= BITS, so the no-wrap step's bit-level garbage
+        # stays inside the single ghost word per side; the interior crop is
+        # exact.
+        ext = halo_extend(p_u32, phases2d, depth=(rem, 1))
+        for _ in range(rem):
+            ext = jnp_step_nowrap(ext)
+        return ext[:, 1:-1]
 
     def local(board):
         h, w = board.shape  # per-shard block (static under shard_map)
@@ -216,31 +302,37 @@ def compiled_evolve_packed_pallas(
                 f"to be a multiple of 8 and >= the exchanged band depth "
                 f"{halo_depth}"
             )
+        if two_d and num_cols > 1 and w // bitlife.BITS < 2:
+            raise ValueError(
+                f"the 2-D sharded Pallas engine needs >= 2 packed words "
+                f"per shard (edge-word strips), got shard width {w}"
+            )
         packed = bitlife.pack(board)
         tile = pallas_bitlife.pick_tile(
             packed.shape[0], packed.shape[1], tile_hint
         )
+        # A 2-D mesh with a size-1 column ring shards only the rows: the
+        # shard owns the full width, its local column wrap IS the torus,
+        # and the strip/edge machinery would compute what the kernel
+        # already has — so degenerate column rings take the 1-D body.
+        strip_fix = two_d and num_cols > 1
+        body = chunk2d if strip_fix else chunk
         if full:
             packed = lax.fori_loop(
-                0, full, lambda _, p: chunk(p, tile), packed
+                0, full, lambda _, p: body(p, tile), packed
             )
         if rem:
-            # One depth-rem exchange feeds all leftover generations (the
-            # blocked-chunk pattern of halo.blocked_local_loop), instead of
-            # rem separate ppermute pairs.
-            ext = halo_extend(packed, phases, depth=rem)
-            for _ in range(rem):  # each step consumes one ghost layer
-                ext = jnp_step(ext)
-            packed = ext
+            packed = (tail2d if strip_fix else tail)(packed)
         return bitlife.unpack(packed)
 
     # check_vma=False: pallas_call's out ShapeDtypeStruct carries no
     # varying-mesh-axes annotation, and the kernel is already per-shard.
+    spec = P(ROWS, COLS) if two_d else P(ROWS, None)
     shmapped = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=P(ROWS, None),
-        out_specs=P(ROWS, None),
+        in_specs=spec,
+        out_specs=spec,
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=0)

@@ -150,15 +150,10 @@ class GolRuntime:
                     f"engine {self.engine!r} has no sharded path; with a "
                     "mesh use 'dense'/'auto' (shard_map+ppermute or "
                     "auto-SPMD), 'bitpack' (packed shard_map+ppermute), or "
-                    "'pallas_bitpack' (fused kernel per shard, 1-D meshes)"
+                    "'pallas_bitpack' (fused kernel per shard)"
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
             if self._resolved == "pallas_bitpack":
-                if mesh_mod.COLS in self.mesh.axis_names:
-                    raise ValueError(
-                        "the sharded Pallas engine is 1-D (row-ring) only; "
-                        "use engine 'bitpack' on 2-D meshes"
-                    )
                 if self.shard_mode != "explicit":
                     raise ValueError(
                         "the sharded Pallas engine has only the explicit "
@@ -169,6 +164,17 @@ class GolRuntime:
                         "the sharded Pallas engine needs halo_depth to be "
                         "a multiple of 8 (DMA row alignment), got "
                         f"{self.halo_depth}"
+                    )
+                from gol_tpu.ops import bitlife
+
+                if (
+                    mesh_mod.COLS in self.mesh.axis_names
+                    and self.halo_depth > bitlife.BITS
+                ):
+                    raise ValueError(
+                        "on a 2-D mesh the sharded Pallas engine's 1-word "
+                        f"column band supports halo_depth <= {bitlife.BITS},"
+                        f" got {self.halo_depth}"
                     )
                 packed_mod.validate_packed_geometry(shape, self.mesh)
             elif self._resolved == "bitpack":
@@ -236,21 +242,25 @@ class GolRuntime:
             if (
                 jax.default_backend() == "tpu"
                 and self.shard_mode == "explicit"
-                and mesh_mod.COLS not in self.mesh.axis_names
                 and (self.halo_depth == 1 or self.halo_depth % 8 == 0)
             ):
                 # Fused kernel per shard when the shard geometry allows:
-                # lane-filling width, aligned shard height, and room for
-                # the 8-deep exchanged ghost band.
+                # lane-filling shard width, aligned shard height, room for
+                # the 8-deep exchanged ghost band, and (2-D meshes) a band
+                # depth within the 1-word column halo's bit light cone.
                 from gol_tpu.ops import bitlife, pallas_bitlife
 
                 rows = self.mesh.shape[mesh_mod.ROWS]
+                cols = self.mesh.shape.get(mesh_mod.COLS, 1)
+                two_d = mesh_mod.COLS in self.mesh.axis_names
                 shard_h = self.geometry.global_height // rows
+                shard_w = self.geometry.global_width // cols
                 depth = 8 if self.halo_depth == 1 else self.halo_depth
                 if (
-                    geom[1] % (pallas_bitlife._LANE * bitlife.BITS) == 0
+                    shard_w % (pallas_bitlife._LANE * bitlife.BITS) == 0
                     and shard_h % pallas_bitlife._ALIGN == 0
                     and depth <= shard_h
+                    and (not two_d or depth <= bitlife.BITS)
                 ):
                     return "pallas_bitpack"
             return "bitpack"

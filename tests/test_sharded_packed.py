@@ -177,11 +177,16 @@ def test_sharded_pallas_matches_oracle(steps):
 def test_sharded_pallas_rejects_bad_geometry():
     from gol_tpu.parallel import packed
 
-    with pytest.raises(ValueError, match="1-D"):
-        packed.compiled_evolve_packed_pallas(mesh_mod.make_mesh_2d(), 8)
     with pytest.raises(ValueError, match="multiple of 8"):
         packed.compiled_evolve_packed_pallas(
             mesh_mod.make_mesh_1d(4), 8, halo_depth=4
+        )
+    # 2-D meshes cap the band depth at the 1-word column halo's light cone.
+    with pytest.raises(ValueError, match="column band"):
+        packed.compiled_evolve_packed_pallas(
+            mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4]),
+            40,
+            halo_depth=40,
         )
 
 
@@ -217,10 +222,117 @@ def test_runtime_sharded_pallas_end_to_end():
     np.testing.assert_array_equal(
         np.asarray(state.board), oracle.run_torus(board0, 10)
     )
-    # 2-D mesh rejected for this engine.
-    with pytest.raises(ValueError, match="1-D"):
+
+
+# -- 2-D-mesh flagship: fused kernel under the block decomposition -----------
+
+
+@pytest.mark.parametrize(
+    "shape,width",
+    [((2, 2), 128), ((2, 4), 256), ((4, 2), 128), ((1, 4), 256), ((4, 1), 32)],
+)
+@pytest.mark.parametrize("steps", [8, 19])  # incl. a jnp remainder tail
+def test_sharded_pallas_2d_matches_oracle(shape, width, steps):
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    rows, cols = shape
+    board = oracle.random_board(32 * rows, width, seed=rows * 10 + cols + steps)
+    mesh = mesh_mod.make_mesh_2d(shape, devices=jax.devices()[: rows * cols])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, steps)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+@pytest.mark.parametrize("halo_depth", [16, 32])
+def test_sharded_pallas_2d_deep_band(halo_depth):
+    """Deeper temporal bands stay inside the 1-word column light cone."""
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(64, 128, seed=77 + halo_depth)
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, halo_depth, halo_depth)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, halo_depth))
+
+
+def test_sharded_pallas_2d_glider_corner_crossing():
+    """A glider through the (32,64) shard junction: the diagonal bit must
+    ride the corner word through both exchange phases, then survive the
+    kernel's edge-word strip repair."""
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    board = np.zeros((64, 128), np.uint8)
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+    board[30:33, 62:65] = g  # centered at the (32, 64) shard junction
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, 16)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+    assert got.sum() == 5  # glider survived the crossing
+
+
+def test_sharded_pallas_2d_custom_rule():
+    from gol_tpu.ops import rules
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(32, 128, seed=88)
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, 11, rule=rules.HIGHLIFE)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    ref = np.asarray(rules.run_rule(jnp.asarray(board), 11, rules.HIGHLIFE))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sharded_pallas_2d_narrow_shard_rejected():
+    from gol_tpu.parallel import packed
+    from gol_tpu.parallel.sharded import place_private
+
+    board = jnp.zeros((64, 128), jnp.uint8)  # shard width 32 -> 1 word
+    mesh = mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="2 packed words"):
+        packed.compiled_evolve_packed_pallas(mesh, 8)(
+            place_private(board, mesh)
+        )
+
+
+def test_runtime_sharded_pallas_2d_end_to_end():
+    from gol_tpu.models import patterns
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    geom = Geometry(size=128, num_ranks=1)  # 128x128, shards 64x64
+    rt = GolRuntime(
+        geometry=geom,
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4]),
+        halo_depth=8,
+    )
+    _, state = rt.run(pattern=4, iterations=10)
+    board0 = patterns.init_global(4, 128, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 10)
+    )
+    # Band depths beyond the column-word light cone are rejected up front.
+    with pytest.raises(ValueError, match="column band"):
         GolRuntime(
-            geometry=Geometry(size=256, num_ranks=1),
+            geometry=geom,
             engine="pallas_bitpack",
-            mesh=mesh_mod.make_mesh_2d(),
+            mesh=mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4]),
+            halo_depth=40,
         )
