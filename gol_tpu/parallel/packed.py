@@ -150,7 +150,7 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
 @functools.lru_cache(maxsize=64)
 def compiled_evolve_packed_pallas(
     mesh: Mesh, steps: int, halo_depth: int = 8, tile_hint: int = 256,
-    rule=None,
+    rule=None, overlap: bool = False,
 ):
     """Sharded evolve running the fused Pallas kernel per shard.
 
@@ -164,6 +164,18 @@ def compiled_evolve_packed_pallas(
     non-multiple remainder of ``steps`` runs on the jnp packed step.
     Optional ``rule`` switches the kernel tail to the generic plane
     matcher.
+
+    ``overlap=True`` restructures each chunk for comm/compute overlap —
+    the interior-first split the reference attempted with nonblocking MPI
+    but forfeited by calling ``MPI_Wait`` before the kernel
+    (gol-main.c:110-114).  The shard's interior rows ``[k, h-k)`` depend
+    only on local data, so their (bulk) kernel launch carries no data
+    dependency on the ring ppermutes and XLA's latency-hiding scheduler
+    can run the band exchange underneath it; only two k-row boundary
+    kernels wait for the band.  The price is reassembling the output from
+    the three pieces (one board copy per chunk, ~1/(22·k/8) of the kernel's
+    bitwise work) — hence a mode, not the default: serial wins single-chip,
+    overlap wins when exchange latency is exposed (multi-chip, DCN).
 
     On **2-D block meshes** (BASELINE config 3's decomposition) the
     exchange grows a second phase: the k-row temporal band vertically, then
@@ -241,20 +253,22 @@ def compiled_evolve_packed_pallas(
             halo_extend(p_u32, phases, depth=halo_depth), tile, halo_depth
         )
 
-    def chunk2d(p_u32, tile):
-        ext = halo_extend(p_u32, phases, depth=halo_depth)  # rows only
-        # Horizontal phase of the two-phase exchange: the edge word-columns
-        # of the already row-extended block (corner words ride this second
-        # hop).  One transpose pulls all four boundary columns into
-        # lane-major layout up front, so the ppermutes and the strip steps
-        # below never touch a [rows, 1] array (which would waste 127/128 of
-        # every lane tile); the kernel input stays the row-extended block
-        # itself, so no full-width rematerialization either.
-        edges_t = jnp.concatenate([ext[:, :2], ext[:, -2:]], axis=1).T
+    def exact_edges(edges_t):
+        """Exact post-chunk edge word-columns from the row-extended block's
+        four boundary columns (transposed, ``[4, h + 2k]``).
+
+        The horizontal phase of the two-phase exchange: ppermute a ghost
+        word-column per side (corner words ride this second hop), then
+        step 3-word strips (ghost + edge + 1 interior — 96-bit no-wrap
+        windows: every edge-word bit sits >= 32 bits from both window
+        boundaries, so k <= 32 steps stay exact), stacked so both sides
+        share one op chain.  Transposed layout throughout: the long row
+        axis fills the 128-wide lanes a ``[rows, 1]`` column would waste.
+        Returns the ``[h, 2]`` left/right edge words after ``halo_depth``
+        generations.
+        """
         left_ghost_t = lax.ppermute(edges_t[3:4], COLS, ring(num_cols, 1))
         right_ghost_t = lax.ppermute(edges_t[0:1], COLS, ring(num_cols, -1))
-        # Exact edge words from 3-word strips (ghost + edge + 1 interior),
-        # stacked so both sides share one op chain.
         strips = jnp.stack(
             [
                 jnp.concatenate([left_ghost_t, edges_t[0:2]], axis=0),
@@ -263,12 +277,67 @@ def compiled_evolve_packed_pallas(
         )  # [2 sides, 3 words, h + 2k rows]
         for _ in range(halo_depth):  # each step consumes one ghost row layer
             strips = jnp_step_nowrap_t(strips)
-        edges = jnp.stack([strips[0, 1], strips[1, 1]], axis=1)  # [h, 2]
+        return jnp.stack([strips[0, 1], strips[1, 1]], axis=1)  # [h, 2]
+
+    def chunk2d(p_u32, tile):
+        ext = halo_extend(p_u32, phases, depth=halo_depth)  # rows only
+        # One transpose pulls all four boundary columns into lane-major
+        # layout up front; the kernel input stays the row-extended block
+        # itself, so no full-width rematerialization either.
+        edges = exact_edges(
+            jnp.concatenate([ext[:, :2], ext[:, -2:]], axis=1).T
+        )
         # Kernel at the lane-aligned shard width; its local column wrap is
         # wrong at the vertical seams, confined by the light cone to the
         # outer halo_depth bits of the two edge words — which the kernel
         # overwrites with `edges` during its own output store.
         return kernel(ext, tile, halo_depth, edges)
+
+    def _boundary_pieces(p_u32, tile_int):
+        """Interior kernel (ppermute-independent) + band-gated edge kernels.
+
+        Returns the three row pieces of the stepped shard.  The interior
+        launch reads only local rows, so XLA schedules the ring ppermutes
+        concurrently with it; the two k-row boundary kernels consume the
+        arrived band plus a 2k-row local margin (their windows span rows
+        ``[-k, 2k)`` and ``[h-2k, h+k)``).
+        """
+        k = halo_depth
+        top_ghost = lax.ppermute(p_u32[-k:], ROWS, ring(num_rows, 1))
+        bottom_ghost = lax.ppermute(p_u32[:k], ROWS, ring(num_rows, -1))
+        interior = kernel(p_u32, tile_int, k)  # output rows [k, h-k)
+        top = kernel(jnp.concatenate([top_ghost, p_u32[: 2 * k]]), k, k)
+        bottom = kernel(
+            jnp.concatenate([p_u32[-2 * k :], bottom_ghost]), k, k
+        )
+        return top, interior, bottom, top_ghost, bottom_ghost
+
+    def chunk_overlap(p_u32, tile_int):
+        top, interior, bottom, _, _ = _boundary_pieces(p_u32, tile_int)
+        return jnp.concatenate([top, interior, bottom], axis=0)
+
+    def chunk2d_overlap(p_u32, tile_int):
+        top, interior, bottom, top_ghost, bottom_ghost = _boundary_pieces(
+            p_u32, tile_int
+        )
+        rows_out = jnp.concatenate([top, interior, bottom], axis=0)
+        # Same strip repair as chunk2d, with the row-extended block's four
+        # boundary columns sliced from the pieces instead of a
+        # materialized extension.  The kernels above could not take an
+        # ``edges`` input (the strips depend on both exchange phases,
+        # which the interior launch must not), so the exact edge words are
+        # spliced by a lane concat instead of the kernel's own output
+        # store — the serial form's advantage this mode trades away for
+        # the overlap.
+        four = lambda a: jnp.concatenate([a[:, :2], a[:, -2:]], axis=1)
+        edges = exact_edges(
+            jnp.concatenate(
+                [four(top_ghost), four(p_u32), four(bottom_ghost)], axis=0
+            ).T
+        )
+        return jnp.concatenate(
+            [edges[:, :1], rows_out[:, 1:-1], edges[:, 1:]], axis=1
+        )
 
     def tail(p_u32):
         # One depth-rem exchange feeds all leftover generations (the
@@ -307,16 +376,28 @@ def compiled_evolve_packed_pallas(
                 f"the 2-D sharded Pallas engine needs >= 2 packed words "
                 f"per shard (edge-word strips), got shard width {w}"
             )
+        if overlap and h < 2 * halo_depth + 8:
+            raise ValueError(
+                f"overlap mode needs shard height (got {h}) >= "
+                f"2*halo_depth + 8 = {2 * halo_depth + 8}: the interior "
+                "kernel must keep at least one aligned row tile that does "
+                "not touch the exchanged band"
+            )
         packed = bitlife.pack(board)
         tile = pallas_bitlife.pick_tile(
-            packed.shape[0], packed.shape[1], tile_hint
+            packed.shape[0] - (2 * halo_depth if overlap else 0),
+            packed.shape[1],
+            tile_hint,
         )
         # A 2-D mesh with a size-1 column ring shards only the rows: the
         # shard owns the full width, its local column wrap IS the torus,
         # and the strip/edge machinery would compute what the kernel
         # already has — so degenerate column rings take the 1-D body.
         strip_fix = two_d and num_cols > 1
-        body = chunk2d if strip_fix else chunk
+        if overlap:
+            body = chunk2d_overlap if strip_fix else chunk_overlap
+        else:
+            body = chunk2d if strip_fix else chunk
         if full:
             packed = lax.fori_loop(
                 0, full, lambda _, p: body(p, tile), packed

@@ -111,10 +111,18 @@ class GolRuntime:
                     "halo_depth > 1 (temporal blocking) only applies to "
                     "sharded runs; pass a mesh"
                 )
-            if self.shard_mode != "explicit":
+            if self.shard_mode != "explicit" and not (
+                self.shard_mode == "overlap"
+                and self._resolved == "pallas_bitpack"
+            ):
+                # The sharded Pallas engine's overlap form keeps the
+                # k-deep band exchange (its interior/boundary split is
+                # band-depth-aware); the dense/XLA-packed overlap splits
+                # assume single-layer halos.
                 raise ValueError(
-                    "halo_depth > 1 requires shard_mode 'explicit' "
-                    f"(got {self.shard_mode!r})"
+                    "halo_depth > 1 requires shard_mode 'explicit' (or "
+                    "'overlap' with the sharded Pallas engine); got "
+                    f"{self.shard_mode!r}"
                 )
             rows = self.mesh.shape.get(mesh_mod.ROWS, 1)
             cols = self.mesh.shape.get(mesh_mod.COLS, 1)
@@ -154,11 +162,23 @@ class GolRuntime:
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
             if self._resolved == "pallas_bitpack":
-                if self.shard_mode != "explicit":
+                if self.shard_mode not in ("explicit", "overlap"):
                     raise ValueError(
-                        "the sharded Pallas engine has only the explicit "
-                        f"ring program (got shard_mode {self.shard_mode!r})"
+                        "the sharded Pallas engine has the explicit and "
+                        "overlap ring programs only (got shard_mode "
+                        f"{self.shard_mode!r})"
                     )
+                if self.shard_mode == "overlap":
+                    depth = 8 if self.halo_depth == 1 else self.halo_depth
+                    shard_h = self.geometry.global_height // self.mesh.shape[
+                        mesh_mod.ROWS
+                    ]
+                    if shard_h < 2 * depth + 8:
+                        raise ValueError(
+                            f"overlap mode needs shard height ({shard_h}) "
+                            f">= 2*halo_depth + 8 = {2 * depth + 8}; "
+                            "shrink halo_depth or use shard_mode 'explicit'"
+                        )
                 if self.halo_depth > 1 and self.halo_depth % 8:
                     raise ValueError(
                         "the sharded Pallas engine needs halo_depth to be "
@@ -211,8 +231,10 @@ class GolRuntime:
         - single-device fresh runs take the fused Pallas bit-packed kernel
           on TPU when the width fills whole lane tiles, else the XLA
           bit-packed engine when the width packs, else dense;
-        - stale_t0 (reference-compat) and overlap/auto shard modes are
-          dense-only paths.
+        - shard_mode 'overlap' prefers the sharded Pallas engine's overlap
+          form, falling back to the XLA packed overlap (1-D) or dense;
+        - stale_t0 (reference-compat) and shard_mode 'auto' are dense-only
+          paths.
         """
         if self.halo_mode != "fresh":
             return "dense"
@@ -220,16 +242,13 @@ class GolRuntime:
         if self.mesh is not None:
             if self.shard_mode == "auto":
                 return "dense"  # auto-SPMD exists for the dense step only
-            if (
-                self.shard_mode == "overlap"
-                and mesh_mod.COLS in self.mesh.axis_names
-            ):
-                return "dense"  # packed overlap is 1-D only
+            two_d = mesh_mod.COLS in self.mesh.axis_names
+            overlap = self.shard_mode == "overlap"
             try:
                 packed_mod.validate_packed_geometry(geom, self.mesh)
             except ValueError:
                 return "dense"
-            if self.halo_depth > 1 and mesh_mod.COLS in self.mesh.axis_names:
+            if self.halo_depth > 1 and two_d:
                 # The packed engine's horizontal ghost quantum is the
                 # 32-cell word; if the shard is too narrow in words for the
                 # requested depth, dense (cell-quantum halos) still works.
@@ -239,30 +258,32 @@ class GolRuntime:
                 words = self.geometry.global_width // cols // bitlife.BITS
                 if self.halo_depth > words:
                     return "dense"
-            if (
-                jax.default_backend() == "tpu"
-                and self.shard_mode == "explicit"
-                and (self.halo_depth == 1 or self.halo_depth % 8 == 0)
+            if jax.default_backend() == "tpu" and (
+                self.halo_depth == 1 or self.halo_depth % 8 == 0
             ):
                 # Fused kernel per shard when the shard geometry allows:
                 # lane-filling shard width, aligned shard height, room for
-                # the 8-deep exchanged ghost band, and (2-D meshes) a band
-                # depth within the 1-word column halo's bit light cone.
+                # the 8-deep exchanged ghost band (overlap additionally
+                # needs an aligned interior tile clear of both bands), and
+                # (2-D meshes) a band depth within the 1-word column halo's
+                # bit light cone.
                 from gol_tpu.ops import bitlife, pallas_bitlife
 
                 rows = self.mesh.shape[mesh_mod.ROWS]
                 cols = self.mesh.shape.get(mesh_mod.COLS, 1)
-                two_d = mesh_mod.COLS in self.mesh.axis_names
                 shard_h = self.geometry.global_height // rows
                 shard_w = self.geometry.global_width // cols
                 depth = 8 if self.halo_depth == 1 else self.halo_depth
+                min_h = 2 * depth + 8 if overlap else depth
                 if (
                     shard_w % (pallas_bitlife._LANE * bitlife.BITS) == 0
                     and shard_h % pallas_bitlife._ALIGN == 0
-                    and depth <= shard_h
+                    and shard_h >= min_h
                     and (not two_d or depth <= bitlife.BITS)
                 ):
                     return "pallas_bitpack"
+            if overlap and two_d:
+                return "dense"  # the XLA packed overlap program is 1-D only
             return "bitpack"
         from gol_tpu.ops import bitlife
 
@@ -299,6 +320,7 @@ class GolRuntime:
                     8 if self.halo_depth == 1 else self.halo_depth,
                     self.tile_hint,
                     self._rule,
+                    self.shard_mode == "overlap",
                 ),
                 (),
                 (),

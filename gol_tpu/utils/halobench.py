@@ -17,7 +17,7 @@ numbers.
 
 Run as a module for a JSON report:
 ``python -m gol_tpu.utils.halobench [size] [steps] [mesh {1d,2d}]
-[engine {dense,bitpack}]``.
+[engine {dense,bitpack,pallas,pallas_overlap}]``.
 """
 
 from __future__ import annotations
@@ -88,7 +88,7 @@ def _time(fn, arg, repeats: int = 3) -> float:
     return time_best(fn, lambda: arg, repeats)
 
 
-ENGINES = ("dense", "bitpack")
+ENGINES = ("dense", "bitpack", "pallas", "pallas_overlap")
 
 
 def measure(
@@ -108,6 +108,14 @@ def measure(
     single-device evolve; ``exchange_s`` still times dense-row ppermutes,
     an upper bound on the packed exchange's wire time.
 
+    ``engine="pallas"`` / ``"pallas_overlap"`` attribute the flagship
+    sharded Pallas engine's serial and comm/compute-overlap forms
+    (:func:`gol_tpu.parallel.packed.compiled_evolve_packed_pallas`); the
+    compute ceiling is the single-device fused-kernel evolve.  Comparing
+    the two engines' ``exposed_exchange_s`` (same mesh, same size) measures
+    exactly what the overlap form hides.  ``steps`` should be a multiple of
+    8 (the band depth) so no jnp remainder tail pollutes the attribution.
+
     Returns ``{"exchange_s": ..., "step_s": ..., "stencil_s": ...,
     "exposed_exchange_s": ...}``, all per generation.
     """
@@ -117,7 +125,14 @@ def measure(
     board_np = (rng.random((size, size)) < 0.35).astype(np.uint8)
     board = jax.device_put(jnp.asarray(board_np), board_sharding(mesh))
     t_exch = _time(_exchange_only(mesh, steps), board) / steps
-    if engine == "bitpack":
+    if engine in ("pallas", "pallas_overlap"):
+        from gol_tpu.parallel import packed as packed_mod
+
+        packed_mod.validate_packed_geometry(board.shape, mesh)
+        step_fn = packed_mod.compiled_evolve_packed_pallas(
+            mesh, steps, overlap=engine == "pallas_overlap"
+        )
+    elif engine == "bitpack":
         from gol_tpu.parallel import packed as packed_mod
 
         packed_mod.validate_packed_geometry(board.shape, mesh)
@@ -133,7 +148,11 @@ def measure(
         jnp.asarray(board_np[:local_h, :local_w]),
         mesh.devices.ravel()[0],
     )
-    if engine == "bitpack":
+    if engine in ("pallas", "pallas_overlap"):
+        from gol_tpu.ops import pallas_bitlife
+
+        sten_fn = lambda b: pallas_bitlife.evolve(b, steps)
+    elif engine == "bitpack":
         from gol_tpu.ops import bitlife
 
         sten_fn = lambda b: bitlife.evolve_dense_io(b, steps)

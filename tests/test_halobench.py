@@ -64,6 +64,16 @@ def test_measure_bitpack_engine():
     assert out["exposed_exchange_s"] >= 0
 
 
+def test_measure_pallas_engines():
+    """Serial and overlap forms of the flagship engine both attribute."""
+    mesh = mesh_mod.make_mesh_1d(4)  # shard height 64 >= 2*8 + 8
+    serial = halobench.measure(mesh, 256, steps=8, engine="pallas")
+    overlap = halobench.measure(mesh, 256, steps=8, engine="pallas_overlap")
+    for out in (serial, overlap):
+        assert out["step_s"] > 0 and out["stencil_s"] > 0
+        assert out["exposed_exchange_s"] >= 0
+
+
 def test_measure_rejects_unknown_engine():
     import pytest
 

@@ -311,6 +311,199 @@ def test_sharded_pallas_2d_narrow_shard_rejected():
         )
 
 
+# -- flagship overlap mode: interior kernel under the band exchange ----------
+
+
+@pytest.mark.parametrize("steps", [8, 16, 19])  # incl. a jnp remainder tail
+def test_sharded_pallas_overlap_matches_oracle(steps):
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(128, 64, seed=41 + steps)
+    mesh = mesh_mod.make_mesh_1d(4)  # shard height 32 >= 2*8 + 8
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, steps, overlap=True)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+@pytest.mark.parametrize(
+    "shape,width", [((2, 2), 128), ((2, 4), 256), ((1, 4), 256), ((4, 1), 32)]
+)
+def test_sharded_pallas_overlap_2d_matches_oracle(shape, width):
+    from gol_tpu.parallel.sharded import place_private
+
+    rows, cols = shape
+    board = oracle.random_board(32 * rows, width, seed=rows + cols)
+    mesh = mesh_mod.make_mesh_2d(shape, devices=jax.devices()[: rows * cols])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, 16, overlap=True)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+
+
+def test_sharded_pallas_overlap_deep_band():
+    """k=16 band: boundary kernels span [-16, 32) with a 48-row shard."""
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(96, 128, seed=55)
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(
+            mesh, 16, halo_depth=16, overlap=True
+        )(place_private(jnp.asarray(board), mesh))
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+
+
+def test_sharded_pallas_overlap_glider_corner_crossing():
+    from gol_tpu.parallel.sharded import place_private
+
+    board = np.zeros((64, 128), np.uint8)
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+    board[30:33, 62:65] = g  # centered at the (32, 64) shard junction
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, 16, overlap=True)(
+            place_private(jnp.asarray(board), mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+    assert got.sum() == 5
+
+
+def test_sharded_pallas_overlap_custom_rule():
+    from gol_tpu.ops import rules
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(64, 128, seed=66)
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(
+            mesh, 11, rule=rules.HIGHLIFE, overlap=True
+        )(place_private(jnp.asarray(board), mesh))
+    )
+    ref = np.asarray(rules.run_rule(jnp.asarray(board), 11, rules.HIGHLIFE))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sharded_pallas_overlap_short_shard_rejected():
+    from gol_tpu.parallel.sharded import place_private
+
+    board = jnp.zeros((64, 64), jnp.uint8)  # shard height 16 < 2*8 + 8
+    mesh = mesh_mod.make_mesh_1d(4)
+    with pytest.raises(ValueError, match="overlap mode needs shard height"):
+        packed.compiled_evolve_packed_pallas(mesh, 8, overlap=True)(
+            place_private(board, mesh)
+        )
+
+
+def test_overlap_interior_kernel_independent_of_exchange():
+    """The overlap property itself, pinned at the jaxpr level: the interior
+    (bulk) Pallas launch must not be a transitive consumer of any ppermute,
+    or XLA's latency-hiding scheduler has nothing to overlap.  The serial
+    form's single launch, by contrast, must depend on the exchange."""
+    import jax as jax_mod
+    from jax.extend import core as jex_core
+    from gol_tpu.parallel.mesh import board_sharding
+
+    def depends_on_ppermute(overlap):
+        mesh = mesh_mod.make_mesh_1d(4)
+        fn = packed.compiled_evolve_packed_pallas(mesh, 8, overlap=overlap)
+        spec = jax_mod.ShapeDtypeStruct(
+            (128, 128), jnp.uint8, sharding=board_sharding(mesh)
+        )
+        top = jax_mod.make_jaxpr(lambda b: fn(b))(spec).jaxpr
+
+        def sub_jaxprs(v):
+            if hasattr(v, "eqns"):  # Jaxpr
+                yield v
+            elif hasattr(v, "jaxpr"):  # ClosedJaxpr
+                yield v.jaxpr
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    yield from sub_jaxprs(x)
+
+        def collect(jpr, acc):
+            acc.append(jpr)
+            for eqn in jpr.eqns:
+                for v in eqn.params.values():
+                    for j in sub_jaxprs(v):
+                        collect(j, acc)
+            return acc
+
+        # The chunk lives in one jaxpr (the fori_loop body): both the
+        # ppermutes and the kernel launches of one chunk appear there in
+        # topological order, so intra-jaxpr taint propagation decides the
+        # dependency.
+        results = []
+        for jpr in collect(top, []):
+            names = [e.primitive.name for e in jpr.eqns]
+            if "ppermute" not in names or "pallas_call" not in names:
+                continue
+            tainted = set()
+            for eqn in jpr.eqns:
+                hit = any(
+                    not isinstance(v, jex_core.Literal) and v in tainted
+                    for v in eqn.invars
+                )
+                if eqn.primitive.name == "pallas_call":
+                    results.append(hit)
+                if eqn.primitive.name == "ppermute" or hit:
+                    tainted.update(eqn.outvars)
+        return results
+
+    serial = depends_on_ppermute(False)
+    assert serial and all(serial)  # the one serial launch waits on the band
+    overlap = depends_on_ppermute(True)
+    # Three launches per chunk: interior (clean) + two boundary (gated).
+    assert len(overlap) == 3
+    assert sorted(overlap) == [False, True, True]
+
+
+def test_runtime_sharded_pallas_overlap_end_to_end():
+    from gol_tpu.models import patterns
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    geom = Geometry(size=32, num_ranks=4)  # 128x32, shard height 32
+    rt = GolRuntime(
+        geometry=geom,
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="overlap",
+    )
+    _, state = rt.run(pattern=4, iterations=10)
+    board0 = patterns.init_global(4, 32, 4)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 10)
+    )
+    # Overlap + deep band on a 2-D mesh rides the same validation.
+    rt2 = GolRuntime(
+        geometry=Geometry(size=128, num_ranks=1),
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4]),
+        shard_mode="overlap",
+        halo_depth=16,
+    )
+    _, state2 = rt2.run(pattern=4, iterations=16)
+    board0 = patterns.init_global(4, 128, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state2.board), oracle.run_torus(board0, 16)
+    )
+    # Too-short shards for the interior/boundary split are rejected up front.
+    with pytest.raises(ValueError, match="overlap mode needs shard height"):
+        GolRuntime(
+            geometry=Geometry(size=16, num_ranks=4),  # shard height 16
+            engine="pallas_bitpack",
+            mesh=mesh_mod.make_mesh_1d(4),
+            shard_mode="overlap",
+        )
+
+
 def test_runtime_sharded_pallas_2d_end_to_end():
     from gol_tpu.models import patterns
     from gol_tpu.models.state import Geometry
