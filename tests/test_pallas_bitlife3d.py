@@ -67,16 +67,139 @@ def test_pick_tile3d_budget():
 
 def test_evolve3d_fallback_when_vmem_infeasible(monkeypatch):
     # Force the infeasible branch regardless of geometry and check the
-    # result still matches the XLA engine.  The Pallas entry is patched to
-    # raise, so a cached/alternate trace taking the kernel path cannot let
+    # result still matches the XLA engine.  The Pallas entries are patched
+    # to raise, so a cached/alternate trace taking a kernel path cannot let
     # this test pass vacuously (both paths are bit-exact otherwise).
     monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 0)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: None
+    )
 
     def _boom(*a, **k):
         raise AssertionError("Pallas path taken despite tile == 0")
 
     monkeypatch.setattr(pallas_bitlife3d, "multi_step_pallas_packed3d", _boom)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_wt", _boom
+    )
     vol = _rand_vol(8, 8, 32, seed=12)
     got = np.asarray(pallas_bitlife3d.evolve3d(jnp.asarray(vol), 4))
     ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 4))
     np.testing.assert_array_equal(got, ref)
+
+
+# -- word-tiled kernel: the 1024³-class path ---------------------------------
+
+
+def _to_word_leading(vol):
+    return jax.lax.bitcast_convert_type(
+        bitlife3d.pack3d(jnp.asarray(vol)), jnp.int32
+    ).transpose(2, 0, 1)
+
+
+def _from_word_leading(pw):
+    return np.asarray(
+        bitlife3d.unpack3d(
+            jax.lax.bitcast_convert_type(pw.transpose(1, 2, 0), jnp.uint32)
+        )
+    )
+
+
+@pytest.mark.parametrize("rule", [life3d.BAYS_4555, life3d.BAYS_5766])
+@pytest.mark.parametrize("k", [1, 3, 8])
+@pytest.mark.parametrize("tile_w", [1, 2])
+def test_wt_kernel_matches_xla_packed(rule, k, tile_w):
+    """tile_w=1 forces a word-chunk seam: the ghost word's bit light cone
+    must carry the x neighborhood across chunks for all k generations."""
+    vol = _rand_vol(16, 16, 64, seed=k + len(rule.birth))  # nw = 2
+    pw = _to_word_leading(vol)
+    got = pallas_bitlife3d.multi_step_pallas_packed3d_wt(
+        pw, 8, tile_w, k, rule
+    )
+    ref = np.asarray(
+        bitlife3d.evolve3d_dense_io(jnp.asarray(vol), k, rule)
+    )
+    np.testing.assert_array_equal(_from_word_leading(got), ref)
+
+
+def test_wt_kernel_wide_volume_seams():
+    """4 words × tile_w=2: seams at word 2 and at the torus x wrap."""
+    vol = _rand_vol(16, 8, 128, seed=5)  # nw = 4
+    pw = _to_word_leading(vol)
+    got = pallas_bitlife3d.multi_step_pallas_packed3d_wt(pw, 8, 2, 4)
+    ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 4))
+    np.testing.assert_array_equal(_from_word_leading(got), ref)
+
+
+def test_wt_kernel_validation():
+    pw = jnp.zeros((2, 16, 32), jnp.int32)
+    with pytest.raises(ValueError, match="tile"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_wt(pw, 12, 1, 1)
+    with pytest.raises(ValueError, match="word tile"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_wt(pw, 8, 3, 1)
+    with pytest.raises(ValueError, match="light cone"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_wt(pw, 8, 1, 33)
+    with pytest.raises(ValueError, match="pad"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_wt(pw, 8, 1, 16)
+
+
+def test_pick_tile3d_wt_covers_1024_cube():
+    # The headline size: a (32, 1024)-word plane doesn't fit whole, the
+    # word-tiled split does.
+    got = pallas_bitlife3d.pick_tile3d_wt(1024, 32, 1024)
+    assert got is not None
+    tile_d, tile_w = got
+    assert 1024 % tile_d == 0 and 32 % tile_w == 0
+    window = (
+        (tile_w + 2)
+        * (tile_d + 16)
+        * 1024
+        * 4
+        * pallas_bitlife3d._LIVE_WINDOWS_WT
+    )
+    assert window <= pallas_bitlife3d._SCOPED_LIMIT
+
+
+def test_evolve3d_strict_raises_instead_of_fallback(monkeypatch):
+    """ADVICE r1: an explicit --engine pallas run must never be silently
+    relabeled as Pallas throughput while running the XLA path."""
+    monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 0)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: None
+    )
+    vol = jnp.zeros((8, 8, 32), jnp.uint8)
+    with pytest.raises(ValueError, match="scoped VMEM"):
+        pallas_bitlife3d.evolve3d(vol, 2, life3d.BAYS_4555, True)
+
+
+def test_cli3d_explicit_pallas_fails_loud(monkeypatch, capsys):
+    from gol_tpu import cli3d
+
+    monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 0)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: None
+    )
+    rc = cli3d.main(["2", "32", "2", "64", "0", "--engine", "pallas"])
+    assert rc == 255
+    assert "scoped VMEM" in capsys.readouterr().out
+
+
+def test_evolve3d_dispatches_to_wt(monkeypatch):
+    """When the plane window is infeasible but the word-tiled one fits,
+    evolve3d must take the wt kernel (not the XLA fallback)."""
+    monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 0)
+    calls = []
+    real = pallas_bitlife3d.multi_step_pallas_packed3d_wt
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_wt", spy
+    )
+    vol = _rand_vol(16, 8, 64, seed=21)
+    got = np.asarray(pallas_bitlife3d.evolve3d(jnp.asarray(vol), 11))
+    ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 11))
+    np.testing.assert_array_equal(got, ref)
+    assert calls  # the wt kernel actually ran (incl. the remainder launch)
