@@ -23,8 +23,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+# Runnable as a plain script: python puts benchmarks/ (not the repo root)
+# on sys.path, so gol_tpu and tests.oracle would not import (ADVICE r1).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

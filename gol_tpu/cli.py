@@ -92,6 +92,10 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     # generations, roll back and replay on corruption (utils/guard.py).
     ext.add_argument("--guard-every", type=int, default=0, metavar="K")
     ext.add_argument("--guard-max-restores", type=int, default=3, metavar="N")
+    # Cross-engine redundancy audit: recompute each audited chunk on a
+    # second bit-exact engine and require matching fingerprints (catches
+    # in-range flips the 0/1 invariant cannot see; ~2x audited compute).
+    ext.add_argument("--guard-redundant", action="store_true")
     ns = ext.parse_args(list(argv))
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE)
@@ -145,6 +149,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         if ns.iterations < 0:
             raise ValueError(f"iterations must be >= 0, got {ns.iterations}")
+        if ns.guard_redundant and ns.guard_every <= 0:
+            raise ValueError(
+                "--guard-redundant audits chunks, so it requires "
+                "--guard-every K > 0"
+            )
         if ns.guard_every < 0:
             raise ValueError(
                 f"--guard-every must be >= 0, got {ns.guard_every} "
@@ -182,6 +191,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 config=guard_mod.GuardConfig(
                     check_every=ns.guard_every,
                     max_restores=ns.guard_max_restores,
+                    redundant=ns.guard_redundant,
                 ),
                 resume=ns.resume,
             )

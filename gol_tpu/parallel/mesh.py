@@ -110,6 +110,12 @@ def shard_board(board, mesh: Mesh):
     init, so the copies agree).
     """
     sharding = board_sharding(mesh)
+    current = getattr(board, "sharding", None)
+    if current is not None and sharding.is_equivalent_to(current, board.ndim):
+        # Already placed (e.g. a sharded-checkpoint resume assembled the
+        # global array directly); np.asarray below would gather — or fail
+        # outright on a non-fully-addressable multi-host array.
+        return board
     if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
         board_np = np.asarray(board)
         return jax.make_array_from_callback(

@@ -423,6 +423,47 @@ class GolRuntime:
         the snapshot on resume (re-freezing from the resumed board would
         silently change the semantics mid-run).
         """
+        if resume and ckpt_mod.is_sharded(resume):
+            meta = ckpt_mod.load_sharded_meta(resume)
+            if meta.num_ranks != self.geometry.num_ranks:
+                raise ValueError(
+                    f"checkpoint has {meta.num_ranks} ranks, run configured "
+                    f"for {self.geometry.num_ranks}"
+                )
+            expected = (self.geometry.global_height, self.geometry.global_width)
+            if meta.shape != expected:
+                raise ValueError(
+                    f"checkpoint board {meta.shape} != configured {expected}"
+                )
+            mine = None if self._rule is None else self._rule.rulestring()
+            if meta.rule != mine:
+                raise ValueError(
+                    f"checkpoint was written by a {meta.rule or 'B3/S23'} "
+                    f"run; this run is configured for {mine or 'B3/S23'} — "
+                    "pass the matching --rule to resume"
+                )
+            if self.halo_mode == "stale_t0":
+                raise ValueError(
+                    "sharded checkpoints are written by fresh-halo runs "
+                    "only; a stale_t0 run cannot resume from one bit-exactly"
+                )
+            if self.mesh is not None:
+                # Each host reads only the rows its devices own — the
+                # load-side mirror of the gather-free save.
+                board = jax.make_array_from_callback(
+                    meta.shape,
+                    mesh_mod.board_sharding(self.mesh),
+                    lambda idx: ckpt_mod.read_sharded_region(
+                        resume, meta, idx
+                    ),
+                )
+            else:
+                board = jax.device_put(
+                    ckpt_mod.read_sharded_region(
+                        resume, meta, (slice(None), slice(None))
+                    )
+                )
+            return GolState.create(board, meta.generation)
         if resume:
             snap = ckpt_mod.load(resume)
             if snap.num_ranks != self.geometry.num_ranks:
@@ -468,45 +509,53 @@ class GolRuntime:
     def _save_snapshot(
         self,
         state: GolState,
-        board_np: Optional[np.ndarray] = None,
         fingerprint: Optional[int] = None,
     ) -> None:
         """Persist a snapshot.
 
-        Callers that already hold a host copy of the board (the guarded
-        loop's last-good buffer) pass it via ``board_np`` to skip a
-        redundant device fetch / multi-host all-gather; likewise a
-        device-computed ``fingerprint`` (the guard audit's) skips the
-        host-side recompute.  Multi-host jobs always write from process 0
-        only, fenced with a global barrier so no host races into the next
-        chunk while the file is mid-write.
+        A device-computed ``fingerprint`` (the guard audit's) skips the
+        host-side recompute and — multi-host — stamps the sharded manifest
+        with the global hash no single host could compute.  Multi-host
+        jobs write the sharded format (each process its own pieces) and
+        fence with a global barrier so no host races into the next chunk
+        while files are mid-write.
         """
         top0, bottom0 = self._halos if self._halos is not None else (None, None)
         multi = jax.process_count() > 1
-        if board_np is None:
-            if multi:
-                from gol_tpu.parallel import multihost
-
-                board_np = multihost.fetch_global(state.board)
-            else:
-                board_np = np.asarray(state.board)
-        if not multi or jax.process_index() == 0:
-            ckpt_mod.save(
-                ckpt_mod.checkpoint_path(
+        rule = None if self._rule is None else self._rule.rulestring()
+        if multi:
+            # Sharded format: every process writes only the rectangles its
+            # devices own — no all-gather, no host ever materializes the
+            # board (VERDICT r1 #4; at 65536² the old fetch_global path
+            # replicated 4 GB to every host per snapshot).  stale_t0 never
+            # reaches here (multi-host runs are fresh-halo by validation).
+            ckpt_mod.save_sharded(
+                ckpt_mod.sharded_checkpoint_path(
                     self.checkpoint_dir, int(state.generation)
                 ),
-                board_np,
+                state.board,
                 int(state.generation),
                 self.geometry.num_ranks,
-                top0=None if top0 is None else np.asarray(top0),
-                bottom0=None if bottom0 is None else np.asarray(bottom0),
+                rule=rule,
                 fingerprint=fingerprint,
-                rule=None if self._rule is None else self._rule.rulestring(),
             )
-        if multi:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("gol_checkpoint")
+            return
+        board_np = np.asarray(state.board)
+        ckpt_mod.save(
+            ckpt_mod.checkpoint_path(
+                self.checkpoint_dir, int(state.generation)
+            ),
+            board_np,
+            int(state.generation),
+            self.geometry.num_ranks,
+            top0=None if top0 is None else np.asarray(top0),
+            bottom0=None if bottom0 is None else np.asarray(bottom0),
+            fingerprint=fingerprint,
+            rule=rule,
+        )
 
     # -- shared compile machinery -------------------------------------------
     def chunk_schedule(self, iterations: int, chunk: int) -> list:

@@ -135,12 +135,300 @@ def load(path: str) -> Snapshot:
         )
 
 
+def _sharded_complete(dirpath: str) -> bool:
+    """True when the manifest and every shard file it references exist.
+
+    A sharded checkpoint directory is not created atomically (each host
+    lands its own file, the barrier comes after), so a crash mid-save can
+    leave a torn directory; :func:`latest` must never prefer one over an
+    older complete snapshot.
+    """
+    try:
+        with np.load(os.path.join(dirpath, _MANIFEST)) as data:
+            procs = set(int(p) for p in data["procs"])
+    except (OSError, KeyError, ValueError):
+        return False
+    return all(
+        os.path.exists(os.path.join(dirpath, f"shards_{p:05d}.npz"))
+        for p in procs
+    )
+
+
 def latest(directory: str) -> Optional[str]:
     if not os.path.isdir(directory):
         return None
     ckpts = sorted(
         f
         for f in os.listdir(directory)
-        if f.startswith("ckpt_") and f.endswith(CKPT_SUFFIX)
+        if f.startswith("ckpt_")
+        and (
+            f.endswith(CKPT_SUFFIX)
+            or (
+                f.endswith(SHARD_DIR_SUFFIX)
+                and _sharded_complete(os.path.join(directory, f))
+            )
+        )
     )
     return os.path.join(directory, ckpts[-1]) if ckpts else None
+
+
+# -- sharded checkpoints (multi-host: no host materializes the board) --------
+#
+# Layout of a ``ckpt_<gen>.gol.d/`` directory:
+#   manifest.npz          — geometry + the full piece table (rect -> writer
+#                           process), identical on every host by construction
+#   shards_<proc>.npz     — that process's pieces: one array per rectangle of
+#                           the board it owns, each stamped with a
+#                           global-offset fingerprint
+#
+# The piece table is computed deterministically on every process from
+# ``Sharding.devices_indices_map`` (the writer-planning idea of
+# ``multihost.write_host_dumps``), so save needs zero coordination traffic;
+# the only collective is the caller's barrier after the files land.  Because
+# the fingerprint is a position-weighted sum mod 2^32
+# (:func:`gol_tpu.utils.guard.fingerprint_np`), the per-piece stamps of the
+# disjoint cover add up to the whole board's fingerprint — so a global
+# audit stamp can be verified at load without assembling the board.
+
+SHARD_DIR_SUFFIX = ".gol.d"
+_MANIFEST = "manifest.npz"
+
+
+def sharded_checkpoint_path(directory: str, generation: int) -> str:
+    return os.path.join(
+        directory, f"ckpt_{generation:012d}{SHARD_DIR_SUFFIX}"
+    )
+
+
+def is_sharded(path: str) -> bool:
+    return os.path.isdir(path)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedMeta:
+    """The manifest: everything except the board data itself."""
+
+    shape: tuple
+    generation: int
+    num_ranks: int
+    rule: Optional[str]
+    rects: np.ndarray  # [n, 4] (r0, r1, c0, c1) disjoint cover
+    procs: np.ndarray  # [n] writer process per rect
+    fingerprint: Optional[int]  # global stamp (guard audit), if known
+
+
+def _piece_table(sharding, shape):
+    """Deterministic (rect -> lowest owning process) map, same on all hosts."""
+    from gol_tpu.parallel.multihost import _rect
+
+    owner = {}
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        r = _rect(idx, shape)
+        p = dev.process_index
+        if r not in owner or p < owner[r]:
+            owner[r] = p
+    return owner
+
+
+def save_sharded(
+    dirpath: str,
+    arr,
+    generation: int,
+    num_ranks: int,
+    rule: Optional[str] = None,
+    fingerprint: Optional[int] = None,
+) -> list:
+    """Write this process's pieces of a sharded board (collective call).
+
+    Every process calls this; each writes one ``shards_<proc>.npz`` holding
+    exactly the rectangles assigned to it (lowest process index owning a
+    rect writes it — replicas dedupe), and process 0 additionally writes
+    the manifest.  No process ever holds more than its own addressable
+    shards.  The caller is responsible for a barrier before using the
+    checkpoint (``runtime._save_snapshot`` fences with
+    ``sync_global_devices``).  Returns the paths this process wrote.
+    """
+    import jax
+
+    from gol_tpu.parallel.multihost import _rect
+    from gol_tpu.utils.guard import fingerprint_np
+
+    os.makedirs(dirpath, exist_ok=True)
+    sharding = arr.sharding
+    shape = tuple(arr.shape)
+    owner = _piece_table(sharding, shape)
+    me = jax.process_index()
+    written = []
+    pieces, seen = [], set()
+    for shard in arr.addressable_shards:
+        r = _rect(shard.index, shape)
+        if owner[r] != me or r in seen:
+            continue
+        seen.add(r)
+        pieces.append((r, np.asarray(shard.data, np.uint8)))
+    arrays = dict(
+        rects=np.asarray([r for r, _ in pieces], np.int64).reshape(-1, 4),
+        fps=np.asarray(
+            [
+                fingerprint_np(data, r0, c0)
+                for (r0, _, c0, _), data in pieces
+            ],
+            np.uint32,
+        ),
+    )
+    for i, (_, data) in enumerate(pieces):
+        arrays[f"piece_{i}"] = data
+    path = os.path.join(dirpath, f"shards_{me:05d}.npz")
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+    written.append(path)
+    if me == 0:
+        table = sorted(owner.items())
+        manifest = dict(
+            shape=np.asarray(shape, np.int64),
+            generation=np.int64(generation),
+            num_ranks=np.int64(num_ranks),
+            rects=np.asarray([r for r, _ in table], np.int64).reshape(-1, 4),
+            procs=np.asarray([p for _, p in table], np.int64),
+        )
+        if rule is not None:
+            manifest["rule"] = np.asarray(rule)
+        if fingerprint is not None:
+            manifest["fingerprint"] = np.uint32(fingerprint)
+        mpath = os.path.join(dirpath, _MANIFEST)
+        tmp = mpath + ".tmp.npz"
+        np.savez_compressed(tmp, **manifest)
+        os.replace(tmp, mpath)
+        written.append(mpath)
+    return written
+
+
+def load_sharded_meta(dirpath: str) -> ShardedMeta:
+    """Read + validate the manifest: the cover must tile the board exactly,
+    and (when a global stamp is present) the per-piece fingerprints must
+    add up to it — both checked without assembling any board data."""
+    with np.load(os.path.join(dirpath, _MANIFEST)) as data:
+        meta = ShardedMeta(
+            shape=tuple(int(x) for x in data["shape"]),
+            generation=int(data["generation"]),
+            num_ranks=int(data["num_ranks"]),
+            rule=str(data["rule"]) if "rule" in data else None,
+            rects=data["rects"].copy(),
+            procs=data["procs"].copy(),
+            fingerprint=(
+                int(data["fingerprint"]) if "fingerprint" in data else None
+            ),
+        )
+    h, w = meta.shape
+    area = int(
+        sum((r1 - r0) * (c1 - c0) for r0, r1, c0, c1 in meta.rects)
+    )
+    if area != h * w:
+        raise CorruptSnapshotError(
+            f"{dirpath}: piece table covers {area} cells of {h * w}; the "
+            "manifest is corrupt or incomplete"
+        )
+    if meta.fingerprint is not None:
+        total = np.uint32(0)
+        with np.errstate(over="ignore"):
+            for proc in sorted(set(int(p) for p in meta.procs)):
+                with np.load(
+                    os.path.join(dirpath, f"shards_{proc:05d}.npz")
+                ) as sf:
+                    total = total + np.sum(
+                        sf["fps"].astype(np.uint32), dtype=np.uint32
+                    )
+        if int(total) != meta.fingerprint:
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece fingerprints sum to {int(total):#010x} "
+                f"!= stamped {meta.fingerprint:#010x}; some shard file is "
+                "corrupt"
+            )
+    return meta
+
+
+def read_sharded_region(
+    dirpath: str, meta: ShardedMeta, index
+) -> np.ndarray:
+    """Assemble one rectangular region from the piece files.
+
+    ``index`` is a tuple of slices over the global board (the contract of
+    ``jax.make_array_from_callback``, so a resuming host reads *only* the
+    rows its devices own).  Each piece consulted is fingerprint-verified
+    once per call; pieces that don't intersect the region are never read.
+    """
+    h, w = meta.shape
+    rs, cs = index[0], index[1] if len(index) > 1 else slice(None)
+    lo_r = 0 if rs.start is None else rs.start
+    hi_r = h if rs.stop is None else rs.stop
+    lo_c = 0 if cs.start is None else cs.start
+    hi_c = w if cs.stop is None else cs.stop
+    out = np.empty((hi_r - lo_r, hi_c - lo_c), np.uint8)
+    filled = 0
+    by_proc = {}
+    try:
+        filled = _fill_region(
+            dirpath, meta, out, lo_r, hi_r, lo_c, hi_c, by_proc
+        )
+    finally:
+        for sf in by_proc.values():
+            sf.close()
+    if filled != out.size:
+        raise CorruptSnapshotError(
+            f"{dirpath}: region {index} only covered {filled} of "
+            f"{out.size} cells"
+        )
+    return out
+
+
+def _fill_region(dirpath, meta, out, lo_r, hi_r, lo_c, hi_c, by_proc):
+    """Copy every intersecting, fingerprint-verified piece into ``out``;
+    opened shard files land in ``by_proc`` for the caller to close."""
+    from gol_tpu.utils.guard import fingerprint_np
+
+    filled = 0
+    for (r0, r1, c0, c1), proc in zip(meta.rects, meta.procs):
+        r0, r1, c0, c1 = int(r0), int(r1), int(c0), int(c1)
+        i0, i1 = max(r0, lo_r), min(r1, hi_r)
+        j0, j1 = max(c0, lo_c), min(c1, hi_c)
+        if i0 >= i1 or j0 >= j1:
+            continue
+        proc = int(proc)
+        if proc not in by_proc:
+            by_proc[proc] = np.load(
+                os.path.join(dirpath, f"shards_{proc:05d}.npz")
+            )
+        sf = by_proc[proc]
+        rects = sf["rects"]
+        hit = np.nonzero(
+            (rects[:, 0] == r0)
+            & (rects[:, 1] == r1)
+            & (rects[:, 2] == c0)
+            & (rects[:, 3] == c1)
+        )[0]
+        if hit.size != 1:
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece ({r0},{r1},{c0},{c1}) missing from "
+                f"shards_{proc:05d}.npz"
+            )
+        k = int(hit[0])
+        data = sf[f"piece_{k}"].astype(np.uint8)
+        if data.shape != (r1 - r0, c1 - c0):
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece ({r0},{r1},{c0},{c1}) has shape "
+                f"{data.shape}"
+            )
+        stored = int(sf["fps"][k])
+        actual = fingerprint_np(data, r0, c0)
+        if stored != actual:
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece ({r0},{r1},{c0},{c1}) fingerprint "
+                f"{actual:#010x} != stored {stored:#010x}; the shard file "
+                "is corrupt"
+            )
+        out[i0 - lo_r : i1 - lo_r, j0 - lo_c : j1 - lo_c] = data[
+            i0 - r0 : i1 - r0, j0 - c0 : j1 - c0
+        ]
+        filled += (i1 - i0) * (j1 - j0)
+    return filled

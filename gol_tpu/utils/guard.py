@@ -11,12 +11,16 @@ evolving board.  This module adds the three standard tiers:
    place).  Alongside it the audit records telemetry that external harness
    checks can compare — the population count and a deterministic content
    fingerprint (order-independent mod-2^32 mixing, so XLA reduce order
-   cannot change it).  The fingerprint has no in-run oracle (the evolved
-   board's correct hash isn't known in advance); its job is cross-run /
-   cross-replica determinism comparison and checkpoint integrity (tier 2).
-   Note the limit this implies: an in-range flip (1->0 / 0->1) passes the
-   live invariant and is only catchable by comparing fingerprints against
-   a redundant run or replica.  The audit is one small jitted reduce fused
+   cannot change it).  The plain fingerprint has no in-run oracle (the
+   evolved board's correct hash isn't known in advance); its job is
+   cross-run / cross-replica determinism comparison and checkpoint
+   integrity (tier 2).  The **redundancy audit** (``GuardConfig.redundant``
+   / ``--guard-redundant``) builds that oracle in-run: every audited chunk
+   is recomputed on a *second* bit-exact engine (dense vs bit-packed — the
+   framework's tiers are mutually bit-exact, pinned by the equivalence
+   suite) and the two device fingerprints must match, which catches the
+   in-range flip (1->0 / 0->1) the 0/1 invariant passes, at the price of
+   doubling the audited compute.  The audit is one small jitted reduce fused
    over the board — negligible next to a generation chunk — and its scalars
    are replicated across hosts, so every process takes the same recovery
    decision with no extra communication.
@@ -24,9 +28,10 @@ evolving board.  This module adds the three standard tiers:
    rides inside checkpoint files and is re-verified on load, turning the
    write-only dump culture of the reference into tamper-evident snapshots.
 3. **Elastic recovery** — :func:`run_guarded` evolves in audit-sized chunks,
-   keeps the last known-good state on the host, and on a failed audit rolls
-   back and replays instead of dying; a bounded restore budget converts
-   persistent faults into a clean :class:`GuardError`.
+   keeps the last known-good state resident on device (sharded like the
+   board, so no per-chunk host fetch or cross-host gather), and on a failed
+   audit rolls back and replays instead of dying; a bounded restore budget
+   converts persistent faults into a clean :class:`GuardError`.
 
 Fault injection for tests/drills is a first-class hook (``fault_hook``),
 because a recovery path that has never fired is a recovery path that does
@@ -53,28 +58,41 @@ _COL_MIX = np.uint32(0x85EBCA77)
 _VAL_MIX = np.uint32(0xC2B2AE35)
 
 
-def fingerprint_np(board: np.ndarray) -> int:
+def fingerprint_np(
+    board: np.ndarray, row0: int = 0, col0: int = 0
+) -> int:
     """Reference NumPy fingerprint (mod 2^32), bit-identical to the device one.
 
     Each cell contributes ``value * (1 + mix(i) * mix(j))``; contributions
     are summed mod 2^32.  Addition mod 2^32 is associative and commutative,
     so any reduction order — NumPy's, XLA's on one chip, or a cross-host
     psum — produces the same 32-bit result.
+
+    ``row0``/``col0`` offset the cell coordinates into a larger global
+    board: because the hash is a position-weighted *sum*, the fingerprints
+    of a disjoint rectangle cover computed with global offsets add up
+    (mod 2^32) to the whole board's fingerprint — the property the sharded
+    checkpoint format uses to verify a global stamp from per-piece stamps
+    without any host ever assembling the board.
     """
     board = np.asarray(board)
     h, w = board.shape
     total = np.uint32(0)
     with np.errstate(over="ignore"):
-        cj = (np.arange(w, dtype=np.uint32) * _COL_MIX + np.uint32(1))[None, :]
+        cj = (
+            np.arange(col0, col0 + w, dtype=np.uint32) * _COL_MIX
+            + np.uint32(1)
+        )[None, :]
         # Row-chunked so the uint32 weight plane never exceeds ~64 MB even
         # for 65536-wide boards (the device version is fused by XLA and
         # never materializes weights at all).
         step = max(1, (16 << 20) // max(w, 1))
         for r0 in range(0, h, step):
             r1 = min(h, r0 + step)
-            ri = (np.arange(r0, r1, dtype=np.uint32) * _ROW_MIX + np.uint32(1))[
-                :, None
-            ]
+            ri = (
+                np.arange(row0 + r0, row0 + r1, dtype=np.uint32) * _ROW_MIX
+                + np.uint32(1)
+            )[:, None]
             weights = np.uint32(1) + ri * cj * _VAL_MIX
             total = total + np.sum(
                 board[r0:r1].astype(np.uint32) * weights, dtype=np.uint32
@@ -101,13 +119,22 @@ _audit_jit = jax.jit(_audit_device)
 
 @dataclasses.dataclass(frozen=True)
 class Audit:
-    """One detection pass over the live board."""
+    """One detection pass over the live board.
+
+    ``redundant_fingerprint`` is filled by the cross-engine redundancy
+    audit (``GuardConfig.redundant``): the same chunk recomputed on a
+    second bit-exact engine.  ``ok`` then also requires the fingerprints
+    to match — the in-run oracle the plain invariant lacks (an in-range
+    1<->0 flip passes the 0/1 check but cannot survive a fingerprint
+    comparison against an independent recompute).
+    """
 
     generation: int
     ok: bool
     max_cell: int
     population: int
     fingerprint: int
+    redundant_fingerprint: Optional[int] = None
 
 
 def audit_board(board, generation: int = 0) -> Audit:
@@ -147,6 +174,10 @@ class GuardConfig:
     # Test/drill hook: (board, generation_after_chunk) -> board, applied
     # after each chunk *before* the audit, simulating in-flight corruption.
     fault_hook: Optional[Callable[[jax.Array, int], jax.Array]] = None
+    # Cross-engine redundancy audit: recompute every audited chunk on a
+    # second bit-exact engine and require matching fingerprints.  Doubles
+    # the audited compute; the only in-run detector for in-range flips.
+    redundant: bool = False
 
     def __post_init__(self) -> None:
         if self.check_every < 1:
@@ -176,13 +207,56 @@ class GuardReport:
         )
 
 
-def _fetch_host(board) -> np.ndarray:
-    """Host copy of a (possibly multi-host sharded) board."""
-    from gol_tpu.parallel import multihost
+def _checker_runtime(rt):
+    """A sibling runtime on a *different* bit-exact engine — the redundant
+    auditor.  dense checks the packed tiers (different data layout and
+    program); bitpack checks dense.  A random hardware flip cannot
+    reproduce across two independent programs, so matching fingerprints
+    certify the chunk; the engines' mutual bit-exactness is pinned by the
+    equivalence test suite.
+    """
+    import dataclasses as dc
 
-    # fetch_global short-circuits to a plain host transfer when
-    # single-process, and all-gathers across hosts otherwise.
-    return multihost.fetch_global(board)
+    if rt.halo_mode != "fresh":
+        raise ValueError(
+            "the redundant audit needs a second bit-exact engine; stale_t0 "
+            "(reference-compat) runs exist only on the dense engine"
+        )
+    if rt._resolved == "dense":
+        geom = (rt.geometry.global_height, rt.geometry.global_width)
+        try:
+            if rt.mesh is not None:
+                from gol_tpu.parallel import packed as packed_mod
+
+                packed_mod.validate_packed_geometry(geom, rt.mesh)
+            else:
+                from gol_tpu.ops import bitlife
+
+                bitlife.packed_width(geom[1])
+        except ValueError as e:
+            raise ValueError(
+                f"the redundant audit needs a second engine, and the only "
+                f"check for a dense run is bit-packed: {e}"
+            ) from e
+        checker = "bitpack"
+    else:
+        checker = "dense"
+    return dc.replace(
+        rt,
+        engine=checker,
+        shard_mode="explicit",
+        halo_depth=1,
+        checkpoint_every=0,
+        checkpoint_dir=None,
+    )
+
+
+# Device-to-device snapshot of the (possibly sharded) board: the last-good
+# buffer stays resident with the board's own sharding, so the per-chunk
+# cost is one on-device copy — not the host fetch (a full cross-host
+# all-gather on multi-host runs, ADVICE r1) the first version paid.  jit
+# re-specializes per shape/sharding; all hosts call it in lockstep.
+_device_copy = jax.jit(jnp.copy)
 
 
 def run_guarded(
@@ -197,11 +271,13 @@ def run_guarded(
     Drop-in sibling of :meth:`gol_tpu.runtime.GolRuntime.run`: same engine
     dispatch and AOT compile phase, but the generation loop is chopped into
     ``config.check_every``-sized chunks, each followed by an on-device
-    audit.  A failed audit rolls the board back to the last good host copy
-    and replays the chunk; more than ``config.max_restores`` consecutive
-    failures raises :class:`GuardError` (the fault is persistent — retrying
-    cannot help).  With no faults the result is identical to ``rt.run`` —
-    pinned by tests against the unguarded path.
+    audit.  A failed audit rolls the board back to the last good snapshot
+    — kept *on device* with the board's own sharding, so multi-host runs
+    never pay a per-chunk all-gather — and replays the chunk; more than
+    ``config.max_restores`` consecutive failures raises
+    :class:`GuardError` (the fault is persistent — retrying cannot help).
+    With no faults the result is identical to ``rt.run`` — pinned by tests
+    against the unguarded path.
 
     When the runtime also has ``checkpoint_every`` set, a verified snapshot
     is persisted at the first audit boundary at or after each interval, so
@@ -221,16 +297,19 @@ def run_guarded(
 
     with sw.phase("compile"):
         evolvers = rt.compile_evolvers(board, schedule)
-
-    def _place(board_np: np.ndarray):
-        # shard_board/device_put take host numpy directly — no intermediate
-        # local device copy.
-        if rt.mesh is not None:
-            return mesh_mod.shard_board(board_np, rt.mesh)
-        return jax.device_put(board_np)
+        checker_evolvers = None
+        if config.redundant:
+            checker_evolvers = _checker_runtime(rt).compile_evolvers(
+                board, schedule
+            )
 
     generation = int(state.generation)
-    last_good = (_fetch_host(board), generation)
+    # The rollback base lives on device (in the same fault domain as the
+    # board — the price of not all-gathering per chunk), so its audit
+    # fingerprint is recorded at snapshot time and re-verified before any
+    # replay: a fault landing in the base itself must fail the restore
+    # loudly, never silently replay-and-certify corruption.
+    last_good = (_device_copy(board), generation, audit_board(board).fingerprint)
     next_ckpt = (
         generation + rt.checkpoint_every if rt.checkpoint_every > 0 else None
     )
@@ -246,34 +325,67 @@ def run_guarded(
             candidate = config.fault_hook(candidate, generation + take)
         with sw.phase("audit"):
             audit = audit_board(candidate, generation + take)
-            guard.audits.append(audit)
+        if checker_evolvers is not None and audit.ok:
+            # Redundant recompute of the same chunk from the same input
+            # (last_good still holds it — it only advances below) on the
+            # second engine; fingerprints of two independent programs can
+            # only agree if neither run was corrupted.
+            comp2, dyn2 = checker_evolvers[take]
+            with sw.phase("redundant"):
+                reference = comp2(_device_copy(last_good[0]), *dyn2)
+                audit2 = audit_board(reference, generation + take)
+            audit = dataclasses.replace(
+                audit,
+                ok=audit2.fingerprint == audit.fingerprint,
+                redundant_fingerprint=audit2.fingerprint,
+            )
+        guard.audits.append(audit)
         if not audit.ok:
             guard.failures += 1
             restores_this_chunk += 1
             if restores_this_chunk > config.max_restores:
+                detail = (
+                    f"max cell {audit.max_cell}"
+                    if audit.max_cell > 1
+                    else (
+                        f"fingerprint {audit.fingerprint:#010x} != redundant "
+                        f"recompute {audit.redundant_fingerprint:#010x}"
+                    )
+                )
                 raise GuardError(
                     f"audit failed at generation {audit.generation} "
-                    f"(max cell {audit.max_cell}) and the restore budget "
+                    f"({detail}) and the restore budget "
                     f"({config.max_restores}) is exhausted — persistent fault"
                 )
             guard.restores += 1
             with sw.phase("restore"):
-                board = _place(last_good[0])
+                # Copy again: the replayed chunk donates its input, and
+                # the last-good buffer must survive for further replays.
+                board = _device_copy(last_good[0])
                 generation = last_good[1]
+                base = audit_board(board, generation)
+                if not base.ok or base.fingerprint != last_good[2]:
+                    raise GuardError(
+                        f"the rollback base itself is corrupt at generation "
+                        f"{generation} (fingerprint {base.fingerprint:#010x} "
+                        f"!= recorded {last_good[2]:#010x}); in-run recovery "
+                        "is impossible — resume from the last checkpoint"
+                    )
             continue  # replay the same chunk
         restores_this_chunk = 0
         board = candidate
         generation += take
         with sw.phase("snapshot"):
-            last_good = (_fetch_host(board), generation)
+            # audit.fingerprint is this exact board's stamp (just computed
+            # on device) — recorded for the base-integrity check above.
+            last_good = (_device_copy(board), generation, audit.fingerprint)
         if next_ckpt is not None and generation >= next_ckpt:
             with sw.phase("checkpoint"):
-                # last_good[0] is this exact board, already on the host, and
-                # the audit already fingerprinted it on device — no second
-                # fetch/all-gather, no host-side fingerprint pass.
+                # The audit already fingerprinted this exact board on
+                # device — no host-side fingerprint pass; multi-host runs
+                # write sharded pieces with no gather at all.
                 rt._save_snapshot(
                     GolState.create(board, generation),
-                    board_np=last_good[0],
                     fingerprint=audit.fingerprint,
                 )
             next_ckpt = generation + rt.checkpoint_every

@@ -315,3 +315,185 @@ def test_guarded_flagship_sharded_pallas():
         np.asarray(state.board), _run_plain(geom, 4, 16)
     )
     assert greport.checks == 2 and greport.failures == 0
+
+
+# -- cross-engine redundancy audit (VERDICT r1 #5) ---------------------------
+
+
+def test_in_range_flip_provably_missed_without_redundant():
+    """The documented blind spot, pinned: a flip to a VALID cell value
+    passes the 0/1 invariant and the plain guard ships the corruption."""
+    geom = Geometry(size=32, num_ranks=2)
+
+    def flip_valid(board, generation):
+        if generation == 6:
+            return guard.inject_bitflip(board, 2, 2, value=1)  # in-range
+        return board
+
+    rt = GolRuntime(geometry=geom)
+    _, state, greport = guard.run_guarded(
+        rt, 4, 10, guard.GuardConfig(check_every=3, fault_hook=flip_valid)
+    )
+    assert greport.failures == 0  # nothing noticed...
+    with pytest.raises(AssertionError):  # ...and the result is wrong
+        np.testing.assert_array_equal(
+            np.asarray(state.board), _run_plain(geom, 4, 10)
+        )
+
+
+def test_in_range_flip_caught_by_redundant_audit():
+    """The same fault with --guard-redundant: the second engine's
+    fingerprint disagrees, the guard rolls back and replays to the exact
+    clean result."""
+    geom = Geometry(size=32, num_ranks=2)
+    fired = []
+
+    def flip_valid_once(board, generation):
+        if generation == 6 and not fired:
+            fired.append(generation)
+            return guard.inject_bitflip(board, 2, 2, value=1)
+        return board
+
+    rt = GolRuntime(geometry=geom)
+    _, state, greport = guard.run_guarded(
+        rt,
+        4,
+        10,
+        guard.GuardConfig(
+            check_every=3, fault_hook=flip_valid_once, redundant=True
+        ),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 10)
+    )
+    assert greport.failures == 1 and greport.restores == 1
+    # Every audit carries the checker fingerprint; the good ones agree.
+    assert all(a.redundant_fingerprint is not None for a in greport.audits)
+    good = [a for a in greport.audits if a.ok]
+    assert all(a.redundant_fingerprint == a.fingerprint for a in good)
+
+
+def test_redundant_clean_run_matches_unguarded():
+    geom = Geometry(size=32, num_ranks=2)
+    rt = GolRuntime(geometry=geom, engine="bitpack")
+    _, state, greport = guard.run_guarded(
+        rt, 4, 8, guard.GuardConfig(check_every=4, redundant=True)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 8)
+    )
+    assert greport.failures == 0
+
+
+def test_redundant_persistent_fault_names_the_mismatch():
+    geom = Geometry(size=32, num_ranks=2)
+
+    def always_flip(board, generation):
+        return guard.inject_bitflip(board, 1, 1, value=1)
+
+    rt = GolRuntime(geometry=geom)
+    with pytest.raises(guard.GuardError, match="redundant recompute"):
+        guard.run_guarded(
+            rt,
+            4,
+            4,
+            guard.GuardConfig(
+                check_every=2,
+                max_restores=1,
+                fault_hook=always_flip,
+                redundant=True,
+            ),
+        )
+
+
+def test_redundant_sharded_run():
+    geom = Geometry(size=32, num_ranks=4)  # 128x32
+    mesh = mesh_mod.make_mesh_1d(4)
+    fired = []
+
+    def flip_valid_once(board, generation):
+        if generation == 4 and not fired:
+            fired.append(generation)
+            return guard.inject_bitflip(board, 40, 3, value=1)
+        return board
+
+    rt = GolRuntime(geometry=geom, mesh=mesh)
+    _, state, greport = guard.run_guarded(
+        rt,
+        4,
+        8,
+        guard.GuardConfig(
+            check_every=4, fault_hook=flip_valid_once, redundant=True
+        ),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 8)
+    )
+    assert greport.failures == 1 and greport.restores == 1
+
+
+def test_checker_runtime_picks_a_different_engine():
+    geom = Geometry(size=32, num_ranks=1)
+    assert (
+        guard._checker_runtime(GolRuntime(geometry=geom, engine="dense"))
+        ._resolved == "bitpack"
+    )
+    assert (
+        guard._checker_runtime(GolRuntime(geometry=geom, engine="bitpack"))
+        ._resolved == "dense"
+    )
+    # A dense run whose width cannot pack has no second engine.
+    with pytest.raises(ValueError, match="redundant audit"):
+        guard._checker_runtime(
+            GolRuntime(geometry=Geometry(size=20, num_ranks=1))
+        )
+
+
+def test_cli_guard_redundant_flag(tmp_path, capsys, monkeypatch):
+    import os
+
+    from gol_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(
+        ["4", "32", "6", "16", "0", "--guard-every", "3", "--guard-redundant"]
+    )
+    assert rc == 0
+    assert "GUARD          : 2 checks, 0 failures, 0 restores" in (
+        capsys.readouterr().out
+    )
+    # The flag without an audit cadence is meaningless.
+    assert (
+        cli.main(["4", "32", "6", "16", "0", "--guard-redundant"]) == 255
+    )
+
+
+def test_corrupt_rollback_base_fails_loud(monkeypatch):
+    """A fault landing in the device-resident last-good buffer itself must
+    abort recovery, not silently replay-and-certify the corruption."""
+    geom = Geometry(size=32, num_ranks=2)
+    real_copy = guard._device_copy
+    calls = []
+
+    def evil_copy(x):
+        # Corrupt only the initial snapshot copy (an in-range flip, so
+        # only the fingerprint comparison can see it); later copies are
+        # faithful, so the restore reads the corrupted base as-is.
+        out = real_copy(x)
+        if not calls:
+            calls.append(1)
+            out = out.at[0, 0].set(1 - out[0, 0])
+        return out
+
+    monkeypatch.setattr(guard, "_device_copy", evil_copy)
+
+    def fault_once(board, generation):
+        if generation == 3:
+            return guard.inject_bitflip(board, 2, 2)  # out-of-range: restore
+        return board
+
+    rt = GolRuntime(geometry=geom)
+    with pytest.raises(guard.GuardError, match="rollback base"):
+        guard.run_guarded(
+            rt, 4, 6, guard.GuardConfig(check_every=3, fault_hook=fault_once)
+        )

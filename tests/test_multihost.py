@@ -146,6 +146,7 @@ _WORKER = textwrap.dedent(
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 2)
     from gol_tpu import cli
+    from gol_tpu.utils import checkpoint as ckpt_mod
     pid = sys.argv[1]
     rc = cli.main([
         "4", "8", "5", "16", "1",
@@ -155,6 +156,17 @@ _WORKER = textwrap.dedent(
         "--outdir", sys.argv[3],
         "--checkpoint-every", "3", "--checkpoint-dir", sys.argv[4],
     ])
+    if rc == 0:
+        # Resume the job from the sharded gen-3 checkpoint for the
+        # remaining 2 generations (jax.distributed is already connected;
+        # the second run reuses the live topology).  Each host reads only
+        # its own rows back (make_array_from_callback).
+        rc = cli.main([
+            "4", "8", "2", "16", "1",
+            "--ranks", "4", "--mesh", "1d",
+            "--outdir", sys.argv[5],
+            "--resume", ckpt_mod.sharded_checkpoint_path(sys.argv[4], 3),
+        ])
     sys.exit(rc)
     """
 )
@@ -226,14 +238,18 @@ def _run_two_workers(worker_src: str, argv_tail) -> list:
 
 def test_two_process_cli_matches_single_process(tmp_path):
     """Full CLI across 2 processes (4 global devices): ppermute halo rings
-    over the process boundary, per-host rank-file writes, a multi-host
-    checkpoint — outputs byte-identical to the single-process run."""
+    over the process boundary, per-host rank-file writes, a *sharded*
+    multi-host checkpoint (each host writes only its own rows; no
+    all-gather) and a cross-process sharded resume — outputs
+    byte-identical to the single-process run."""
     out_mh = tmp_path / "mh"
+    out_rs = tmp_path / "rs"
     out_sp = tmp_path / "sp"
     ckpt = tmp_path / "ckpt"
     out_mh.mkdir()
+    out_rs.mkdir()
 
-    outs = _run_two_workers(_WORKER, [str(out_mh), str(ckpt)])
+    outs = _run_two_workers(_WORKER, [str(out_mh), str(ckpt), str(out_rs)])
 
     # Only the coordinator reports (reference: rank 0, gol-main.c:121-128).
     assert "TOTAL DURATION" in outs[0][1]
@@ -249,16 +265,33 @@ def test_two_process_cli_matches_single_process(tmp_path):
 
     for r in range(4):
         name = gol_io.rank_filename(r, 4)
-        mh = (out_mh / name).read_bytes()
         sp = (out_sp / name).read_bytes()
-        assert mh == sp, f"rank {r} dump differs across process counts"
+        assert (out_mh / name).read_bytes() == sp, (
+            f"rank {r} dump differs across process counts"
+        )
+        # The resumed job (gen 3 checkpoint + 2 generations) must land on
+        # the same world as the straight 5-generation run.
+        assert (out_rs / name).read_bytes() == sp, (
+            f"rank {r} dump differs after sharded resume"
+        )
 
-    # The multi-host checkpoint path wrote a loadable snapshot (gen 3).
+    # The checkpoint is the sharded format: one piece file per process,
+    # each holding only that host's rows — no host assembled the board.
     from gol_tpu.utils import checkpoint as ckpt_mod
 
-    snap = ckpt_mod.load(ckpt_mod.checkpoint_path(str(ckpt), 3))
-    assert snap.generation == 3
-    assert snap.board.shape == (32, 8)
+    d = ckpt_mod.sharded_checkpoint_path(str(ckpt), 3)
+    meta = ckpt_mod.load_sharded_meta(d)
+    assert meta.generation == 3 and meta.shape == (32, 8)
+    piece_rows = {0: [], 1: []}
+    for (r0, r1, _, _), proc in zip(meta.rects, meta.procs):
+        piece_rows[int(proc)].append((int(r0), int(r1)))
+    # 4 global devices = 2 per process; rows [0,16) on proc 0, [16,32) on 1.
+    assert all(r1 <= 16 for _, r1 in piece_rows[0])
+    assert all(r0 >= 16 for r0, _ in piece_rows[1])
+    board = ckpt_mod.read_sharded_region(
+        d, meta, (slice(None), slice(None))
+    )
+    assert board.shape == (32, 8)
 
 
 def test_two_process_2d_mesh_guarded_gather_dump(tmp_path):
