@@ -85,9 +85,9 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--resume", default=None, metavar="CKPT")
     # Multi-host (the `mpirun -np N` analog): connect this process to the
     # job before any device work; the mesh then spans the whole pod.
-    ext.add_argument("--coordinator", default=None, metavar="HOST:PORT")
-    ext.add_argument("--num-processes", type=int, default=None, metavar="N")
-    ext.add_argument("--process-id", type=int, default=None, metavar="I")
+    from gol_tpu.parallel.multihost import add_multihost_args
+
+    add_multihost_args(ext)
     # Failure detection + elastic recovery: audit the board every K
     # generations, roll back and replay on corruption (utils/guard.py).
     ext.add_argument("--guard-every", type=int, default=0, metavar="K")

@@ -64,6 +64,19 @@ def topology() -> HostTopology:
     )
 
 
+def add_multihost_args(parser) -> None:
+    """Install the multi-host CLI trio (the ``mpirun -np N`` analog).
+
+    One definition shared by every entry point (`gol_tpu.cli`,
+    ``scalebench``), so the multi-host surface cannot drift between them;
+    the parsed ``coordinator``/``num_processes``/``process_id`` feed
+    :func:`init_multihost`.
+    """
+    parser.add_argument("--coordinator", default=None, metavar="HOST:PORT")
+    parser.add_argument("--num-processes", type=int, default=None, metavar="N")
+    parser.add_argument("--process-id", type=int, default=None, metavar="I")
+
+
 def init_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

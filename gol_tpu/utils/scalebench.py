@@ -23,6 +23,15 @@ Run as a module for a JSON report:
 (engine ``dense`` | ``bitpack`` | ``pallas`` — the last is the flagship
 fused-kernel-per-shard program; on TPU it needs ``size_per_chip`` to be a
 multiple of 4096 so the packed width fills whole 128-lane tiles).
+
+**Multi-host sweeps** (the config-4 pod shape): pass the same trio as the
+CLI — ``--coordinator HOST:PORT --num-processes N --process-id I`` — on
+every participating process.  Device counts then sweep the *global*
+device list: rows using only some processes' devices are measured by
+those processes while the rest idle at the between-row barrier (the
+1-device baseline every efficiency number divides by stays measurable),
+and rows spanning processes run the exact cross-host programs a pod
+would.  Process 0 prints the report.
 """
 
 from __future__ import annotations
@@ -59,7 +68,15 @@ def measure_weak_scaling(
     engine: str = "dense",
     counts: Optional[List[int]] = None,
 ) -> List[Dict[str, float]]:
-    """One weak-scaling sweep; returns a row per device count."""
+    """One weak-scaling sweep; returns a row per device count.
+
+    Multi-process jobs: every process must call this (rows spanning
+    processes run cross-host programs; a between-row barrier keeps the
+    job in lockstep).  A process only measures rows whose mesh includes
+    its devices, so the returned list is complete — and the efficiency
+    baseline correct — on process 0, whose devices lead the global device
+    list; other processes' partial lists are for their own logging only.
+    """
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}; expected {ENGINES}")
     counts = device_counts() if counts is None else list(counts)
@@ -80,63 +97,110 @@ def measure_weak_scaling(
                 f"multiple of {lane_cells} (128-lane packed width); got "
                 f"{size_per_chip}"
             )
-    rng = np.random.default_rng(0)
+    multi = jax.process_count() > 1
+    me = jax.process_index()
+    # Validate every row's geometry up front, on every process: the checks
+    # are deterministic, so a bad configuration fails identically
+    # everywhere *before* the first row barrier — a participant raising
+    # mid-sweep would leave the idle processes deadlocked at theirs.
+    for n in counts:
+        mesh = mesh_mod.make_mesh_1d(num_devices=n)
+        shape = (n * size_per_chip, size_per_chip)
+        if engine in ("pallas", "bitpack"):
+            packed_mod.validate_packed_geometry(shape, mesh)
+        else:
+            mesh_mod.validate_geometry(shape, mesh)
     rows: List[Dict[str, float]] = []
     base_per_chip: Optional[float] = None
     for n in counts:
         mesh = mesh_mod.make_mesh_1d(num_devices=n)
-        height = n * size_per_chip
-        board_np = (rng.random((height, size_per_chip)) < 0.35).astype(
-            np.uint8
-        )
-        board = mesh_mod.shard_board(jnp.asarray(board_np), mesh)
-        if engine == "pallas":
-            # The flagship multi-chip program (fused kernel per shard over
-            # the ring).  Meaningful curves need a real TPU — interpret
-            # mode is far too slow.
-            packed_mod.validate_packed_geometry(board.shape, mesh)
-            evolve = packed_mod.compiled_evolve_packed_pallas(mesh, steps)
-        elif engine == "bitpack":
-            packed_mod.validate_packed_geometry(board.shape, mesh)
-            evolve = packed_mod.compiled_evolve_packed(mesh, steps)
-        else:
-            evolve = sharded_mod.compiled_evolve(mesh, steps, "explicit", 1)
-        dt = time_best(evolve, lambda b=board: jnp.array(b, copy=True))
-        updates = height * size_per_chip * steps
-        per_chip = updates / dt / n
-        if base_per_chip is None:
-            base_per_chip = per_chip
-        rows.append(
-            {
-                "devices": n,
-                "seconds": dt,
-                "updates_per_s": updates / dt,
-                "per_chip": per_chip,
-                "efficiency": per_chip / base_per_chip,
-            }
-        )
+        participating = {d.process_index for d in mesh.devices.flat}
+        try:
+            if me in participating:
+                height = n * size_per_chip
+                # Per-row seed: every process that measures row n builds
+                # the identical board with no sequential PRNG coupling, so
+                # idle processes skip at zero cost.
+                rng = np.random.default_rng((0, n))
+                board_np = (
+                    rng.random((height, size_per_chip)) < 0.35
+                ).astype(np.uint8)
+                board = mesh_mod.shard_board(jnp.asarray(board_np), mesh)
+                if engine == "pallas":
+                    # The flagship multi-chip program (fused kernel per
+                    # shard over the ring).  Meaningful curves need a real
+                    # TPU — interpret mode is far too slow.
+                    evolve = packed_mod.compiled_evolve_packed_pallas(
+                        mesh, steps
+                    )
+                elif engine == "bitpack":
+                    evolve = packed_mod.compiled_evolve_packed(mesh, steps)
+                else:
+                    evolve = sharded_mod.compiled_evolve(
+                        mesh, steps, "explicit", 1
+                    )
+                dt = time_best(evolve, lambda b=board: jnp.array(b, copy=True))
+                updates = height * size_per_chip * steps
+                per_chip = updates / dt / n
+                if base_per_chip is None:
+                    base_per_chip = per_chip
+                rows.append(
+                    {
+                        "devices": n,
+                        "seconds": dt,
+                        "updates_per_s": updates / dt,
+                        "per_chip": per_chip,
+                        "efficiency": per_chip / base_per_chip,
+                    }
+                )
+        finally:
+            # Reached even if a participant's row fails at runtime, so the
+            # others' barrier is never left waiting on a dead process.
+            if multi:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(f"scalebench_row_{n}")
     return rows
 
 
 def main(argv=None) -> None:
+    import argparse
     import sys
 
-    args = list(sys.argv[1:] if argv is None else argv)
-    size = int(args[0]) if len(args) > 0 else 1024
-    steps = int(args[1]) if len(args) > 1 else 64
-    engine = args[2] if len(args) > 2 else "dense"
-    rows = measure_weak_scaling(size, steps, engine)
-    print(
-        json.dumps(
-            {
-                "size_per_chip": size,
-                "steps": steps,
-                "engine": engine,
-                "platform": jax.devices()[0].platform,
-                "rows": rows,
-            }
-        )
+    from gol_tpu.parallel.multihost import add_multihost_args
+
+    p = argparse.ArgumentParser(prog="scalebench")
+    p.add_argument("positionals", nargs="*", metavar="ARG")
+    # The multi-host trio, same surface as the main CLI: every process of
+    # the job runs this module with its own --process-id.
+    add_multihost_args(p)
+    ns = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+    size = int(ns.positionals[0]) if len(ns.positionals) > 0 else 1024
+    steps = int(ns.positionals[1]) if len(ns.positionals) > 1 else 64
+    engine = ns.positionals[2] if len(ns.positionals) > 2 else "dense"
+
+    from gol_tpu.parallel import multihost
+
+    topo = multihost.init_multihost(
+        ns.coordinator, ns.num_processes, ns.process_id
     )
+    rows = measure_weak_scaling(size, steps, engine)
+    if topo.is_coordinator:
+        # Process 0 owns the full curve (its devices lead the global list,
+        # so it participates in every row, including the 1-device
+        # baseline); it reports alone, like the reference's rank 0.
+        print(
+            json.dumps(
+                {
+                    "size_per_chip": size,
+                    "steps": steps,
+                    "engine": engine,
+                    "platform": jax.devices()[0].platform,
+                    "processes": topo.process_count,
+                    "rows": rows,
+                }
+            )
+        )
 
 
 if __name__ == "__main__":

@@ -74,15 +74,22 @@ def force_ready(x) -> None:
     # readback alone only proves shard (0,...,0) finished on a sharded
     # array.  Both together cover single- and multi-device cases.
     jax.block_until_ready(x)
-    if jax.process_count() > 1:
-        # Multi-host: element (0,...,0) may not be addressable here.  A
-        # cross-process barrier is the correct fence — and mirrors the
+    leaves = jax.tree_util.tree_leaves(x)
+    if jax.process_count() > 1 and any(
+        not getattr(leaf, "is_fully_addressable", True) for leaf in leaves
+    ):
+        # A cross-process array: element (0,...,0) may not be addressable
+        # here, so a barrier is the correct fence — mirroring the
         # reference's MPI_Barrier before the timing stop (gol-main.c:118).
+        # Fully-addressable arrays fall through to the readback even in
+        # multi-process jobs: they belong to a process-local computation
+        # (e.g. a scalebench row only some processes run), and a global
+        # barrier would deadlock against processes sitting that row out.
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("gol_force_ready")
         return
-    for leaf in jax.tree_util.tree_leaves(x):
+    for leaf in leaves:
         if hasattr(leaf, "ndim"):
             leaf[(0,) * leaf.ndim].item()
 

@@ -67,3 +67,41 @@ def test_weak_scaling_pallas_engine():
     )
     assert [r["devices"] for r in rows] == [1, 2]
     assert all(r["updates_per_s"] > 0 for r in rows)
+
+
+# -- multi-host sweep: the config-4 curve across OS processes ----------------
+
+_WORKER_SCALEBENCH = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+from gol_tpu.utils import scalebench
+scalebench.main([
+    "32", "3", "dense",
+    "--coordinator", sys.argv[2],
+    "--num-processes", "2", "--process-id", sys.argv[1],
+])
+"""
+
+
+def test_two_process_weak_scaling_curve():
+    """The full efficiency curve across 2 OS processes (4 global devices):
+    the 1- and 2-device rows are measured by process 0 alone while
+    process 1 idles at the row barrier; the 4-device row runs the real
+    cross-process ring.  Only the coordinator reports."""
+    import json
+
+    from tests.test_multihost import _run_two_workers
+
+    outs = _run_two_workers(_WORKER_SCALEBENCH, [])
+    rec = json.loads(outs[0][1].strip().splitlines()[-1])
+    # Process 1 emits no report (Gloo connection chatter aside).
+    assert not any(
+        line.startswith("{") for line in outs[1][1].strip().splitlines()
+    )
+    assert rec["processes"] == 2
+    assert [r["devices"] for r in rec["rows"]] == [1, 2, 4]
+    assert all(r["updates_per_s"] > 0 for r in rec["rows"])
+    assert rec["rows"][0]["efficiency"] == 1.0
+    assert all(r["efficiency"] > 0 for r in rec["rows"])
