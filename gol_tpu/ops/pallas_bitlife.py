@@ -13,9 +13,13 @@ Here the entire adder tree + rule runs fused over one VMEM tile, and
 generations are **temporally blocked**: each kernel launch loads its tile
 with a k-deep halo pad and evolves k generations in VMEM (the valid window
 shrinking one row per side per step), so k generations cost one HBM round
-trip and one launch instead of k.  Measured on one v5e chip at 16384²
-(k=16, tile=256): ~8.6e11 cell-updates/s wall vs ~7.3e11 for the k=1
-kernel in the same session (+17%); the kernel is VPU-bound (~22 bitwise
+trip and one launch instead of k.  The window DMAs are **double-buffered
+across grid steps** (tile i+1's three mod-H fetches issued into a second
+scratch slot before tile i's adder tree): best-of-8 samples at
+16384²×1024 measure 8.96/9.77e11 cell-updates/s vs 8.20/8.69e11 for the
+serial-DMA form — ~10% from hiding the input fetch under the VPU work.
+Earlier same-session sweep (k=16, tile=256, serial DMA): ~8.6e11 vs
+~7.3e11 for the k=1 kernel (+17%); the kernel is VPU-bound (~22 bitwise
 ops per 32-cell word), which is why deeper blocking saturates — the
 recomputed halo bands add ~2k/tile extra compute.  A fully VMEM-resident
 variant (no HBM traffic at all, row wrap via sublane rolls) measured 3×
@@ -28,7 +32,9 @@ shifts are emulated with arithmetic shift + mask (``_lsr``); the word-ring
 column wrap (gol-with-cuda.cu:210-211) is a ``pltpu.roll`` along lanes,
 carry bits crossing words via shifts exactly as in ``bitlife._west_east``.
 Row wrap is handled at DMA time with mod-H aligned halo fetches
-(:func:`gol_tpu.ops.pallas_common.load_tile_with_halo`).
+(:func:`gol_tpu.ops.pallas_common.tile_halo_copies` descriptors, started
+and waited under the double-buffer protocol in :func:`_kernel` — the
+wait must reconstruct the start's descriptors identically).
 """
 
 from __future__ import annotations
@@ -43,16 +49,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import bitlife
 from gol_tpu.ops.pallas_common import (
-    load_tile_with_halo,
     pick_tile as _pick,
+    tile_halo_copies,
     validate_tile,
 )
 
 _ALIGN = 8  # TPU tiling for 32-bit data is (8, 128): 8-row DMA alignment
 _LANE = 128  # Mosaic lane tiling for 32-bit data: packed width granularity
 # ~12 live int32 [tile, nw] temporaries across the adder tree, plus the
-# second scratch slot of the double-buffered ext kernel (~1 more row).
-_BYTES_PER_ROW = 52
+# second scratch slot both double-buffered kernels carry (~1.1 more rows
+# per body row at the torus kernel's pad=16).
+_BYTES_PER_ROW = 57
 
 
 def pick_tile(height: int, packed_width: int, hint: int) -> int:
@@ -106,16 +113,40 @@ def _kernel(
     bands independently — the in-kernel analog of the sharded engines'
     ``--halo-depth`` temporal blocking, trading O(k²) duplicated edge rows
     for k× fewer HBM round trips and kernel launches.
+
+    Like :func:`_kernel_ext`, the three window DMAs are double-buffered
+    across grid steps: tile ``i+1``'s mod-H fetches are issued into the
+    other scratch slot before tile ``i``'s adder tree runs.
     """
-    load_tile_with_halo(
-        packed_hbm, scratch, sems, pl.program_id(0),
-        tile=tile, height=height, align=_ALIGN, pad=pad,
-    )
+    i = pl.program_id(0)
+    nt = pl.num_programs(0)
+    slot = jax.lax.rem(i, 2)
+
+    def copies(j, s):
+        return tile_halo_copies(
+            packed_hbm, scratch.at[s], sems.at[s], j,
+            tile=tile, height=height, align=_ALIGN, pad=pad,
+        )
+
+    @pl.when(i == 0)
+    def _():
+        for c in copies(i, slot):
+            c.start()
+
+    @pl.when(i + 1 < nt)
+    def _():
+        for c in copies(i + 1, 1 - slot):
+            c.start()
+
+    for c in copies(i, slot):
+        c.wait()
     for j in range(k):
         a = pad - (k - j)
         b = pad + tile + (k - j)
-        scratch[a + 1 : b - 1] = _one_generation(scratch[a:b], rule)
-    out_ref[:] = scratch[pad : pad + tile]
+        scratch[slot, a + 1 : b - 1] = _one_generation(
+            scratch[slot, a:b], rule
+        )
+    out_ref[:] = scratch[slot, pad : pad + tile]
 
 
 def multi_step_pallas_packed(
@@ -147,8 +178,10 @@ def multi_step_pallas_packed(
         ),
         out_shape=jax.ShapeDtypeStruct(packed_i32.shape, packed_i32.dtype),
         scratch_shapes=[
-            pltpu.VMEM((tile + 2 * pad, nw), packed_i32.dtype),
-            pltpu.SemaphoreType.DMA((3,)),
+            # Two slots × (3 DMAs each): tile i computes from slot i%2
+            # while tile i+1's mod-H window lands in the other.
+            pltpu.VMEM((2, tile + 2 * pad, nw), packed_i32.dtype),
+            pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=jax.default_backend() != "tpu",
     )(packed_i32)

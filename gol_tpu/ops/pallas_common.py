@@ -57,19 +57,21 @@ def validate_tile(height: int, tile: int, align: int) -> None:
         )
 
 
-def load_tile_with_halo(
-    board_hbm, scratch, sems, i, *, tile, height, align, pad=None
+def tile_halo_copies(
+    board_hbm, scratch, sems, i, *, tile, height, align, pad
 ):
-    """Fill ``scratch`` with [halo-pad | body tile | halo-pad] rows.
+    """The three async-copy descriptors filling ``scratch`` with
+    [halo-pad | body tile | halo-pad] rows of window ``i``.
 
     Rank-agnostic: slices are taken on the leading axis only, so the same
-    loader serves the 2-D kernels' [H, nw] row tiles and the 3-D kernel's
-    [D, nw, H] plane tiles.
+    plan serves the 2-D kernels' [H, nw] row tiles and the 3-D kernel's
+    [D, nw, H] plane tiles.  ``scratch``/``sems`` may be ``.at[slot]``
+    views of a double-buffered pair — a caller prefetching window ``i+1``
+    builds these descriptors twice (start on one slot, wait on the other);
+    descriptors are cheap and must be *reconstructed identically* for the
+    matching ``wait`` (the make_async_copy contract).
 
-    ``pad`` (default ``align``) is the halo depth in rows, a multiple of
-    ``align`` and at most ``tile`` — deeper pads feed temporally-blocked
-    kernels that run several generations per VMEM residency.  Scratch
-    layout (all DMA offsets ``align``-row aligned):
+    Scratch layout (all DMA offsets ``align``-row aligned):
 
     - rows ``[0, pad)``: the block *ending* in the top halo row — source
       rows ``(start - pad) mod height`` (the torus row wrap; contiguous
@@ -79,35 +81,44 @@ def load_tile_with_halo(
       bottom halo row (``(start + tile) mod height``).
 
     A k-generation caller reads the step-``j`` stencil window as
-    ``scratch[pad-(k-j) : pad+tile+(k-j)]``.  Blocks until all three DMAs
-    land.
+    ``scratch[pad-(k-j) : pad+tile+(k-j)]``.
     """
-    if pad is None:
-        pad = align
     start = pl.multiple_of(i * tile, align)
     top = pl.multiple_of(
         jax.lax.rem(start - pad + height, height), align
     )
     bot = pl.multiple_of(jax.lax.rem(start + tile, height), align)
+    return (
+        pltpu.make_async_copy(
+            board_hbm.at[pl.ds(start, tile)],
+            scratch.at[pl.ds(pad, tile)],
+            sems.at[0],
+        ),
+        pltpu.make_async_copy(
+            board_hbm.at[pl.ds(top, pad)],
+            scratch.at[pl.ds(0, pad)],
+            sems.at[1],
+        ),
+        pltpu.make_async_copy(
+            board_hbm.at[pl.ds(bot, pad)],
+            scratch.at[pl.ds(pad + tile, pad)],
+            sems.at[2],
+        ),
+    )
 
-    body_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(start, tile)],
-        scratch.at[pl.ds(pad, tile)],
-        sems.at[0],
+
+def load_tile_with_halo(
+    board_hbm, scratch, sems, i, *, tile, height, align, pad=None
+):
+    """Serial form of :func:`tile_halo_copies`: start all three DMAs and
+    block until they land."""
+    if pad is None:
+        pad = align
+    copies = tile_halo_copies(
+        board_hbm, scratch, sems, i,
+        tile=tile, height=height, align=align, pad=pad,
     )
-    top_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(top, pad)],
-        scratch.at[pl.ds(0, pad)],
-        sems.at[1],
-    )
-    bot_dma = pltpu.make_async_copy(
-        board_hbm.at[pl.ds(bot, pad)],
-        scratch.at[pl.ds(pad + tile, pad)],
-        sems.at[2],
-    )
-    body_dma.start()
-    top_dma.start()
-    bot_dma.start()
-    body_dma.wait()
-    top_dma.wait()
-    bot_dma.wait()
+    for c in copies:
+        c.start()
+    for c in copies:
+        c.wait()
