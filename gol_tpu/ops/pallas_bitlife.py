@@ -49,6 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from gol_tpu.ops import bitlife
 from gol_tpu.ops.pallas_common import (
+    load_window_double_buffered,
     pick_tile as _pick,
     tile_halo_copies,
     validate_tile,
@@ -128,18 +129,7 @@ def _kernel(
             tile=tile, height=height, align=_ALIGN, pad=pad,
         )
 
-    @pl.when(i == 0)
-    def _():
-        for c in copies(i, slot):
-            c.start()
-
-    @pl.when(i + 1 < nt)
-    def _():
-        for c in copies(i + 1, 1 - slot):
-            c.start()
-
-    for c in copies(i, slot):
-        c.wait()
+    load_window_double_buffered(copies, i, i + 1, slot, i == 0, i + 1 < nt)
     for j in range(k):
         a = pad - (k - j)
         b = pad + tile + (k - j)
@@ -221,23 +211,17 @@ def _kernel_ext(*refs, tile: int, k: int, rule=None):
     nt = pl.num_programs(0)
     slot = jax.lax.rem(i, 2)
 
-    def copy_for(j, s):
+    def copies(j, s):
         start = pl.multiple_of(j * tile, _ALIGN)
-        return pltpu.make_async_copy(
-            ext_hbm.at[pl.ds(start, tile + 2 * k)],
-            scratch.at[s],
-            sems.at[s],
+        return (
+            pltpu.make_async_copy(
+                ext_hbm.at[pl.ds(start, tile + 2 * k)],
+                scratch.at[s],
+                sems.at[s],
+            ),
         )
 
-    @pl.when(i == 0)
-    def _():
-        copy_for(i, slot).start()
-
-    @pl.when(i + 1 < nt)
-    def _():
-        copy_for(i + 1, 1 - slot).start()
-
-    copy_for(i, slot).wait()
+    load_window_double_buffered(copies, i, i + 1, slot, i == 0, i + 1 < nt)
     for j in range(k):
         a = j
         b = tile + 2 * k - j

@@ -29,10 +29,17 @@ whose 32-bit light cone supports k <= 32 in-VMEM generations, and keep H
 whole so the h wrap stays a lane roll.  x/d wraps are XLA-pre-extended
 ghost words/planes, one concat pair per k-generation launch.
 
-Measured on one v5e chip (Bays 4555, same-process comparisons):
-3.8e10 cell-updates/s at 512³ (XLA packed: 3.4e10), **8.1e10 at 768³**
-(XLA packed: 4.6e10 — 1.75×), and **9.5e10 at 1024³** via the word-tiled
-kernel (tiles (32, 4); XLA packed: 4.7e10 — 2.0×).
+Dispatch between the two kernels is by halo-recompute score (the
+kernels are VPU-bound, so duplicated ghost compute decides); the
+word-tiled kernel's window DMA is double-buffered across plane chunks,
+the plane kernel's measured better serial (see :func:`_kernel`).
+
+Measured on one v5e chip (Bays 4555, ×128-step runs so the ~130 ms
+tunnel RPC doesn't dilute the rates; earlier round-2 notes used ×32 and
+under-reported): **7.3e10 cell-updates/s at 512³** via the plane kernel
+(XLA packed: 5.9e10), **1.78e11 at 768³** (wt kernel (48, 4), beating
+both the plane kernel's 1.61e11 and XLA's 6.9e10 — 2.6×), and
+**2.35e11 at 1024³** (wt (32, 4); XLA packed: 6.6e10 — 3.5×).
 """
 
 from __future__ import annotations
@@ -49,7 +56,11 @@ from jax.experimental.pallas import tpu as pltpu
 from gol_tpu.ops import bitlife, bitlife3d
 from gol_tpu.ops.life3d import BAYS_4555, Rule3D
 from gol_tpu.ops.pallas_bitlife import _lsr, _pick_block
-from gol_tpu.ops.pallas_common import load_tile_with_halo, validate_tile
+from gol_tpu.ops.pallas_common import (
+    load_tile_with_halo,
+    load_window_double_buffered,
+    validate_tile,
+)
 
 _ALIGN = 8  # plane-axis DMA alignment for 32-bit data
 _LANE = 128  # Mosaic lane tiling: H must fill whole lane tiles
@@ -90,6 +101,16 @@ def _one_generation(
 def _kernel(
     vol_hbm, out_ref, scratch, sems, *, tile, depth, k, pad, birth, survive
 ):
+    """Plane-tiled kernel body, serial window DMA.
+
+    Measured negative result (v5e, 512³×128, same session): the
+    cross-grid-step double-buffer that wins ~10% on the 2-D torus kernel
+    *loses* ~9% here (6.6/6.7e10 vs 7.2/7.3e10 serial) — the dynamic
+    scratch-slot indexing taxes the much larger 3-D windows more than the
+    hidden fetch saves — so this kernel keeps the serial loader.  The
+    word-tiled kernel (:func:`_kernel_wt`), whose windows are narrower,
+    keeps its double-buffer (+5-11% at 768³/1024³).
+    """
     load_tile_with_halo(
         vol_hbm, scratch, sems, pl.program_id(0),
         tile=tile, height=depth, align=_ALIGN, pad=pad,
@@ -192,23 +213,38 @@ def _kernel_wt(
     """
     j = pl.program_id(0)  # word chunk
     i = pl.program_id(1)  # plane chunk
-    dma = pltpu.make_async_copy(
-        ext_hbm.at[
-            pl.ds(j * tile_w, tile_w + 2),
-            pl.ds(pl.multiple_of(i * tile_d, _ALIGN), tile_d + 2 * pad),
-        ],
-        scratch,
-        sems.at[0],
-    )
-    dma.start()
-    dma.wait()
+    ni = pl.num_programs(1)
+    # Double-buffered across the plane-chunk (inner) grid axis: window
+    # (j, i+1) lands in the other slot under (j, i)'s adder tree.  The
+    # first plane chunk of each word chunk loads serially (prefetching
+    # across the word-chunk boundary would need j+1's window at i==ni-1;
+    # the once-per-word-chunk stall is 1/ni of the fetches).
+    step_lin = j * ni + i
+    slot = jax.lax.rem(step_lin, 2)
+
+    def copies(ii, s):
+        return (
+            pltpu.make_async_copy(
+                ext_hbm.at[
+                    pl.ds(j * tile_w, tile_w + 2),
+                    pl.ds(
+                        pl.multiple_of(ii * tile_d, _ALIGN),
+                        tile_d + 2 * pad,
+                    ),
+                ],
+                scratch.at[s],
+                sems.at[s],
+            ),
+        )
+
+    load_window_double_buffered(copies, i, i + 1, slot, i == 0, i + 1 < ni)
     for step in range(k):
         lo = pad - (k - step)
         hi = pad + tile_d + (k - step)
-        scratch[:, lo + 1 : hi - 1] = _one_generation_wt(
-            scratch[:, lo:hi], birth, survive
+        scratch[slot, :, lo + 1 : hi - 1] = _one_generation_wt(
+            scratch[slot, :, lo:hi], birth, survive
         )
-    out_ref[:] = scratch[1:-1, pad : pad + tile_d]
+    out_ref[:] = scratch[slot, 1:-1, pad : pad + tile_d]
 
 
 def multi_step_pallas_packed3d_wt(
@@ -267,10 +303,11 @@ def multi_step_pallas_packed3d_wt(
         ),
         out_shape=jax.ShapeDtypeStruct(packed_w.shape, packed_w.dtype),
         scratch_shapes=[
+            # Two slots for the cross-grid-step prefetch (see _kernel_wt).
             pltpu.VMEM(
-                (tile_w + 2, tile_d + 2 * pad, h), packed_w.dtype
+                (2, tile_w + 2, tile_d + 2 * pad, h), packed_w.dtype
             ),
-            pltpu.SemaphoreType.DMA((1,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=jax.default_backend() != "tpu",
     )(ext)
@@ -278,18 +315,30 @@ def multi_step_pallas_packed3d_wt(
 
 # The wt kernel's own live-window factor: the 1024³ compile with a
 # 9-window model overflowed scoped VMEM by 1.73 MB at a 1.77 MB window —
-# the compiler's measured peak was 10.02 windows; 11 leaves margin.
-_LIVE_WINDOWS_WT = 11
+# the compiler's measured peak was 10.02 windows; 11 leaves margin, +1
+# for the double-buffered scratch's second slot.
+_LIVE_WINDOWS_WT = 12
+
+
+def recompute_score(tile_d: int, tile_w: int, pad: int = _ALIGN) -> float:
+    """Halo-recompute ratio of a kernel window: duplicated ghost compute
+    per useful output cell.  ``tile_w`` counts ghost *words* (2 total);
+    the plane axis carries ``2*pad`` ghost planes.  The plane kernel is
+    the ``tile_w -> inf`` special case (no word ghosts).  One definition
+    shared by the wt tile picker and evolve3d's kernel dispatch, so the
+    picker's objective and the dispatcher's comparison cannot drift.
+    """
+    word_factor = (tile_w + 2) / tile_w if tile_w else 1.0
+    return word_factor * ((tile_d + 2 * pad) / tile_d)
 
 
 def pick_tile3d_wt(depth: int, nw: int, h: int, pad: int = _ALIGN):
     """(tile_d, tile_w) for the word-tiled kernel, or None if nothing fits.
 
-    Minimizes the halo-recompute ratio
-    ``(tile_w+2)/tile_w · (tile_d+2·pad)/tile_d`` (the kernel is
-    VPU-bound, so duplicated ghost compute is the cost that matters) over
-    all feasible tiles under the scoped-VMEM window model; ties prefer
-    the larger plane tile (fewer launches/DMAs).
+    Minimizes :func:`recompute_score` (the kernel is VPU-bound, so
+    duplicated ghost compute is the cost that matters) over all feasible
+    tiles under the scoped-VMEM window model; ties prefer the larger
+    plane tile (fewer launches/DMAs).
     """
     budget = _SCOPED_LIMIT // (_LIVE_WINDOWS_WT * 4 * h)
     best = None
@@ -300,7 +349,7 @@ def pick_tile3d_wt(depth: int, nw: int, h: int, pad: int = _ALIGN):
             continue
         for tile_d in range(cap - cap % _ALIGN, 0, -_ALIGN):
             if depth % tile_d == 0:
-                score = ((tile_w + 2) / tile_w) * ((tile_d + 2 * pad) / tile_d)
+                score = recompute_score(tile_d, tile_w, pad)
                 if (
                     best is None
                     or score < best_score - 1e-12
@@ -372,12 +421,21 @@ def evolve3d(
                 f"{_LANE}-lane tiles on TPU: got H={h}"
             )
     tile = pick_tile3d(d, nw, h)
+    wt = pick_tile3d_wt(d, nw, h)
+    if tile and wt is not None:
+        # Both kernels fit: pick the lower halo-recompute ratio — the
+        # kernels are VPU-bound, so duplicated ghost compute decides.
+        # Measured (v5e, ×128 steps): 768³ plane tile 8 scores 3.0
+        # against wt (48, 4) at 2.0, and wt runs ~11% faster (1.78e11 vs
+        # 1.61e11); 512³ plane tile 32 scores 1.5 < wt's 1.875 and the
+        # plane kernel keeps the job.
+        if recompute_score(wt[0], wt[1]) < recompute_score(tile, 0):
+            tile = 0
     if tile == 0:
         # A single (nw, H) word plane is too large for the scoped-VMEM
-        # window (e.g. 1024³): split it along the word axis instead
-        # (the word-tiled kernel), keeping the fused path at every size
-        # whose H axis fills lanes.
-        wt = pick_tile3d_wt(d, nw, h)
+        # window (e.g. 1024³) — or the word-tiled split simply recomputes
+        # less: run the word-tiled kernel, keeping the fused path at
+        # every size whose H axis fills lanes.
         if wt is not None:
             tile_d, tile_w = wt
             packed_w = lax.bitcast_convert_type(

@@ -107,6 +107,36 @@ def tile_halo_copies(
     )
 
 
+def load_window_double_buffered(copies, idx, nxt, slot, first, has_next):
+    """The cross-grid-step DMA double-buffer protocol, shared by every
+    prefetching kernel.
+
+    ``copies(window_idx, slot)`` returns the async-copy descriptors
+    filling scratch slot ``slot`` with that window (descriptors must be
+    reconstructible — the wait rebuilds them, per the make_async_copy
+    contract).  On the grid's first step (``first``) window ``idx`` is
+    started serially; whenever ``has_next``, window ``nxt``'s copies are
+    started into the *other* slot before this step's compute; then this
+    window's copies are waited.  The caller computes from
+    ``scratch[slot]`` and relies on the two-step slot reuse distance:
+    the prefetch only ever writes the slot whose compute finished on the
+    previous grid step.
+    """
+
+    @pl.when(first)
+    def _():
+        for c in copies(idx, slot):
+            c.start()
+
+    @pl.when(has_next)
+    def _():
+        for c in copies(nxt, 1 - slot):
+            c.start()
+
+    for c in copies(idx, slot):
+        c.wait()
+
+
 def load_tile_with_halo(
     board_hbm, scratch, sems, i, *, tile, height, align, pad=None
 ):

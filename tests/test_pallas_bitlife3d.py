@@ -203,3 +203,28 @@ def test_evolve3d_dispatches_to_wt(monkeypatch):
     ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 11))
     np.testing.assert_array_equal(got, ref)
     assert calls  # the wt kernel actually ran (incl. the remainder launch)
+
+
+def test_score_dispatch_prefers_lower_recompute(monkeypatch):
+    """When both kernels fit, the halo-recompute score decides: a plane
+    tile of 8 (score 3.0) must lose to wt (48, 4) (score 2.0) — the 768³
+    situation, shrunk to interpret-mode size."""
+    monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 8)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: (48, 4)
+    )
+    calls = []
+    real = pallas_bitlife3d.multi_step_pallas_packed3d_wt
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_wt", spy
+    )
+    vol = _rand_vol(96, 8, 128, seed=31)  # depth 96 % 48 == 0, nw 4 % 4 == 0
+    got = np.asarray(pallas_bitlife3d.evolve3d(jnp.asarray(vol), 3))
+    ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 3))
+    np.testing.assert_array_equal(got, ref)
+    assert calls  # the word-tiled kernel won the dispatch
