@@ -57,16 +57,18 @@ def main() -> None:
     rng = np.random.default_rng(0)
     board = jnp.asarray((rng.random((size, size)) < 0.35).astype(np.uint8))
 
-    # Each entry: (evolve, steps) — the fused-kernel contenders run the
-    # full config-3 generation count, the slower tiers a shorter loop.
+    # Each entry: (evolve, steps), built by ``entry`` from one step count
+    # so the closure and the rate formula cannot drift — the fused-kernel
+    # contenders run the full config-3 generation count, the slower tiers
+    # a shorter loop.
+    def entry(fn, n):
+        return (lambda b: fn(b, n)), n
+
     engines = {}
     try:
         from gol_tpu.ops import bitlife
 
-        engines["bitpack"] = (
-            lambda b, s=slow_steps: bitlife.evolve_dense_io(b, s),
-            slow_steps,
-        )
+        engines["bitpack"] = entry(bitlife.evolve_dense_io, slow_steps)
     except ImportError:
         pass
     if on_tpu:
@@ -74,18 +76,16 @@ def main() -> None:
         try:
             from gol_tpu.ops import pallas_bitlife
 
-            engines["pallas_bitpack"] = (
-                lambda b, s=steps: pallas_bitlife.evolve(b, s, 1024),
-                steps,
+            engines["pallas_bitpack"] = entry(
+                lambda b, s: pallas_bitlife.evolve(b, s, 1024), steps
             )
         except ImportError:
             pass
         try:
             from gol_tpu.ops import pallas_step
 
-            engines["pallas"] = (
-                lambda b, s=slow_steps: pallas_step.evolve(b, s, 512),
-                slow_steps,
+            engines["pallas"] = entry(
+                lambda b, s: pallas_step.evolve(b, s, 512), slow_steps
             )
         except ImportError:
             pass
@@ -96,18 +96,15 @@ def main() -> None:
             from gol_tpu.parallel import packed as packed_mod
 
             ring = mesh_mod.make_mesh_1d(1)
-            engines["pallas_ring"] = (
-                lambda b, s=steps: (
+            engines["pallas_ring"] = entry(
+                lambda b, s: (
                     packed_mod.compiled_evolve_packed_pallas(ring, s)(b)
                 ),
                 steps,
             )
         except ImportError:
             pass
-    engines["dense"] = (
-        lambda b, s=slow_steps: stencil.run(b, s),
-        slow_steps,
-    )
+    engines["dense"] = entry(stencil.run, slow_steps)
 
     results = {}
     for name, (evolve, esteps) in engines.items():

@@ -38,10 +38,10 @@ from gol_tpu.models.state import CELL_DTYPE
 
 WORD = jnp.uint32
 BITS = 32
-# A numpy (not jnp) scalar: creating a device array at import time would
-# initialize the XLA backend, which must not happen before a possible
+# pack/unpack build their weight planes from numpy (not jnp) scalars:
+# creating a device array at import time would initialize the XLA
+# backend, which must not happen before a possible
 # jax.distributed.initialize (multi-host CLI path).
-_ONE = np.uint32(1)
 
 
 def packed_width(width: int) -> int:
@@ -54,19 +54,30 @@ def packed_width(width: int) -> int:
 
 
 def pack(board: jax.Array) -> jax.Array:
-    """uint8[H, W] 0/1 board -> uint32[H, W//32]; bit j of word k = col 32k+j."""
+    """uint8[H, W] 0/1 board -> uint32[H, W//32]; bit j of word k = col 32k+j.
+
+    Staged through uint8 bytes: the obvious one-step form (widen every
+    cell to uint32, weight, reduce) materializes a 4×-board uint32
+    intermediate — 17 GB at 65536², an HBM OOM on a 16 GB chip.  Packing
+    8 cells per *byte* first keeps the big temporaries at board width in
+    uint8; only the 4-bytes-per-word combine widens, at 1/8th the cells.
+    """
     h, w = board.shape
     nw = packed_width(w)
-    lanes = board.reshape(h, nw, BITS).astype(WORD)
-    weights = (_ONE << jnp.arange(BITS, dtype=WORD)).reshape(1, 1, BITS)
-    return jnp.sum(lanes * weights, axis=-1, dtype=WORD)
+    bits = board.reshape(h, nw, 4, 8)
+    w8 = (np.uint8(1) << np.arange(8, dtype=np.uint8)).reshape(1, 1, 1, 8)
+    by = jnp.sum(bits * w8, axis=-1, dtype=jnp.uint8)  # [h, nw, 4]
+    shifts = (np.arange(4, dtype=np.uint32) * np.uint32(8)).reshape(1, 1, 4)
+    return jnp.sum(by.astype(WORD) << shifts, axis=-1, dtype=WORD)
 
 
 def unpack(packed: jax.Array) -> jax.Array:
-    """Inverse of :func:`pack`."""
+    """Inverse of :func:`pack` (byte-staged for the same HBM reason)."""
     h, nw = packed.shape
-    shifts = jnp.arange(BITS, dtype=WORD).reshape(1, 1, BITS)
-    bits = (packed[:, :, None] >> shifts) & _ONE
+    shifts = (np.arange(4, dtype=np.uint32) * np.uint32(8)).reshape(1, 1, 4)
+    by = ((packed[:, :, None] >> shifts) & np.uint32(0xFF)).astype(jnp.uint8)
+    bit_shifts = np.arange(8, dtype=np.uint8).reshape(1, 1, 1, 8)
+    bits = (by[..., None] >> bit_shifts) & np.uint8(1)
     return bits.astype(CELL_DTYPE).reshape(h, nw * BITS)
 
 
