@@ -48,7 +48,7 @@ from gol_tpu.parallel import packed as packed_mod
 from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils.timing import time_best
 
-ENGINES = ("dense", "bitpack", "pallas")
+ENGINES = ("dense", "bitpack", "pallas", "pallas_overlap")
 
 
 def device_counts(limit: Optional[int] = None) -> List[int]:
@@ -84,7 +84,8 @@ def measure_weak_scaling(
         # Efficiency is defined against the 1-device throughput; a sweep
         # that skips it would silently re-baseline on its first row.
         raise ValueError(f"counts must start at 1, got {counts}")
-    if engine == "pallas" and jax.default_backend() == "tpu":
+    pallas_like = engine in ("pallas", "pallas_overlap")
+    if pallas_like and jax.default_backend() == "tpu":
         # Surface the fused kernel's lane constraint early (it otherwise
         # raises deep inside shard_map tracing).  Loop-invariant: the
         # width axis is unsharded on the 1-D row mesh.
@@ -106,7 +107,9 @@ def measure_weak_scaling(
     for n in counts:
         mesh = mesh_mod.make_mesh_1d(num_devices=n)
         shape = (n * size_per_chip, size_per_chip)
-        if engine in ("pallas", "bitpack"):
+        if pallas_like or engine == "bitpack":
+            # Packable widths are >= 32, so the square shard also always
+            # clears the overlap form's 24-row interior/boundary minimum.
             packed_mod.validate_packed_geometry(shape, mesh)
         else:
             mesh_mod.validate_geometry(shape, mesh)
@@ -126,12 +129,13 @@ def measure_weak_scaling(
                     rng.random((height, size_per_chip)) < 0.35
                 ).astype(np.uint8)
                 board = mesh_mod.shard_board(jnp.asarray(board_np), mesh)
-                if engine == "pallas":
+                if pallas_like:
                     # The flagship multi-chip program (fused kernel per
-                    # shard over the ring).  Meaningful curves need a real
-                    # TPU — interpret mode is far too slow.
+                    # shard over the ring), serial or overlap form.
+                    # Meaningful curves need a real TPU — interpret mode
+                    # is far too slow.
                     evolve = packed_mod.compiled_evolve_packed_pallas(
-                        mesh, steps
+                        mesh, steps, overlap=engine == "pallas_overlap"
                     )
                 elif engine == "bitpack":
                     evolve = packed_mod.compiled_evolve_packed(mesh, steps)

@@ -105,3 +105,22 @@ def test_two_process_weak_scaling_curve():
     assert all(r["updates_per_s"] > 0 for r in rec["rows"])
     assert rec["rows"][0]["efficiency"] == 1.0
     assert all(r["efficiency"] > 0 for r in rec["rows"])
+
+
+def test_pallas_overlap_engine_sweep():
+    """The overlap form of the flagship engine sweeps like the serial one
+    (interpret mode; shard height >= 24 for the interior/boundary split)."""
+    rows = scalebench.measure_weak_scaling(
+        64, steps=8, engine="pallas_overlap", counts=[1, 2]
+    )
+    assert [r["devices"] for r in rows] == [1, 2]
+    assert all(r["updates_per_s"] > 0 for r in rows)
+
+
+def test_pallas_overlap_engine_unpackable_width_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="divisible"):
+        scalebench.measure_weak_scaling(
+            16, steps=8, engine="pallas_overlap", counts=[1]
+        )
