@@ -6,6 +6,7 @@ same surface so the two drivers feel like one tool:
     python -m gol_tpu.cli3d <pattern> <size> <iterations> <threads> <on_off>
         [--rule NAME|B../S..] [--engine {auto,dense,bitpack,pallas}]
         [--mesh {none,3d}] [--outdir DIR]
+        [--checkpoint-every K] [--checkpoint-dir DIR] [--resume CKPT]
 
 Patterns: 0 all-zeros, 1 all-ones, 2 random (density 0.3, fixed seed 0 —
 deterministic across engines and meshes).  ``size`` is the cube edge
@@ -160,6 +161,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--engine", choices=ENGINES3D, default="auto")
     ext.add_argument("--mesh", choices=["none", "3d"], default="none")
     ext.add_argument("--outdir", default=".")
+    # Checkpoint/resume, mirroring the 2-D driver: periodic
+    # fingerprint-stamped volume snapshots, verified + rule-checked on
+    # resume (utils/checkpoint.py save3d/load3d).
+    ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
+    ext.add_argument("--checkpoint-dir", default="checkpoints3d")
+    ext.add_argument("--resume", default=None, metavar="CKPT")
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -177,8 +184,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(f"iterations must be >= 0, got {iterations}")
         if threads <= 0:
             raise ValueError(f"threads per block must be positive, got {threads}")
+        if ns.checkpoint_every < 0:
+            raise ValueError(
+                f"--checkpoint-every must be >= 0, got {ns.checkpoint_every}"
+            )
         rule = parse_rule3d(ns.rule)
-        vol = init_volume(pattern, size)
+
+        from gol_tpu.ops.life3d import rulestring3d
+        from gol_tpu.utils import checkpoint as ckpt_mod
+
+        generation = 0
+        if ns.resume:
+            snap = ckpt_mod.load3d(ns.resume)
+            if snap.volume.shape != (size, size, size):
+                raise ValueError(
+                    f"checkpoint volume {snap.volume.shape} != configured "
+                    f"{(size, size, size)}"
+                )
+            mine = rulestring3d(rule)
+            if snap.rule != mine:
+                raise ValueError(
+                    f"checkpoint was written by a {snap.rule} run; this "
+                    f"run is configured for {mine} — pass the matching "
+                    "--rule to resume"
+                )
+            vol = snap.volume
+            generation = snap.generation
+        else:
+            vol = init_volume(pattern, size)
 
         mesh = None
         if ns.mesh == "3d":
@@ -190,19 +223,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         sw = Stopwatch()
         if iterations > 0:
+            # GolRuntime's schedule policy: full checkpoint intervals plus
+            # one tail, one AOT-compiled evolver per distinct size.
+            from gol_tpu.runtime import chunk_schedule
+
+            schedule = chunk_schedule(
+                iterations,
+                ns.checkpoint_every if ns.checkpoint_every > 0 else iterations,
+            )
             with sw.phase("compile"):
-                compiled, place = _build_evolver(
-                    ns.engine, mesh, iterations, rule, size
-                )
+                evolvers = {
+                    take: _build_evolver(ns.engine, mesh, take, rule, size)
+                    for take in set(schedule)
+                }
+                place = evolvers[schedule[0]][1]
                 board = place(vol)
                 force_ready(board)
-            with sw.phase("total"):
-                out = compiled(board)
-                force_ready(out)
+            for take in schedule:
+                compiled, _ = evolvers[take]
+                with sw.phase("total"):
+                    board = compiled(board)
+                    force_ready(board)
+                generation += take
+                if ns.checkpoint_every > 0:
+                    with sw.phase("checkpoint"):
+                        ckpt_mod.save3d(
+                            ckpt_mod.checkpoint3d_path(
+                                ns.checkpoint_dir, generation
+                            ),
+                            np.asarray(board),
+                            generation,
+                            rulestring3d(rule),
+                        )
+            out = board
         else:
             out = vol
         out_np = np.asarray(out)
-    except ValueError as e:
+    except (ValueError, OSError) as e:
+        # Same surface as the 2-D driver (gol_tpu/cli.py): bad --resume
+        # paths, corrupt snapshots, unavailable engines, unwritable dirs
+        # all exit cleanly with the message, not a traceback.
         print(e)
         return 255
 

@@ -37,6 +37,17 @@ BAYS_4555 = Rule3D(birth=frozenset({5}), survive=frozenset({4, 5}))
 BAYS_5766 = Rule3D(birth=frozenset({6}), survive=frozenset({5, 6, 7}))
 
 
+def rulestring3d(rule: Rule3D) -> str:
+    """Canonical ``B<counts>/S<counts>`` form (comma-separated, sorted) —
+    round-trips through ``gol_tpu.cli3d.parse_rule3d``; stamped into 3-D
+    checkpoints so resume can refuse a rule mismatch."""
+
+    def fmt(counts):
+        return ",".join(str(c) for c in sorted(counts))
+
+    return f"B{fmt(rule.birth)}/S{fmt(rule.survive)}"
+
+
 def _count_in(n: jax.Array, counts: FrozenSet[int]) -> jax.Array:
     hits = [n == c for c in sorted(counts)]
     # Explicit init keeps the empty set legal (an always-false predicate,

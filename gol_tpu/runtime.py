@@ -48,6 +48,23 @@ def build_mesh(kind: str) -> Optional[Mesh]:
     raise ValueError(f"unknown mesh kind {kind!r}; expected one of {MESH_CHOICES}")
 
 
+def chunk_schedule(iterations: int, chunk: int) -> list:
+    """Full chunks of ``chunk`` generations plus one tail.
+
+    The one schedule policy behind every chunked loop (checkpoint and
+    guard cadence in :class:`GolRuntime`, the 3-D driver's checkpointing)
+    — shared so tail handling cannot drift between drivers.
+    """
+    chunk = min(chunk, iterations) if iterations else 0
+    schedule = []
+    remaining = iterations
+    while remaining > 0:
+        take = min(chunk, remaining)
+        schedule.append(take)
+        remaining -= take
+    return schedule
+
+
 @dataclasses.dataclass
 class GolRuntime:
     geometry: Geometry
@@ -560,14 +577,7 @@ class GolRuntime:
     # -- shared compile machinery -------------------------------------------
     def chunk_schedule(self, iterations: int, chunk: int) -> list:
         """Full chunks of ``chunk`` generations plus one tail."""
-        chunk = min(chunk, iterations) if iterations else 0
-        schedule = []
-        remaining = iterations
-        while remaining > 0:
-            take = min(chunk, remaining)
-            schedule.append(take)
-            remaining -= take
-        return schedule
+        return chunk_schedule(iterations, chunk)
 
     def compile_evolvers(self, board, schedule) -> dict:
         """AOT-compile one evolver per distinct chunk size in ``schedule``.

@@ -172,6 +172,74 @@ def latest(directory: str) -> Optional[str]:
     return os.path.join(directory, ckpts[-1]) if ckpts else None
 
 
+# -- 3-D volume snapshots (the cli3d driver's persistence) -------------------
+
+
+CKPT3D_SUFFIX = ".gol3d.npz"
+
+
+def checkpoint3d_path(directory: str, generation: int) -> str:
+    return os.path.join(
+        directory, f"ckpt3d_{generation:012d}{CKPT3D_SUFFIX}"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot3D:
+    volume: np.ndarray
+    generation: int
+    rule: str  # 3-D rulestring (e.g. "B4/S4,5" / named form's expansion)
+
+
+def _vol_fingerprint(vol: np.ndarray) -> int:
+    """Volume integrity stamp: the 2-D position-weighted fingerprint over
+    the ``[D*H, W]`` flattening (deterministic, shape-free)."""
+    from gol_tpu.utils.guard import fingerprint_np
+
+    d, h, w = vol.shape
+    return fingerprint_np(vol.reshape(d * h, w))
+
+
+def save3d(path: str, vol: np.ndarray, generation: int, rule: str) -> str:
+    """Atomic fingerprint-stamped 3-D snapshot (same contract as
+    :func:`save`, volume-shaped)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    vol = np.asarray(vol, np.uint8)
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(
+        tmp,
+        volume=vol,
+        generation=np.int64(generation),
+        rule=np.asarray(rule),
+        fingerprint=np.uint32(_vol_fingerprint(vol)),
+    )
+    os.replace(tmp, path)
+    return path
+
+
+def load3d(path: str) -> Snapshot3D:
+    """Read + fingerprint-verify a 3-D snapshot."""
+    with np.load(path) as data:
+        if "volume" not in data:
+            raise CorruptSnapshotError(
+                f"{path}: not a 3-D snapshot (no 'volume' array — a 2-D "
+                f"{CKPT_SUFFIX} checkpoint belongs to the 2-D driver)"
+            )
+        vol = data["volume"].astype(np.uint8)
+        stored = int(data["fingerprint"])
+        actual = _vol_fingerprint(vol)
+        if stored != actual:
+            raise CorruptSnapshotError(
+                f"{path}: stored fingerprint {stored:#010x} != computed "
+                f"{actual:#010x}; the snapshot is corrupt"
+            )
+        return Snapshot3D(
+            volume=vol,
+            generation=int(data["generation"]),
+            rule=str(data["rule"]),
+        )
+
+
 # -- sharded checkpoints (multi-host: no host materializes the board) --------
 #
 # Layout of a ``ckpt_<gen>.gol.d/`` directory:

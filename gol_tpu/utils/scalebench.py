@@ -20,9 +20,10 @@ unchanged over ICI.
 
 Run as a module for a JSON report:
 ``python -m gol_tpu.utils.scalebench [size_per_chip] [steps] [engine]``
-(engine ``dense`` | ``bitpack`` | ``pallas`` — the last is the flagship
-fused-kernel-per-shard program; on TPU it needs ``size_per_chip`` to be a
-multiple of 4096 so the packed width fills whole 128-lane tiles).
+(engine ``dense`` | ``bitpack`` | ``pallas`` | ``pallas_overlap`` — the
+last two are the flagship fused-kernel-per-shard program in its serial
+and comm/compute-overlap forms; on TPU they need ``size_per_chip`` to be
+a multiple of 4096 so the packed width fills whole 128-lane tiles).
 
 **Multi-host sweeps** (the config-4 pod shape): pass the same trio as the
 CLI — ``--coordinator HOST:PORT --num-processes N --process-id I`` — on
@@ -94,7 +95,7 @@ def measure_weak_scaling(
         lane_cells = pallas_bitlife._LANE * bitlife.BITS
         if size_per_chip % lane_cells:
             raise ValueError(
-                "engine 'pallas' on TPU needs size_per_chip to be a "
+                f"engine {engine!r} on TPU needs size_per_chip to be a "
                 f"multiple of {lane_cells} (128-lane packed width); got "
                 f"{size_per_chip}"
             )

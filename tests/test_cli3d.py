@@ -106,3 +106,93 @@ def test_sharded_3d_custom_rule(tmp_path):
         np.load(tmp_path / "mesh" / "World3D_of_1.npy"),
         np.load(tmp_path / "single" / "World3D_of_1.npy"),
     )
+
+
+# -- checkpoint / resume (capability parity with the 2-D driver) -------------
+
+
+def test_cli3d_checkpoint_and_resume_equivalence(tmp_path, capsys):
+    """10 straight generations == 4 generations + snapshot + resumed 6."""
+    from gol_tpu import cli3d
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    out_a = tmp_path / "a"
+    out_b = tmp_path / "b"
+    ck = tmp_path / "ck"
+    assert cli3d.main(
+        ["2", "32", "10", "64", "1", "--outdir", str(out_a)]
+    ) == 0
+    assert cli3d.main(
+        ["2", "32", "4", "64", "0", "--checkpoint-every", "4",
+         "--checkpoint-dir", str(ck)]
+    ) == 0
+    resume = ckpt_mod.checkpoint3d_path(str(ck), 4)
+    assert cli3d.main(
+        ["2", "32", "6", "64", "1", "--resume", resume,
+         "--outdir", str(out_b)]
+    ) == 0
+    import numpy as np_
+
+    a = np_.load(out_a / "World3D_of_1.npy")
+    b = np_.load(out_b / "World3D_of_1.npy")
+    np_.testing.assert_array_equal(a, b)
+
+
+def test_cli3d_resume_rule_mismatch_rejected(tmp_path, capsys):
+    from gol_tpu import cli3d
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    ck = tmp_path / "ck"
+    assert cli3d.main(
+        ["2", "32", "4", "64", "0", "--checkpoint-every", "4",
+         "--checkpoint-dir", str(ck), "--rule", "bays5766"]
+    ) == 0
+    capsys.readouterr()
+    rc = cli3d.main(
+        ["2", "32", "2", "64", "0",
+         "--resume", ckpt_mod.checkpoint3d_path(str(ck), 4)]
+    )
+    assert rc == 255
+    assert "pass the matching --rule" in capsys.readouterr().out
+
+
+def test_cli3d_resume_corrupt_snapshot_rejected(tmp_path, capsys):
+    import numpy as np_
+
+    from gol_tpu import cli3d
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    path = ckpt_mod.checkpoint3d_path(str(tmp_path), 3)
+    vol = np_.random.default_rng(0).integers(0, 2, (32, 32, 32), np_.uint8)
+    ckpt_mod.save3d(path, vol, 3, "B5/S4,5")
+    with np_.load(path) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    arrays["volume"][0, 0, 0] ^= 1  # in-range flip
+    np_.savez_compressed(path, **arrays)
+    capsys.readouterr()
+    rc = cli3d.main(["2", "32", "2", "64", "0", "--resume", path])
+    assert rc == 255
+    assert "corrupt" in capsys.readouterr().out
+
+
+def test_cli3d_resume_missing_file_fails_clean(tmp_path, capsys):
+    from gol_tpu import cli3d
+
+    rc = cli3d.main(
+        ["2", "32", "2", "64", "0", "--resume", str(tmp_path / "nope.npz")]
+    )
+    assert rc == 255  # OSError path: clean message, no traceback
+
+
+def test_cli3d_resume_2d_checkpoint_rejected(tmp_path, capsys):
+    import numpy as np_
+
+    from gol_tpu import cli3d
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    path = ckpt_mod.checkpoint_path(str(tmp_path), 1)
+    ckpt_mod.save(path, np_.zeros((8, 8), np_.uint8), 1, num_ranks=1)
+    capsys.readouterr()
+    rc = cli3d.main(["2", "32", "2", "64", "0", "--resume", path])
+    assert rc == 255
+    assert "not a 3-D snapshot" in capsys.readouterr().out
