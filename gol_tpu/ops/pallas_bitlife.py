@@ -222,17 +222,7 @@ def _kernel_ext(*refs, tile: int, k: int, rule=None):
         )
 
     load_window_double_buffered(copies, i, i + 1, slot, i == 0, i + 1 < nt)
-    for j in range(k):
-        a = j
-        b = tile + 2 * k - j
-        scratch[slot, a + 1 : b - 1] = _one_generation(
-            scratch[slot, a:b], rule
-        )
-    out_ref[:] = scratch[slot, k : k + tile]
-    if edges_ref is not None:
-        nw = out_ref.shape[1]
-        out_ref[:, 0:1] = edges_ref[:, 0:1]
-        out_ref[:, nw - 1 : nw] = edges_ref[:, 1:2]
+    _evolve_window_and_store(scratch, slot, out_ref, edges_ref, tile, k, rule)
 
 
 def multi_step_pallas_packed_ext(
@@ -279,6 +269,203 @@ def multi_step_pallas_packed_ext(
             # window lands in the other (see _kernel_ext).
             pltpu.VMEM((2, tile + 2 * k, nw), ext_i32.dtype),
             pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(*operands)
+
+
+def _evolve_window_and_store(
+    scratch, slot, out_ref, edges_ref, tile: int, k: int, rule
+):
+    """The ext kernels' shared compute tail: k in-place generations over
+    the slot's window (shrinking one row per side per step), body store,
+    and the optional exact-edge-word overwrite (see
+    :func:`multi_step_pallas_packed_ext`)."""
+    for j in range(k):
+        a = j
+        b = tile + 2 * k - j
+        scratch[slot, a + 1 : b - 1] = _one_generation(
+            scratch[slot, a:b], rule
+        )
+    out_ref[:] = scratch[slot, k : k + tile]
+    if edges_ref is not None:
+        nw = out_ref.shape[1]
+        out_ref[:, 0:1] = edges_ref[:, 0:1]
+        out_ref[:, nw - 1 : nw] = edges_ref[:, 1:2]
+
+
+def _kernel_ext_bands(*refs, tile: int, k: int, rule=None):
+    """k generations of one tile, ghost band as a separate operand.
+
+    Same compute as :func:`_kernel_ext`, but the k-row ghost bands arrive
+    as their own ``[2k, nw]`` operand instead of pre-concatenated onto
+    the block — so the sharded engine never materializes the
+    ``[h+2k, nw]`` extended array (a full-board HBM copy per chunk, ~1/9
+    of the chunk's traffic at k=8).  Each tile's window is assembled in
+    VMEM from three fixed-size segments: a k-row top segment (the band's
+    top half for tile 0, else block rows), the tile body, and a k-row
+    bottom segment (block rows, or the band's bottom half for the last
+    tile).  Segment source is resolved by ``pl.when`` pairs whose wait
+    mirrors the start, and the whole plan is double-buffered across grid
+    steps like the other kernels.
+    """
+    if len(refs) == 5:
+        blk_hbm, bands_hbm, out_ref, scratch, sems = refs
+        edges_ref = None
+    else:
+        blk_hbm, bands_hbm, edges_ref, out_ref, scratch, sems = refs
+    i = pl.program_id(0)
+    nt = pl.num_programs(0)
+    slot = jax.lax.rem(i, 2)
+
+    def segs(j, s):
+        """(predicate, descriptor) pairs for window j into slot s; the
+        body descriptor's predicate is None (unconditional)."""
+        base = pl.multiple_of(j * tile, _ALIGN)
+        # Clamped so the never-started branch's descriptor stays in
+        # bounds (the clamps are no-ops whenever the branch does start).
+        top_blk = pl.multiple_of(jnp.maximum(base - k, 0), _ALIGN)
+        bot_blk = pl.multiple_of(
+            jnp.minimum(base + tile, blk_hbm.shape[0] - k), _ALIGN
+        )
+        mk = pltpu.make_async_copy
+        return (
+            (
+                j == 0,
+                mk(
+                    bands_hbm.at[pl.ds(0, k)],
+                    scratch.at[s, pl.ds(0, k)],
+                    sems.at[s, 0],
+                ),
+            ),
+            (
+                j > 0,
+                mk(
+                    blk_hbm.at[pl.ds(top_blk, k)],
+                    scratch.at[s, pl.ds(0, k)],
+                    sems.at[s, 0],
+                ),
+            ),
+            (
+                None,
+                mk(
+                    blk_hbm.at[pl.ds(base, tile)],
+                    scratch.at[s, pl.ds(k, tile)],
+                    sems.at[s, 1],
+                ),
+            ),
+            (
+                j == nt - 1,
+                mk(
+                    bands_hbm.at[pl.ds(k, k)],
+                    scratch.at[s, pl.ds(k + tile, k)],
+                    sems.at[s, 2],
+                ),
+            ),
+            (
+                j < nt - 1,
+                mk(
+                    blk_hbm.at[pl.ds(bot_blk, k)],
+                    scratch.at[s, pl.ds(k + tile, k)],
+                    sems.at[s, 2],
+                ),
+            ),
+        )
+
+    def for_each_seg(j, s, action):
+        for pred, desc in segs(j, s):
+            if pred is None:
+                action(desc)
+            else:
+                @pl.when(pred)
+                def _(d=desc):
+                    action(d)
+
+    def start_all(j, s):
+        for_each_seg(j, s, lambda d: d.start())
+
+    def wait_all(j, s):
+        for_each_seg(j, s, lambda d: d.wait())
+
+    @pl.when(i == 0)
+    def _():
+        start_all(i, slot)
+
+    @pl.when(i + 1 < nt)
+    def _():
+        start_all(i + 1, 1 - slot)
+
+    wait_all(i, slot)
+    _evolve_window_and_store(scratch, slot, out_ref, edges_ref, tile, k, rule)
+
+
+def multi_step_pallas_packed_bands(
+    blk_i32: jax.Array,
+    bands_i32: jax.Array,
+    tile: int,
+    k: int,
+    rule=None,
+    edges_i32=None,
+) -> jax.Array:
+    """k fused generations of a packed block with a separate ghost band.
+
+    ``blk_i32[h, W/32]`` is the shard's own rows; ``bands_i32[2k, W/32]``
+    stacks the k-row top and bottom ghost bands a ring exchange produced
+    (fresh, same traced program).  Columns wrap locally; ``edges_i32``
+    follows the :func:`multi_step_pallas_packed_ext` contract.  Returns
+    the updated ``[h, W/32]``.
+    """
+    if k < 1 or k % _ALIGN:
+        raise ValueError(
+            f"banded kernel needs k to be a positive multiple of "
+            f"{_ALIGN}, got {k}"
+        )
+    height, nw = blk_i32.shape
+    if bands_i32.shape != (2 * k, nw):
+        raise ValueError(
+            f"bands must be [2k, nw] = {(2 * k, nw)}, got {bands_i32.shape}"
+        )
+    validate_tile(height, tile, _ALIGN)
+    if tile < k:
+        # An interior tile's k-row halo segments come from adjacent block
+        # rows in ONE descriptor each; with tile < k the segment would
+        # span more than one neighboring tile and the in-bounds clamps
+        # would silently fetch the wrong rows.  Callers with tile < k use
+        # the pre-extended kernel (multi_step_pallas_packed_ext) instead.
+        raise ValueError(
+            f"banded kernel needs tile ({tile}) >= band depth k ({k})"
+        )
+    if height < tile + k:
+        # A single-tile block still needs k rows below the body for the
+        # bot_blk descriptor's clamped source to stay in bounds; with
+        # height == tile that descriptor is never started (j == nt-1) but
+        # must still describe valid memory (tile >= k above keeps its
+        # clamped start non-negative).
+        if height != tile:
+            raise ValueError(
+                f"banded kernel needs block height {height} >= tile + k"
+            )
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    operands = [blk_i32, bands_i32]
+    if edges_i32 is not None:
+        in_specs.append(
+            pl.BlockSpec((tile, 2), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        )
+        operands.append(edges_i32)
+    return pl.pallas_call(
+        functools.partial(_kernel_ext_bands, tile=tile, k=k, rule=rule),
+        grid=(height // tile,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (tile, nw), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((height, nw), blk_i32.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, tile + 2 * k, nw), blk_i32.dtype),
+            pltpu.SemaphoreType.DMA((2, 3)),
         ],
         interpret=jax.default_backend() != "tpu",
     )(*operands)

@@ -154,12 +154,18 @@ def compiled_evolve_packed_pallas(
 ):
     """Sharded evolve running the fused Pallas kernel per shard.
 
-    The flagship multi-chip configuration: per chunk, one ``halo_extend``
-    ring exchange ships a ``halo_depth``-deep packed ghost band
-    (``lax.ppermute`` over ICI), then the shard steps ``halo_depth``
-    generations inside a single Pallas launch
-    (:func:`gol_tpu.ops.pallas_bitlife.multi_step_pallas_packed_ext` — the
-    no-wrap variant; the exchanged band replaces the torus DMA).
+    The flagship multi-chip configuration: per chunk, one ring exchange
+    ships a ``halo_depth``-deep packed ghost band (``lax.ppermute`` over
+    ICI), then the shard steps ``halo_depth`` generations inside a single
+    Pallas launch.  The band rides its *own* kernel operand
+    (:func:`gol_tpu.ops.pallas_bitlife.multi_step_pallas_packed_bands`),
+    so the shard's rows are never re-copied into an extended array — the
+    halo_extend concat was a full-board HBM round trip per chunk, worth
+    ~4% of end-to-end throughput at 16384² (1.81e12 vs 1.73e12
+    cell-updates/s at ×10240).  Tiles smaller than the band depth fall
+    back to the pre-extended kernel
+    (:func:`~gol_tpu.ops.pallas_bitlife.multi_step_pallas_packed_ext`),
+    whose windows may span several neighbor tiles.
     ``halo_depth`` must be a multiple of 8 (DMA row alignment).  A
     non-multiple remainder of ``steps`` runs on the jnp packed step.
     Defaults are the measured single-chip sweet spot at 16384²×1024
@@ -232,6 +238,30 @@ def compiled_evolve_packed_pallas(
         )
         return lax.bitcast_convert_type(out, jnp.uint32)
 
+    def kernel_bands(blk_u32, bands_u32, tile, k, edges_u32=None):
+        out = pallas_bitlife.multi_step_pallas_packed_bands(
+            lax.bitcast_convert_type(blk_u32, jnp.int32),
+            lax.bitcast_convert_type(bands_u32, jnp.int32),
+            tile,
+            k,
+            rule,
+            None
+            if edges_u32 is None
+            else lax.bitcast_convert_type(edges_u32, jnp.int32),
+        )
+        return lax.bitcast_convert_type(out, jnp.uint32)
+
+    def bands_for(p_u32):
+        """The chunk's k-row ghost bands, fresh off the ring."""
+        k = halo_depth
+        top_ghost = lax.ppermute(p_u32[-k:], ROWS, ring(num_rows, 1))
+        bottom_ghost = lax.ppermute(p_u32[:k], ROWS, ring(num_rows, -1))
+        return top_ghost, bottom_ghost
+
+    def four(a):
+        """A block's four boundary word-columns, lane-packed."""
+        return jnp.concatenate([a[:, :2], a[:, -2:]], axis=1)
+
     def jnp_step(ext):
         if rule is None:
             return bitlife.step_packed_vext(ext)
@@ -254,9 +284,13 @@ def compiled_evolve_packed_pallas(
         return rules_mod.step_rule_packed_vext_nowrap_t(ext_t, rule)
 
     def chunk(p_u32, tile):
-        return kernel(
-            halo_extend(p_u32, phases, depth=halo_depth), tile, halo_depth
-        )
+        # Band as its own kernel operand: the exchange ships 2k rows and
+        # the shard's own rows are never re-copied into an extended array
+        # (halo_extend's concat cost a full-board HBM round trip per
+        # chunk — ~1/9 of chunk traffic at k=8).
+        top_ghost, bottom_ghost = bands_for(p_u32)
+        bands = jnp.concatenate([top_ghost, bottom_ghost])
+        return kernel_bands(p_u32, bands, tile, halo_depth)
 
     def exact_edges(edges_t):
         """Exact post-chunk edge word-columns from the row-extended block's
@@ -284,19 +318,37 @@ def compiled_evolve_packed_pallas(
             strips = jnp_step_nowrap_t(strips)
         return jnp.stack([strips[0, 1], strips[1, 1]], axis=1)  # [h, 2]
 
-    def chunk2d(p_u32, tile):
+    def chunk_ext(p_u32, tile):
+        # tile < halo_depth fallback: the banded kernel's one-descriptor
+        # halo segments can't span multiple neighbor tiles, so small
+        # tiles take the pre-extended form (one extra board copy/chunk).
+        return kernel(
+            halo_extend(p_u32, phases, depth=halo_depth), tile, halo_depth
+        )
+
+    def chunk2d_ext(p_u32, tile):
         ext = halo_extend(p_u32, phases, depth=halo_depth)  # rows only
-        # One transpose pulls all four boundary columns into lane-major
-        # layout up front; the kernel input stays the row-extended block
-        # itself, so no full-width rematerialization either.
         edges = exact_edges(
             jnp.concatenate([ext[:, :2], ext[:, -2:]], axis=1).T
         )
+        return kernel(ext, tile, halo_depth, edges)
+
+    def chunk2d(p_u32, tile):
+        top_ghost, bottom_ghost = bands_for(p_u32)
+        # One transpose pulls all four boundary columns into lane-major
+        # layout up front, sliced from the pieces (no row-extended array
+        # is ever materialized — the band rides its own kernel operand).
+        edges = exact_edges(
+            jnp.concatenate(
+                [four(top_ghost), four(p_u32), four(bottom_ghost)], axis=0
+            ).T
+        )
+        bands = jnp.concatenate([top_ghost, bottom_ghost])
         # Kernel at the lane-aligned shard width; its local column wrap is
         # wrong at the vertical seams, confined by the light cone to the
         # outer halo_depth bits of the two edge words — which the kernel
         # overwrites with `edges` during its own output store.
-        return kernel(ext, tile, halo_depth, edges)
+        return kernel_bands(p_u32, bands, tile, halo_depth, edges)
 
     def _boundary_pieces(p_u32, tile_int):
         """Interior kernel (ppermute-independent) + band-gated edge kernels.
@@ -308,8 +360,7 @@ def compiled_evolve_packed_pallas(
         ``[-k, 2k)`` and ``[h-2k, h+k)``).
         """
         k = halo_depth
-        top_ghost = lax.ppermute(p_u32[-k:], ROWS, ring(num_rows, 1))
-        bottom_ghost = lax.ppermute(p_u32[:k], ROWS, ring(num_rows, -1))
+        top_ghost, bottom_ghost = bands_for(p_u32)
         interior = kernel(p_u32, tile_int, k)  # output rows [k, h-k)
         top = kernel(jnp.concatenate([top_ghost, p_u32[: 2 * k]]), k, k)
         bottom = kernel(
@@ -334,7 +385,6 @@ def compiled_evolve_packed_pallas(
         # spliced by a lane concat instead of the kernel's own output
         # store — the serial form's advantage this mode trades away for
         # the overlap.
-        four = lambda a: jnp.concatenate([a[:, :2], a[:, -2:]], axis=1)
         edges = exact_edges(
             jnp.concatenate(
                 [four(top_ghost), four(p_u32), four(bottom_ghost)], axis=0
@@ -401,8 +451,10 @@ def compiled_evolve_packed_pallas(
         strip_fix = two_d and num_cols > 1
         if overlap:
             body = chunk2d_overlap if strip_fix else chunk_overlap
-        else:
+        elif tile >= halo_depth:
             body = chunk2d if strip_fix else chunk
+        else:
+            body = chunk2d_ext if strip_fix else chunk_ext
         if full:
             packed = lax.fori_loop(
                 0, full, lambda _, p: body(p, tile), packed

@@ -218,12 +218,29 @@ def save3d(path: str, vol: np.ndarray, generation: int, rule: str) -> str:
 
 
 def load3d(path: str) -> Snapshot3D:
-    """Read + fingerprint-verify a 3-D snapshot."""
-    with np.load(path) as data:
-        if "volume" not in data:
+    """Read + fingerprint-verify a 3-D snapshot.
+
+    Every malformation fails as :class:`CorruptSnapshotError` (a
+    ValueError), so the CLI's clean-error handling covers truncated
+    files and wrong-format archives too — not just bad fingerprints.
+    """
+    import zipfile
+
+    try:
+        data = np.load(path)
+    except (zipfile.BadZipFile, ValueError) as e:
+        raise CorruptSnapshotError(
+            f"{path}: not a readable snapshot archive ({e})"
+        ) from e
+    with data:
+        missing = {"volume", "generation", "rule", "fingerprint"} - set(
+            data.files
+        )
+        if missing:
             raise CorruptSnapshotError(
-                f"{path}: not a 3-D snapshot (no 'volume' array — a 2-D "
-                f"{CKPT_SUFFIX} checkpoint belongs to the 2-D driver)"
+                f"{path}: not a 3-D snapshot (missing "
+                f"{sorted(missing)}; a 2-D {CKPT_SUFFIX} checkpoint "
+                "belongs to the 2-D driver)"
             )
         vol = data["volume"].astype(np.uint8)
         stored = int(data["fingerprint"])

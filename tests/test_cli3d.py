@@ -196,3 +196,27 @@ def test_cli3d_resume_2d_checkpoint_rejected(tmp_path, capsys):
     rc = cli3d.main(["2", "32", "2", "64", "0", "--resume", path])
     assert rc == 255
     assert "not a 3-D snapshot" in capsys.readouterr().out
+
+
+def test_cli3d_resume_truncated_snapshot_fails_clean(tmp_path, capsys):
+    from gol_tpu import cli3d
+
+    bad = tmp_path / "trunc.gol3d.npz"
+    bad.write_bytes(b"PK\x03\x04 definitely not a real zip")
+    rc = cli3d.main(["2", "32", "2", "64", "0", "--resume", str(bad)])
+    assert rc == 255
+    assert "not a readable snapshot" in capsys.readouterr().out
+
+
+def test_cli3d_resume_missing_fingerprint_fails_clean(tmp_path, capsys):
+    import numpy as np_
+
+    from gol_tpu import cli3d
+
+    bad = tmp_path / "nofp.gol3d.npz"
+    np_.savez_compressed(
+        bad, volume=np_.zeros((32, 32, 32), np_.uint8)
+    )
+    rc = cli3d.main(["2", "32", "2", "64", "0", "--resume", str(bad)])
+    assert rc == 255
+    assert "missing" in capsys.readouterr().out

@@ -529,3 +529,29 @@ def test_runtime_sharded_pallas_2d_end_to_end():
             mesh=mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4]),
             halo_depth=40,
         )
+
+
+def test_small_tile_deep_band_takes_ext_fallback():
+    """tile < halo_depth must stay correct: the banded kernel's single-
+    descriptor halo segments can't span multiple neighbor tiles (the bug
+    the r2 review caught on real TPU), so the engine falls back to the
+    pre-extended kernel — pinned against the oracle here."""
+    from gol_tpu.parallel.sharded import place_private
+
+    board = oracle.random_board(128, 64, seed=91)
+    mesh = mesh_mod.make_mesh_1d(2)  # shard height 64
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(
+            mesh, 16, halo_depth=16, tile_hint=8
+        )(place_private(jnp.asarray(board), mesh))
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+
+
+def test_banded_kernel_rejects_small_tile():
+    from gol_tpu.ops import pallas_bitlife
+
+    blk = jnp.zeros((32, 4), jnp.int32)
+    bands = jnp.zeros((32, 4), jnp.int32)  # k = 16
+    with pytest.raises(ValueError, match="tile .8. >= band depth"):
+        pallas_bitlife.multi_step_pallas_packed_bands(blk, bands, 8, 16)
