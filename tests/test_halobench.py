@@ -82,3 +82,13 @@ def test_measure_rejects_unknown_engine():
 
     with pytest.raises(ValueError, match="unknown engine"):
         halobench.measure(mesh_mod.make_mesh_1d(), 64, 2, engine="warp")
+
+
+def test_measure_pallas_engine_2d_mesh():
+    """The flagship engine attributes on a 2-D block mesh too (strip
+    repair + corner-word path under the measurement harness)."""
+    import jax
+
+    mesh = mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+    out = halobench.measure(mesh, 128, steps=8, engine="pallas")
+    assert out["step_s"] > 0 and out["exposed_exchange_s"] >= 0
