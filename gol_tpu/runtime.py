@@ -98,15 +98,6 @@ class GolRuntime:
 
             parsed = rules_mod.parse_rulestring(self.rule)
             if parsed != rules_mod.CONWAY:
-                # B3/S23 stays on the hard-wired fast paths; other rules
-                # run the generic evaluators (fresh halos; sharded via the
-                # explicit ring engine).
-                if self.mesh is not None and self.shard_mode != "explicit":
-                    raise ValueError(
-                        "custom rules shard via the explicit ring engine "
-                        f"only; shard_mode {self.shard_mode!r} is a "
-                        "Conway-specific program"
-                    )
                 if self.halo_mode != "fresh":
                     raise ValueError(
                         "custom rules have no stale_t0 reference-compat mode "
@@ -122,6 +113,24 @@ class GolRuntime:
         self._resolved = (
             self._resolve_auto() if self.engine == "auto" else self.engine
         )
+        if self._rule is not None and self.mesh is not None:
+            # B3/S23 stays on the hard-wired fast paths; other rules run
+            # the generic evaluators — sharded via the explicit ring
+            # engine, or the sharded Pallas engine's overlap form (its
+            # kernel carries the generic rule tail).  Checked against the
+            # *resolved* engine so 'auto' runs that resolve to the Pallas
+            # engine get the same allowance as an explicit choice.
+            if self.shard_mode != "explicit" and not (
+                self.shard_mode == "overlap"
+                and self._resolved == "pallas_bitpack"
+            ):
+                raise ValueError(
+                    "custom rules shard via the explicit ring engine (any "
+                    "engine) or the sharded Pallas engine's overlap form "
+                    f"(engine 'pallas_bitpack'); shard_mode "
+                    f"{self.shard_mode!r} with engine {self._resolved!r} "
+                    "is a Conway-specific program"
+                )
         if self.halo_depth > 1:
             if self.mesh is None:
                 raise ValueError(

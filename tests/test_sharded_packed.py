@@ -555,3 +555,37 @@ def test_banded_kernel_rejects_small_tile():
     bands = jnp.zeros((32, 4), jnp.int32)  # k = 16
     with pytest.raises(ValueError, match="tile .8. >= band depth"):
         pallas_bitlife.multi_step_pallas_packed_bands(blk, bands, 8, 16)
+
+
+def test_runtime_custom_rule_overlap_flagship():
+    """Custom rules ride the flagship overlap form through the runtime
+    (the kernel's generic tail works under the interior/boundary split)."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.ops import rules
+    from gol_tpu.runtime import GolRuntime
+
+    geom = Geometry(size=32, num_ranks=4)  # 128x32, shard height 32
+    rt = GolRuntime(
+        geometry=geom,
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="overlap",
+        rule="B36/S23",
+    )
+    _, state = rt.run(pattern=4, iterations=9)
+    from gol_tpu.models import patterns
+
+    board0 = patterns.init_global(4, 32, 4)
+    ref = np.asarray(
+        rules.run_rule(jnp.asarray(board0), 9, rules.HIGHLIFE)
+    )
+    np.testing.assert_array_equal(np.asarray(state.board), ref)
+    # Other engines still reject the combination.
+    with pytest.raises(ValueError, match="Conway-specific"):
+        GolRuntime(
+            geometry=geom,
+            engine="bitpack",
+            mesh=mesh_mod.make_mesh_1d(4),
+            shard_mode="overlap",
+            rule="B36/S23",
+        )
