@@ -340,6 +340,67 @@ _WORKER_PALLAS = textwrap.dedent(
     """
 )
 
+# Every round-2 subsystem composed in one job: the flagship fused-Pallas
+# engine sharded across 2 OS processes, the cross-engine redundancy audit
+# (checker = dense, compiled multi-process in lockstep), sharded
+# checkpoints (per-host pieces), and a cross-process sharded resume of the
+# remaining generations.  Shard height 64 >= 2*8+8 also permits overlap,
+# but the guard path is the one under test here.
+_WORKER_KITCHEN_SINK = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import cli
+    from gol_tpu.utils import checkpoint as ckpt_mod
+    pid = sys.argv[1]
+    rc = cli.main([
+        "4", "64", "8", "16", "0",
+        "--ranks", "4", "--mesh", "1d", "--engine", "pallas_bitpack",
+        "--coordinator", sys.argv[2],
+        "--num-processes", "2", "--process-id", pid,
+        "--guard-every", "4", "--guard-redundant",
+        "--checkpoint-every", "4", "--checkpoint-dir", sys.argv[3],
+    ])
+    if rc == 0:
+        rc = cli.main([
+            "4", "64", "8", "16", "1",
+            "--ranks", "4", "--mesh", "1d", "--engine", "pallas_bitpack",
+            "--guard-every", "4", "--guard-redundant",
+            "--outdir", sys.argv[4],
+            "--resume", ckpt_mod.sharded_checkpoint_path(sys.argv[3], 8),
+        ])
+    sys.exit(rc)
+    """
+)
+
+
+def test_two_process_kitchen_sink(tmp_path):
+    """Flagship engine + redundant guard + sharded checkpoint + sharded
+    resume, all in one 2-process job; final dumps byte-match the
+    straight single-process run of the same 16 generations."""
+    ck = tmp_path / "ck"
+    out_mh = tmp_path / "mh"
+    out_sp = tmp_path / "sp"
+    out_mh.mkdir()
+
+    outs = _run_two_workers(_WORKER_KITCHEN_SINK, [str(ck), str(out_mh)])
+    assert "GUARD          : 2 checks, 0 failures, 0 restores" in outs[0][1]
+
+    from gol_tpu import cli
+
+    assert (
+        cli.main(
+            ["4", "64", "16", "16", "1", "--ranks", "4",
+             "--outdir", str(out_sp)]
+        )
+        == 0
+    )
+    for r in range(4):
+        name = gol_io.rank_filename(r, 4)
+        assert (out_mh / name).read_bytes() == (out_sp / name).read_bytes()
+
 
 def test_two_process_flagship_pallas_engine(tmp_path):
     out_mh = tmp_path / "mh"
