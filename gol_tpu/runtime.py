@@ -55,6 +55,11 @@ def chunk_schedule(iterations: int, chunk: int) -> list:
     guard cadence in :class:`GolRuntime`, the 3-D driver's checkpointing)
     — shared so tail handling cannot drift between drivers.
     """
+    if iterations > 0 and chunk < 1:
+        raise ValueError(
+            f"chunk must be >= 1 when iterations > 0 (got chunk={chunk}, "
+            f"iterations={iterations})"
+        )
     chunk = min(chunk, iterations) if iterations else 0
     schedule = []
     remaining = iterations

@@ -406,14 +406,38 @@ def load_sharded_meta(dirpath: str) -> ShardedMeta:
             ),
         )
     h, w = meta.shape
-    area = int(
-        sum((r1 - r0) * (c1 - c0) for r0, r1, c0, c1 in meta.rects)
-    )
+    area = 0
+    rects = []
+    for r0, r1, c0, c1 in meta.rects:
+        r0, r1, c0, c1 = int(r0), int(r1), int(c0), int(c1)
+        if not (0 <= r0 < r1 <= h and 0 <= c0 < c1 <= w):
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece rect ({r0},{r1},{c0},{c1}) falls outside "
+                f"the {h}x{w} board; the manifest is corrupt"
+            )
+        area += (r1 - r0) * (c1 - c0)
+        rects.append((r0, r1, c0, c1))
     if area != h * w:
         raise CorruptSnapshotError(
             f"{dirpath}: piece table covers {area} cells of {h * w}; the "
             "manifest is corrupt or incomplete"
         )
+    # In-bounds + exact total area only proves a tiling if the rects are
+    # also pairwise disjoint; overlapping rects that happen to sum to h*w
+    # would otherwise let read_sharded_region double-count coverage and
+    # return np.empty garbage in the genuinely uncovered cells.  Piece
+    # counts are O(hosts), so the quadratic check is cheap.
+    rects.sort()
+    for i, (r0, r1, c0, c1) in enumerate(rects):
+        for q0, q1, s0, s1 in rects[i + 1 :]:
+            if q0 >= r1:
+                break  # sorted by r0: no later rect can overlap rows
+            # rows overlap (r0 <= q0 < r1); overlap iff columns intersect
+            if s1 > c0 and s0 < c1:
+                raise CorruptSnapshotError(
+                    f"{dirpath}: piece rects ({r0},{r1},{c0},{c1}) and "
+                    f"({q0},{q1},{s0},{s1}) overlap; the manifest is corrupt"
+                )
     if meta.fingerprint is not None:
         total = np.uint32(0)
         with np.errstate(over="ignore"):

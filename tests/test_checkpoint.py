@@ -266,3 +266,58 @@ def test_latest_skips_torn_sharded_dirs(tmp_path):
     ckpt.save_sharded(torn, arr, 60, 1)
     os.remove(os.path.join(torn, "shards_00000.npz"))
     assert ckpt.latest(str(tmp_path)) == good
+
+def test_sharded_overlapping_manifest_rejected(tmp_path):
+    """Overlapping rects whose areas still sum to h*w must be rejected at
+    load — otherwise read_sharded_region double-counts the overlap and can
+    report a region complete while leaving uncovered cells as np.empty
+    garbage (ADVICE r2)."""
+    import os
+
+    _, arr, _ = _sharded_board(seed=9)
+    d = ckpt.sharded_checkpoint_path(str(tmp_path), 3)
+    ckpt.save_sharded(d, arr, 3, num_ranks=4)
+    mpath = os.path.join(d, "manifest.npz")
+    with np.load(mpath) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    h, w = (int(x) for x in arrays["shape"])
+    # Two half-board rects shifted to overlap: total area == h*w but the
+    # right quarter of the board is uncovered.
+    arrays["rects"] = np.asarray(
+        [(0, h, 0, w // 2), (0, h, w // 4, 3 * w // 4)], np.int64
+    )
+    arrays["procs"] = np.asarray([0, 0], np.int64)
+    np.savez_compressed(mpath, **arrays)
+    with pytest.raises(ckpt.CorruptSnapshotError, match="overlap"):
+        ckpt.load_sharded_meta(d)
+
+
+def test_sharded_out_of_bounds_manifest_rejected(tmp_path):
+    import os
+
+    _, arr, _ = _sharded_board(seed=10)
+    d = ckpt.sharded_checkpoint_path(str(tmp_path), 3)
+    ckpt.save_sharded(d, arr, 3, num_ranks=4)
+    mpath = os.path.join(d, "manifest.npz")
+    with np.load(mpath) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    h, w = (int(x) for x in arrays["shape"])
+    arrays["rects"] = np.asarray(
+        [(0, h, 0, w), (h, h + 1, 0, w)], np.int64
+    )
+    arrays["procs"] = np.asarray([0, 0], np.int64)
+    np.savez_compressed(mpath, **arrays)
+    with pytest.raises(ckpt.CorruptSnapshotError, match="outside"):
+        ckpt.load_sharded_meta(d)
+
+
+def test_chunk_schedule_rejects_zero_chunk():
+    """chunk_schedule is shared public policy; chunk=0 with work to do must
+    error, not hang (ADVICE r2)."""
+    from gol_tpu.runtime import chunk_schedule
+
+    with pytest.raises(ValueError, match="chunk"):
+        chunk_schedule(10, 0)
+    assert chunk_schedule(0, 0) == []
+    assert chunk_schedule(10, 4) == [4, 4, 2]
+    assert chunk_schedule(3, 100) == [3]
