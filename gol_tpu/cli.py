@@ -139,6 +139,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 255
 
+    if ns.on_off == 1:
+        # Reference lifecycle (gol-main.c:64-73): every rank's dump file is
+        # fopen'd "w" right after MPI_Init, BEFORE world init/validation —
+        # files exist (truncated) from startup even if the run later dies,
+        # and open failure prints the exact "ERROR IN RANK %d" diagnostic.
+        from gol_tpu.utils import io as gol_io
+
+        try:
+            if topo.process_count > 1:
+                try:
+                    multihost.precreate_host_dump_files(
+                        build_mesh(ns.mesh),
+                        (ns.world_size * ns.ranks, ns.world_size),
+                        ns.ranks,
+                        ns.outdir,
+                    )
+                except ValueError:
+                    pass  # invalid geometry/mesh: validation below reports it
+            else:
+                gol_io.create_rank_files(
+                    range(max(ns.ranks, 0)), ns.ranks, ns.outdir
+                )
+        except gol_io.RankFileError as e:
+            sys.stdout.write(f"ERROR IN RANK {e.rank}")
+            return 255  # exit(-1) in the reference (gol-main.c:70)
+
     try:
         geom = Geometry(size=ns.world_size, num_ranks=ns.ranks)
         patterns.validate_pattern_size(ns.pattern, ns.world_size)

@@ -159,6 +159,8 @@ def plan_rank_writers(
     covers alone (they need a collective gather).
     """
     h, w = shape
+    if num_ranks < 1:
+        raise ValueError(f"num_ranks must be positive, got {num_ranks}")
     if h % num_ranks:
         raise ValueError(f"global height {h} not divisible by {num_ranks} ranks")
     s = h // num_ranks
@@ -218,6 +220,32 @@ def fetch_global(arr) -> np.ndarray:
     out = NamedSharding(sharding.mesh, PartitionSpec())
     replicated = jax.jit(lambda x: x, out_shardings=out)(arr)
     return np.asarray(replicated.addressable_shards[0].data)
+
+
+def precreate_host_dump_files(
+    mesh, shape: Tuple[int, int], num_ranks: int, directory: str = "."
+) -> List[str]:
+    """Create (truncating) at startup the dump files this process will write.
+
+    The reference opens every rank's file right after ``MPI_Init``, before
+    world init (gol-main.c:64-73).  With sharded output the writer plan is
+    known deterministically from the prospective board sharding, so each
+    process pre-creates exactly the files :func:`write_host_dumps` will
+    later fill (process 0 additionally owns any gathered ranks).  Raises
+    :class:`gol_tpu.utils.io.RankFileError` on open failure, like the
+    single-process path.
+    """
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    writers, gather_ranks = plan_rank_writers(
+        mesh_mod.board_sharding(mesh), shape, num_ranks
+    )
+    me = jax.process_index()
+    ranks = sorted(
+        [r for r, p in writers.items() if p == me]
+        + (gather_ranks if me == 0 else [])
+    )
+    return gol_io.create_rank_files(ranks, num_ranks, directory)
 
 
 def write_host_dumps(

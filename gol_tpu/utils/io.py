@@ -37,6 +37,48 @@ def rank_filename(rank: int, num_ranks: int) -> str:
     return RANK_FILE_TEMPLATE.format(rank=rank, num_ranks=num_ranks)
 
 
+class RankFileError(OSError):
+    """A rank's dump file could not be created at startup.
+
+    Carries the failing logical rank so the driver can print the
+    reference's exact diagnostic ``printf("ERROR IN RANK %d", myRank)``
+    (gol-main.c:68-71).
+    """
+
+    def __init__(self, rank: int, cause: OSError):
+        super().__init__(f"ERROR IN RANK {rank}")
+        self.rank = rank
+        self.cause = cause
+
+
+def create_rank_files(ranks, num_ranks: int, directory: str = ".") -> list:
+    """Create (truncating) each rank's dump file at startup.
+
+    The reference ``fopen(..., "w")``s every rank's ``Rank_<r>_of_<n>.txt``
+    right after ``MPI_Init``, *before* world initialization
+    (gol-main.c:64-73) — so with output enabled a (possibly empty) file
+    exists even if the run later dies, and a pre-existing dump from an
+    earlier run is truncated the moment the new run starts.  Raises
+    :class:`RankFileError` naming the first rank whose open failed.
+    """
+    ranks = list(ranks)
+    paths = []
+    if not ranks:
+        return paths
+    try:
+        os.makedirs(directory, exist_ok=True)
+    except OSError as e:
+        raise RankFileError(ranks[0], e)
+    for rank in ranks:
+        path = os.path.join(directory, rank_filename(rank, num_ranks))
+        try:
+            open(path, "wb").close()
+        except OSError as e:
+            raise RankFileError(rank, e)
+        paths.append(path)
+    return paths
+
+
 def _format_rows_fast(block: np.ndarray, row0: int) -> bytes:
     """Vectorized renderer for the common case: all cells are single digit.
 

@@ -121,3 +121,42 @@ def test_module_entrypoint_runs():
     )
     assert proc.returncode == 0, proc.stderr
     assert "TOTAL DURATION : " in proc.stdout
+
+def test_rank_files_created_at_startup_before_validation(capsys, tmp_path):
+    """Reference lifecycle (gol-main.c:64-73): with on_off=1 the rank files
+    are fopen'd "w" right after process init, BEFORE world validation — so
+    a run that dies on an unknown pattern still leaves (empty) files, and a
+    stale dump from an earlier run is truncated at startup."""
+    stale = tmp_path / "Rank_0_of_1.txt"
+    stale.write_bytes(b"stale dump from an earlier run\n")
+    rc = run_cli(["9", "32", "1", "64", "1"], tmp_path)  # unknown pattern
+    assert rc == 255
+    assert "not been implemented" in capsys.readouterr().out
+    assert stale.exists() and stale.read_bytes() == b""  # created+truncated
+
+
+def test_rank_file_open_failure_prints_reference_error(capsys, tmp_path):
+    """fopen failure prints exactly `ERROR IN RANK %d` (no newline) and
+    exits -1 (gol-main.c:68-71).  Induced by squatting a directory on the
+    rank-1 filename (root ignores permission bits, so chmod won't do)."""
+    os.makedirs(tmp_path / "Rank_1_of_2.txt")
+    rc = run_cli(["4", "8", "2", "64", "1", "--ranks", "2"], tmp_path)
+    assert rc == 255
+    assert capsys.readouterr().out == "ERROR IN RANK 1"
+
+
+def test_rank_file_outdir_failure_names_rank_zero(capsys, tmp_path):
+    squat = tmp_path / "not_a_dir"
+    squat.write_bytes(b"")
+    rc = cli.main(["4", "8", "2", "64", "1", "--outdir", str(squat)])
+    assert rc == 255
+    assert capsys.readouterr().out == "ERROR IN RANK 0"
+
+
+def test_rank_files_precreated_then_filled(capsys, tmp_path):
+    """A successful run's startup-created files end up with the dump."""
+    rc = run_cli(["4", "8", "2", "64", "1", "--ranks", "2"], tmp_path)
+    assert rc == 0
+    for r in range(2):
+        data = (tmp_path / f"Rank_{r}_of_2.txt").read_bytes()
+        assert data.startswith(b"#")

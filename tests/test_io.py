@@ -74,3 +74,19 @@ def test_fast_and_generic_renderers_agree():
 def test_indivisible_ranks_rejected():
     with pytest.raises(ValueError, match="divisible"):
         gol_io.write_world_dumps(np.zeros((10, 4), np.uint8), num_ranks=3)
+
+def test_precreate_host_dump_files_single_process(tmp_path):
+    """Writer-planned startup creation: single process owns every rank."""
+    import os
+
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import multihost
+
+    mesh = mesh_mod.make_mesh_1d(4)
+    paths = multihost.precreate_host_dump_files(
+        mesh, (32, 8), 4, str(tmp_path)
+    )
+    assert [os.path.basename(p) for p in paths] == [
+        f"Rank_{r}_of_4.txt" for r in range(4)
+    ]
+    assert all(os.path.getsize(p) == 0 for p in paths)
