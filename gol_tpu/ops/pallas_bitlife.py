@@ -40,6 +40,7 @@ wait must reconstruct the start's descriptors identically).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -68,12 +69,28 @@ def pick_tile(height: int, packed_width: int, hint: int) -> int:
     return _pick(height, packed_width, hint, _ALIGN, _BYTES_PER_ROW)
 
 
+def fold_factor(packed_width: int) -> int:
+    """Smallest row-fold ``f`` making ``f * packed_width`` fill whole
+    128-lane tiles.
+
+    1 when the width already fills them.  A shard too narrow for the lane
+    tiling (BASELINE config 3 on a 16×16 mesh: 1024-cell = 32-word shards)
+    is evolved in a folded ``[h/f, f*nw]`` layout — ``f`` row groups side
+    by side in lanes — by :func:`gol_tpu.parallel.packed.
+    compiled_evolve_packed_pallas`; the kernel's group-local rolls
+    (``_one_generation(groups=f)``) keep the fold exact, so only
+    column-*sharded* meshes need their usual edge repair (folded to one
+    column pair per group).
+    """
+    return _LANE // math.gcd(packed_width, _LANE)
+
+
 def _lsr(x: jax.Array, r: int) -> jax.Array:
     """Logical shift right on int32 lanes (mask off the sign extension)."""
     return (x >> r) & jnp.int32((1 << (32 - r)) - 1)
 
 
-def _one_generation(ext: jax.Array, rule=None) -> jax.Array:
+def _one_generation(ext: jax.Array, rule=None, groups: int = 1) -> jax.Array:
     """One packed generation over an extended row window (shrinks by 2 rows).
 
     Per-row 3-cell horizontal sums once per extended row (bit planes),
@@ -82,10 +99,37 @@ def _one_generation(ext: jax.Array, rule=None) -> jax.Array:
     two ops cheaper); a ``Rule2D`` runs the generic plane matcher on the
     count-of-9 with the +1 survive identity (see
     :func:`gol_tpu.ops.rules.step_rule_packed`).
+
+    ``groups > 1`` is the lane-folded narrow-shard layout (``groups`` row
+    groups side by side in lanes, :func:`gol_tpu.parallel.packed.
+    fold_rows`): the word ring becomes **group-local** — each group's edge
+    word takes its carry from its *own* group's opposite edge (two masked
+    rolls), so the fold introduces no seam wrongness at all and the
+    row-sharded engine needs no repair.  Cost: 2 extra rolls + 2 selects
+    per extended row per generation (~18% on the ~22-op tree).
     """
     nw = ext.shape[1]
-    prev_word = pltpu.roll(ext, 1, axis=1)
-    next_word = pltpu.roll(ext, nw - 1, axis=1)  # roll by -1
+    if groups == 1:
+        prev_word = pltpu.roll(ext, 1, axis=1)
+        next_word = pltpu.roll(ext, nw - 1, axis=1)  # roll by -1
+    else:
+        gw = nw // groups
+        # Masks via in-kernel iota (pallas_call forbids captured
+        # constants); Mosaic CSEs the repeats across the unrolled k loop.
+        lane = lax.rem(lax.broadcasted_iota(jnp.int32, (1, nw), 1), gw)
+        first = lane == 0
+        last = lane == gw - 1
+        prev_word = jnp.where(
+            first,
+            # group-local wrap: lane g*gw reads its own group's last word
+            pltpu.roll(ext, (nw - gw + 1) % nw, axis=1),
+            pltpu.roll(ext, 1, axis=1),
+        )
+        next_word = jnp.where(
+            last,
+            pltpu.roll(ext, gw - 1, axis=1),
+            pltpu.roll(ext, nw - 1, axis=1),
+        )
     west = (ext << 1) | _lsr(prev_word, 31)
     east = _lsr(ext, 1) | (next_word << 31)
     s0, s1 = bitlife._full_add(west, ext, east)
@@ -182,7 +226,7 @@ def step_pallas_packed(packed_i32: jax.Array, tile: int) -> jax.Array:
     return multi_step_pallas_packed(packed_i32, tile, 1)
 
 
-def _kernel_ext(*refs, tile: int, k: int, rule=None):
+def _kernel_ext(*refs, tile: int, k: int, rule=None, groups: int = 1):
     """k generations of one tile of a halo-extended (no-wrap) board.
 
     The input already carries k ghost rows on each side (a sharded
@@ -222,11 +266,14 @@ def _kernel_ext(*refs, tile: int, k: int, rule=None):
         )
 
     load_window_double_buffered(copies, i, i + 1, slot, i == 0, i + 1 < nt)
-    _evolve_window_and_store(scratch, slot, out_ref, edges_ref, tile, k, rule)
+    _evolve_window_and_store(
+        scratch, slot, out_ref, edges_ref, tile, k, rule, groups
+    )
 
 
 def multi_step_pallas_packed_ext(
-    ext_i32: jax.Array, tile: int, k: int, rule=None, edges_i32=None
+    ext_i32: jax.Array, tile: int, k: int, rule=None, edges_i32=None,
+    groups: int = 1,
 ) -> jax.Array:
     """k fused generations on a k-deep row-halo-extended packed board.
 
@@ -251,13 +298,19 @@ def multi_step_pallas_packed_ext(
     validate_tile(height, tile, _ALIGN)
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
     operands = [ext_i32]
+    _validate_groups(groups, nw)
     if edges_i32 is not None:
+        _validate_edges(edges_i32, height, nw, groups)
         in_specs.append(
-            pl.BlockSpec((tile, 2), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec(
+                (tile, edges_i32.shape[1]),
+                lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            )
         )
         operands.append(edges_i32)
     return pl.pallas_call(
-        functools.partial(_kernel_ext, tile=tile, k=k, rule=rule),
+        functools.partial(_kernel_ext, tile=tile, k=k, rule=rule, groups=groups),
         grid=(height // tile,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
@@ -275,7 +328,8 @@ def multi_step_pallas_packed_ext(
 
 
 def _evolve_window_and_store(
-    scratch, slot, out_ref, edges_ref, tile: int, k: int, rule
+    scratch, slot, out_ref, edges_ref, tile: int, k: int, rule,
+    groups: int = 1,
 ):
     """The ext kernels' shared compute tail: k in-place generations over
     the slot's window (shrinking one row per side per step), body store,
@@ -285,16 +339,48 @@ def _evolve_window_and_store(
         a = j
         b = tile + 2 * k - j
         scratch[slot, a + 1 : b - 1] = _one_generation(
-            scratch[slot, a:b], rule
+            scratch[slot, a:b], rule, groups
         )
     out_ref[:] = scratch[slot, k : k + tile]
     if edges_ref is not None:
+        # One (left, right) exact column pair per lane-fold row group —
+        # [tile, 2] unfolded, [tile, 2f] folded (group g's pair at columns
+        # 2g, 2g+1; its words at lanes g*gw and (g+1)*gw - 1).
         nw = out_ref.shape[1]
-        out_ref[:, 0:1] = edges_ref[:, 0:1]
-        out_ref[:, nw - 1 : nw] = edges_ref[:, 1:2]
+        groups = edges_ref.shape[1] // 2
+        gw = nw // groups
+        for g in range(groups):
+            out_ref[:, g * gw : g * gw + 1] = edges_ref[:, 2 * g : 2 * g + 1]
+            out_ref[:, (g + 1) * gw - 1 : (g + 1) * gw] = edges_ref[
+                :, 2 * g + 1 : 2 * g + 2
+            ]
 
 
-def _kernel_ext_bands(*refs, tile: int, k: int, rule=None):
+def _validate_groups(groups: int, nw: int) -> None:
+    if groups < 1 or nw % groups:
+        raise ValueError(
+            f"groups ({groups}) must be >= 1 and divide the packed "
+            f"width {nw}"
+        )
+
+
+def _validate_edges(edges, height: int, nw: int, groups: int) -> None:
+    """Edges operand contract shared by the ext and banded kernels: one
+    (left, right) exact column pair per row group, >= 2 words per group
+    (so the two stores never collide)."""
+    if edges.shape != (height, 2 * groups):
+        raise ValueError(
+            f"edges must be [height, 2*groups] = {(height, 2 * groups)}, "
+            f"got {edges.shape}"
+        )
+    if nw // groups < 2:
+        raise ValueError(
+            f"edge repair needs >= 2 packed words per row group, got "
+            f"{nw // groups}"
+        )
+
+
+def _kernel_ext_bands(*refs, tile: int, k: int, rule=None, groups: int = 1):
     """k generations of one tile, ghost band as a separate operand.
 
     Same compute as :func:`_kernel_ext`, but the k-row ghost bands arrive
@@ -396,7 +482,9 @@ def _kernel_ext_bands(*refs, tile: int, k: int, rule=None):
         start_all(i + 1, 1 - slot)
 
     wait_all(i, slot)
-    _evolve_window_and_store(scratch, slot, out_ref, edges_ref, tile, k, rule)
+    _evolve_window_and_store(
+        scratch, slot, out_ref, edges_ref, tile, k, rule, groups
+    )
 
 
 def multi_step_pallas_packed_bands(
@@ -406,6 +494,7 @@ def multi_step_pallas_packed_bands(
     k: int,
     rule=None,
     edges_i32=None,
+    groups: int = 1,
 ) -> jax.Array:
     """k fused generations of a packed block with a separate ghost band.
 
@@ -450,13 +539,21 @@ def multi_step_pallas_packed_bands(
         pl.BlockSpec(memory_space=pl.ANY),
     ]
     operands = [blk_i32, bands_i32]
+    _validate_groups(groups, nw)
     if edges_i32 is not None:
+        _validate_edges(edges_i32, height, nw, groups)
         in_specs.append(
-            pl.BlockSpec((tile, 2), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec(
+                (tile, edges_i32.shape[1]),
+                lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            )
         )
         operands.append(edges_i32)
     return pl.pallas_call(
-        functools.partial(_kernel_ext_bands, tile=tile, k=k, rule=rule),
+        functools.partial(
+            _kernel_ext_bands, tile=tile, k=k, rule=rule, groups=groups
+        ),
         grid=(height // tile,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(

@@ -306,8 +306,20 @@ class GolRuntime:
                 shard_w = self.geometry.global_width // cols
                 depth = 8 if self.halo_depth == 1 else self.halo_depth
                 min_h = 2 * depth + 8 if overlap else depth
+                words = shard_w // bitlife.BITS
+                fold = pallas_bitlife.fold_factor(words)
+                # Narrow shards run lane-folded (explicit mode only): f
+                # row groups side by side in lanes, exact via the
+                # kernel's group-local rolls — so BASELINE config 3's
+                # 16x16-mesh 32-word shards resolve here too.  Sharded
+                # columns additionally need >= 2 words for edge strips.
+                fold_ok = fold == 1 or (
+                    not overlap
+                    and shard_h % (fold * pallas_bitlife._ALIGN) == 0
+                    and (cols <= 1 or words >= 2)
+                )
                 if (
-                    shard_w % (pallas_bitlife._LANE * bitlife.BITS) == 0
+                    fold_ok
                     and shard_h % pallas_bitlife._ALIGN == 0
                     and shard_h >= min_h
                     and (not two_d or depth <= bitlife.BITS)
