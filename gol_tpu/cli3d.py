@@ -81,6 +81,25 @@ def init_volume(pattern: int, size: int) -> np.ndarray:
     raise ValueError(f"Pattern {pattern} has not been implemented")
 
 
+def _pallas3d_sharded_fits(mesh, size: int) -> bool:
+    """Whether the fused sharded 3-D kernel supports this mesh/geometry —
+    mirrors :func:`gol_tpu.parallel.sharded3d.compiled_evolve3d_pallas`'s
+    constraints, for ``auto`` resolution (an explicit ``--engine pallas``
+    raises the real errors instead)."""
+    from gol_tpu.ops import bitlife, pallas_bitlife3d
+    from gol_tpu.parallel.mesh import COLS, PLANES, ROWS
+
+    if mesh.shape.get(ROWS, 1) != 1 or size % 128:
+        return False
+    d = size // mesh.shape.get(PLANES, 1)
+    nw = size // mesh.shape.get(COLS, 1) // bitlife.BITS
+    return (
+        d >= 8
+        and nw >= 1
+        and pallas_bitlife3d.pick_tile3d_wt(d, nw, size, 8) is not None
+    )
+
+
 def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
     """(compiled, place) for the chosen engine/mesh.
 
@@ -95,8 +114,6 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
     if mesh is not None:
         from gol_tpu.parallel import sharded3d
 
-        if engine == "pallas":
-            raise ValueError("engine 'pallas' is single-device; drop --mesh")
         packable = True
         try:
             sharded3d.validate_geometry3d_packed(spec_shape, mesh)
@@ -108,7 +125,24 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
                 f"whole 32-cell words (size {size} over mesh "
                 f"{dict(mesh.shape)})"
             )
-        if packable and engine in ("auto", "bitpack"):
+        if engine == "pallas" or (
+            engine == "auto"
+            and packable
+            and jax.default_backend() == "tpu"
+            and _pallas3d_sharded_fits(mesh, size)
+        ):
+            # The fused word-tiled kernel per shard behind the two-phase
+            # ring exchange; an explicit --engine pallas surfaces its
+            # geometry constraints (H-unsharded mesh etc.) as clean
+            # errors rather than silently substituting a slower tier.
+            if not packable:
+                raise ValueError(
+                    "engine 'pallas' needs the x-shard width to pack "
+                    f"into whole 32-cell words (size {size} over mesh "
+                    f"{dict(mesh.shape)})"
+                )
+            fn = sharded3d.compiled_evolve3d_pallas(mesh, steps, rule)
+        elif packable and engine in ("auto", "bitpack"):
             fn = sharded3d.compiled_evolve3d_packed(mesh, steps, rule)
         else:
             sharded3d.validate_geometry3d(spec_shape, mesh)
@@ -160,6 +194,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--rule", default="bays4555")
     ext.add_argument("--engine", choices=ENGINES3D, default="auto")
     ext.add_argument("--mesh", choices=["none", "3d"], default="none")
+    # Explicit (planes, rows, cols) factorization: the fused sharded
+    # kernel needs rows == 1 (H unsharded), which the default most-cubic
+    # factorization of 8 devices (2,2,2) is not.
+    ext.add_argument("--mesh-shape", default=None, metavar="P,R,C")
     ext.add_argument("--outdir", default=".")
     # Checkpoint/resume, mirroring the 2-D driver: periodic
     # fingerprint-stamped volume snapshots, verified + rule-checked on
@@ -217,7 +255,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if ns.mesh == "3d":
             from gol_tpu.parallel import mesh as mesh_mod
 
-            mesh = mesh_mod.make_mesh_3d()
+            shape3 = None
+            if ns.mesh_shape:
+                parts = ns.mesh_shape.split(",")
+                if len(parts) != 3 or not all(
+                    p.strip().isdigit() for p in parts
+                ):
+                    raise ValueError(
+                        f"--mesh-shape must be P,R,C integers, got "
+                        f"{ns.mesh_shape!r}"
+                    )
+                shape3 = tuple(int(p) for p in parts)
+            mesh = mesh_mod.make_mesh_3d(shape3)
+        elif ns.mesh_shape:
+            raise ValueError("--mesh-shape requires --mesh 3d")
 
         from gol_tpu.utils.timing import Stopwatch, force_ready
 

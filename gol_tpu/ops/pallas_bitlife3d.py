@@ -285,6 +285,47 @@ def multi_step_pallas_packed3d_wt(
     ext = jnp.concatenate(
         [ext[:, -pad:], ext, ext[:, :pad]], axis=1
     )  # [nw+2, D+2*pad, H]
+    return multi_step_pallas_packed3d_wt_ext(ext, tile_d, tile_w, k, rule)
+
+
+def multi_step_pallas_packed3d_wt_ext(
+    ext: jax.Array,
+    tile_d: int,
+    tile_w: int,
+    k: int,
+    rule: Rule3D = BAYS_4555,
+) -> jax.Array:
+    """Word-tiled kernel on a pre-extended volume ``[nw+2, D+2*pad, H]``.
+
+    The extension's source is the caller's business: the single-device
+    wrapper (:func:`multi_step_pallas_packed3d_wt`) concats torus wraps;
+    the sharded engine (:func:`gol_tpu.parallel.sharded3d.
+    compiled_evolve3d_pallas`) concats ``lax.ppermute`` ring ghosts —
+    ghost word columns (x, one word per side: the 32-bit light cone
+    covers k <= 32) and a ``pad``-plane band (d), with the word columns
+    sliced from the already plane-extended array so the x/d corner data
+    rides the second hop, exactly like the 2-D engine's two-phase
+    exchange.  ``pad`` is inferred from the extension: ``(ext.shape[1] -
+    D) / 2`` must equal ``ceil(k/8)*8``.
+    """
+    nw = ext.shape[0] - 2
+    h = ext.shape[2]
+    pad = -(-k // _ALIGN) * _ALIGN
+    depth = ext.shape[1] - 2 * pad
+    validate_tile(depth, tile_d, _ALIGN)
+    if nw % tile_w:
+        raise ValueError(
+            f"word tile {tile_w} must divide the packed width {nw}"
+        )
+    if k < 1 or k > bitlife.BITS:
+        raise ValueError(
+            f"word-tiled kernel supports 1 <= k <= {bitlife.BITS}, got {k}"
+        )
+    if pad > tile_d:
+        raise ValueError(
+            f"temporal block depth {k} needs halo pad {pad} <= plane tile "
+            f"{tile_d}"
+        )
     return pl.pallas_call(
         functools.partial(
             _kernel_wt,
@@ -301,11 +342,11 @@ def multi_step_pallas_packed3d_wt(
             (tile_w, tile_d, h), lambda j, i: (j, i, 0),
             memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct(packed_w.shape, packed_w.dtype),
+        out_shape=jax.ShapeDtypeStruct((nw, depth, h), ext.dtype),
         scratch_shapes=[
             # Two slots for the cross-grid-step prefetch (see _kernel_wt).
             pltpu.VMEM(
-                (2, tile_w + 2, tile_d + 2 * pad, h), packed_w.dtype
+                (2, tile_w + 2, tile_d + 2 * pad, h), ext.dtype
             ),
             pltpu.SemaphoreType.DMA((2,)),
         ],

@@ -566,7 +566,7 @@ def compiled_evolve_packed_pallas(
                 f"to be a multiple of 8 and >= the exchanged band depth "
                 f"{halo_depth}"
             )
-        if two_d and num_cols > 1 and w // bitlife.BITS < 2:
+        if two_d and num_cols > 1 and nw < 2:
             raise ValueError(
                 f"the 2-D sharded Pallas engine needs >= 2 packed words "
                 f"per shard (edge-word strips), got shard width {w}"

@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -139,5 +140,137 @@ def evolve_sharded3d_packed(
     """Packed-engine counterpart of :func:`evolve_sharded3d`."""
     validate_geometry3d_packed(vol.shape, mesh)
     return compiled_evolve3d_packed(mesh, steps, rule, halo_depth)(
+        place_private(vol, volume_sharding(mesh))
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_evolve3d_pallas(
+    mesh: Mesh, steps: int, rule: Rule3D = BAYS_4555, halo_depth: int = 8
+):
+    """Sharded 3-D evolve running the fused word-tiled Pallas kernel per
+    shard — config 5's fastest kernel composed with its decomposition
+    (VERDICT r2 #2).
+
+    Per chunk, a two-phase ring exchange mirrors the 2-D flagship's
+    corner handling one dimension up: (1) a ``halo_depth``-deep ghost
+    *plane* band rides the PLANES ring; (2) one ghost word *column* per
+    side of the already plane-extended volume rides the COLS ring, so the
+    x/d corner words make two hops.  The extended volume feeds
+    :func:`gol_tpu.ops.pallas_bitlife3d.multi_step_pallas_packed3d_wt_ext`
+    — the same kernel the single-device path runs, whose zero-filled
+    outer-ghost light cone already supports exactly this 1-word x halo
+    for k <= 32 generations.
+
+    **Mesh constraint**: the ROWS axis must have size 1 (H unsharded) —
+    the kernel's h wrap is a lane roll, true only when the shard owns the
+    full H axis.  1024³ on 8 chips still has its pick of (8,1,1),
+    (4,1,2), (2,1,4), (1,1,8) decompositions.  A non-multiple-of-
+    ``halo_depth`` remainder of ``steps`` runs on the XLA packed step.
+    """
+    from gol_tpu.ops import bitlife, bitlife3d, pallas_bitlife3d
+    from gol_tpu.parallel.halo import ring
+
+    num_planes = mesh.shape.get(PLANES, 1)
+    num_rows = mesh.shape.get(ROWS, 1)
+    num_cols = mesh.shape.get(COLS, 1)
+    if num_rows != 1:
+        raise ValueError(
+            "the sharded 3-D Pallas engine needs an H-unsharded mesh "
+            "(rows axis of size 1): the kernel's h wrap is a lane roll, "
+            f"true only when the shard owns the full H; got mesh "
+            f"{dict(mesh.shape)}"
+        )
+    if halo_depth < 8 or halo_depth % 8:
+        raise ValueError(
+            f"the sharded 3-D Pallas engine needs halo_depth to be a "
+            f"multiple of 8 (DMA plane alignment), got {halo_depth}"
+        )
+    from gol_tpu.ops.bitlife import BITS
+
+    if halo_depth > BITS:
+        raise ValueError(
+            f"the sharded 3-D Pallas engine ships one ghost word column "
+            f"whose bit light cone supports halo_depth <= {BITS}, got "
+            f"{halo_depth}"
+        )
+    pad = halo_depth
+    full, rem = divmod(steps, halo_depth)
+    phases = _phases(mesh)
+
+    def chunk(pw, tile_d, tile_w):
+        # Two-phase exchange; x ghost words sliced from the already
+        # plane-extended array carry the x/d corner planes for free.
+        top = lax.ppermute(pw[:, -pad:], PLANES, ring(num_planes, 1))
+        bot = lax.ppermute(pw[:, :pad], PLANES, ring(num_planes, -1))
+        ext_d = jnp.concatenate([top, pw, bot], axis=1)
+        left = lax.ppermute(ext_d[-1:], COLS, ring(num_cols, 1))
+        right = lax.ppermute(ext_d[:1], COLS, ring(num_cols, -1))
+        ext = jnp.concatenate([left, ext_d, right], axis=0)
+        return pallas_bitlife3d.multi_step_pallas_packed3d_wt_ext(
+            ext, tile_d, tile_w, halo_depth, rule
+        )
+
+    def local(vol):
+        d, h, w = vol.shape  # per-shard block (static under shard_map)
+        nw = w // bitlife.BITS
+        if jax.default_backend() == "tpu" and h % 128:
+            raise ValueError(
+                "the sharded 3-D Pallas engine needs the (unsharded) H "
+                f"axis to fill whole 128-lane tiles on TPU, got H={h}"
+            )
+        if d < pad:
+            raise ValueError(
+                f"shard depth {d} < exchanged plane band {pad}: the ghost "
+                "band would need planes from beyond the ring neighbor"
+            )
+        wt = pallas_bitlife3d.pick_tile3d_wt(d, nw, h, pad)
+        if wt is None:
+            raise ValueError(
+                f"no word-tiled kernel window fits scoped VMEM for shard "
+                f"{(d, h, w)} at band depth {pad}"
+            )
+        tile_d, tile_w = wt
+        packed = lax.bitcast_convert_type(
+            bitlife3d.pack3d(vol), jnp.int32
+        ).transpose(2, 0, 1)  # word-leading [nw, d, h]
+        if full:
+            packed = lax.fori_loop(
+                0, full, lambda _, p: chunk(p, tile_d, tile_w), packed
+            )
+        p3 = lax.bitcast_convert_type(
+            packed.transpose(1, 2, 0), jnp.uint32
+        )
+        if rem:
+            # Leftover generations on the XLA packed step, one exchange
+            # each: a depth-rem blocked exchange would ship rem ghost
+            # *words* along x, which narrow (few-word) shards can't
+            # source from one ring neighbor; rem < halo_depth <= 32, so
+            # the per-step ppermute cost is bounded and tiny.
+            for _ in range(rem):
+                p3 = bitlife3d.step3d_packed_halo_full(
+                    halo_extend(p3, phases, depth=1), rule
+                )
+        return bitlife3d.unpack3d(p3)
+
+    spec = P(PLANES, ROWS, COLS)
+    # check_vma=False: pallas_call's out ShapeDtypeStruct carries no
+    # varying-mesh-axes annotation (same note as the 2-D flagship).
+    local_sharded = jax.shard_map(
+        local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
+    )
+    return jax.jit(local_sharded, donate_argnums=0)
+
+
+def evolve_sharded3d_pallas(
+    vol: jax.Array,
+    steps: int,
+    mesh: Mesh,
+    rule: Rule3D = BAYS_4555,
+    halo_depth: int = 8,
+) -> jax.Array:
+    """Fused-kernel counterpart of :func:`evolve_sharded3d`."""
+    validate_geometry3d_packed(vol.shape, mesh)
+    return compiled_evolve3d_pallas(mesh, steps, rule, halo_depth)(
         place_private(vol, volume_sharding(mesh))
     )

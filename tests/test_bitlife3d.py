@@ -103,3 +103,103 @@ def test_sharded_packed_rejects_narrow_shards():
     mesh = mesh_mod.make_mesh_3d((1, 2, 4))  # shard width 16 < 32
     with pytest.raises(ValueError, match="shard width"):
         sharded3d.evolve_sharded3d_packed(vol, 1, mesh)
+
+# -- sharded 3-D flagship: fused word-tiled kernel per shard -----------------
+#
+# Config 5's fastest kernel composed with its decomposition (VERDICT r2
+# #2): halo_depth-deep ghost plane bands over the PLANES ring + one ghost
+# word column per side over the COLS ring (two-phase, corners ride the
+# second hop), feeding multi_step_pallas_packed3d_wt_ext per shard.
+# Interpret mode on CPU; the engine is shape-driven so the same program
+# runs on chip.
+
+
+def _vol3(shape=(64, 128, 256), seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return (rng.random(shape) < density).astype(np.uint8)
+
+
+def _ref3(vol, steps, rule=None):
+    from gol_tpu.ops import life3d
+
+    r = jnp.asarray(vol)
+    for _ in range(steps):
+        r = life3d.step3d(r) if rule is None else life3d.step3d(r, rule)
+    return np.asarray(r)
+
+
+@pytest.mark.parametrize(
+    "shape", [(2, 1, 4), (8, 1, 1), (1, 1, 8), (2, 1, 2)]
+)
+@pytest.mark.parametrize("steps", [8, 19])  # incl. an XLA remainder tail
+def test_sharded3d_pallas_matches_oracle(shape, steps):
+    n = shape[0] * shape[1] * shape[2]
+    mesh = mesh_mod.make_mesh_3d(shape, devices=jax.devices()[:n])
+    vol = _vol3(seed=sum(shape) + steps)
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), steps, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, steps))
+
+
+def test_sharded3d_pallas_deep_band_and_rule():
+    from gol_tpu.ops.life3d import BAYS_5766
+
+    mesh = mesh_mod.make_mesh_3d((2, 1, 4), devices=jax.devices()[:8])
+    vol = _vol3(seed=9)
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(
+            jnp.asarray(vol), 16, mesh, rule=BAYS_5766, halo_depth=16
+        )
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 16, BAYS_5766))
+
+
+def test_sharded3d_pallas_corner_crossing():
+    """A live cluster at a planes×cols shard corner: the x/d corner words
+    must ride the second exchange hop intact."""
+    vol = np.zeros((64, 128, 256), np.uint8)
+    rng = np.random.default_rng(3)
+    # Dense blob straddling the (32, :, 128) shard junction of a (2,1,2)
+    # mesh, spanning the packed-word boundary at x=128.
+    vol[28:36, 60:68, 124:132] = (
+        rng.random((8, 8, 8)) < 0.6
+    ).astype(np.uint8)
+    mesh = mesh_mod.make_mesh_3d((2, 1, 2), devices=jax.devices()[:4])
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 8, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 8))
+
+
+def test_sharded3d_pallas_matches_packed_tier():
+    """Cross-engine: fused sharded == XLA packed sharded, same mesh."""
+    mesh = mesh_mod.make_mesh_3d((2, 1, 4), devices=jax.devices()[:8])
+    vol = _vol3(seed=11)
+    a = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 11, mesh)
+    )
+    b = np.asarray(
+        sharded3d.evolve_sharded3d_packed(jnp.asarray(vol), 11, mesh)
+    )
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sharded3d_pallas_rejections():
+    mesh_rows = mesh_mod.make_mesh_3d((2, 2, 2), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="H-unsharded"):
+        sharded3d.compiled_evolve3d_pallas(mesh_rows, 8)
+    mesh = mesh_mod.make_mesh_3d((2, 1, 2), devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="multiple of 8"):
+        sharded3d.compiled_evolve3d_pallas(mesh, 8, halo_depth=4)
+    with pytest.raises(ValueError, match="light cone"):
+        sharded3d.compiled_evolve3d_pallas(mesh, 40, halo_depth=40)
+    # Shard depth below the exchanged plane band.
+    shallow = _vol3((8, 128, 128), seed=1)
+    mesh8 = mesh_mod.make_mesh_3d((8, 1, 1), devices=jax.devices()[:8])
+    with pytest.raises(Exception, match="plane band"):
+        np.asarray(
+            sharded3d.evolve_sharded3d_pallas(
+                jnp.asarray(shallow), 8, mesh8
+            )
+        )

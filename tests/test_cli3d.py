@@ -220,3 +220,42 @@ def test_cli3d_resume_missing_fingerprint_fails_clean(tmp_path, capsys):
     rc = cli3d.main(["2", "32", "2", "64", "0", "--resume", str(bad)])
     assert rc == 255
     assert "missing" in capsys.readouterr().out
+
+def test_mesh_pallas_engine_matches_single_device(tmp_path, capsys):
+    """--engine pallas --mesh 3d (H-unsharded shape): the fused sharded
+    kernel per shard, byte-compared against the single-device dump."""
+    rc = cli3d.main(
+        ["2", "128", "10", "64", "1", "--mesh", "3d", "--mesh-shape",
+         "2,1,4", "--engine", "pallas", "--outdir", str(tmp_path / "a")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    rc = cli3d.main(
+        ["2", "128", "10", "64", "1", "--engine", "bitpack", "--outdir",
+         str(tmp_path / "b")]
+    )
+    assert rc == 0
+    a = np.load(tmp_path / "a" / "World3D_of_1.npy")
+    b = np.load(tmp_path / "b" / "World3D_of_1.npy")
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_pallas_engine_rejects_sharded_h(capsys):
+    rc = cli3d.main(
+        ["2", "64", "2", "64", "0", "--mesh", "3d", "--mesh-shape",
+         "2,2,2", "--engine", "pallas"]
+    )
+    assert rc == 255
+    assert "H-unsharded" in capsys.readouterr().out
+
+
+def test_mesh_shape_validation(capsys):
+    rc = cli3d.main(
+        ["2", "32", "1", "64", "0", "--mesh-shape", "2,1,4"]
+    )
+    assert rc == 255
+    assert "--mesh 3d" in capsys.readouterr().out
+    rc = cli3d.main(
+        ["2", "32", "1", "64", "0", "--mesh", "3d", "--mesh-shape", "nope"]
+    )
+    assert rc == 255
+    assert "P,R,C" in capsys.readouterr().out
