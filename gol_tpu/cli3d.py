@@ -111,6 +111,8 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
     import jax
 
     spec_shape = (size, size, size)
+    explicit_pallas = engine == "pallas"
+    engine = _resolve_engine3d(engine, mesh, size)
     if mesh is not None:
         from gol_tpu.parallel import sharded3d
 
@@ -119,30 +121,19 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
             sharded3d.validate_geometry3d_packed(spec_shape, mesh)
         except ValueError:
             packable = False
-        if engine == "bitpack" and not packable:
+        if engine in ("bitpack", "pallas") and not packable:
             raise ValueError(
-                "engine 'bitpack' needs the x-shard width to pack into "
+                f"engine {engine!r} needs the x-shard width to pack into "
                 f"whole 32-cell words (size {size} over mesh "
                 f"{dict(mesh.shape)})"
             )
-        if engine == "pallas" or (
-            engine == "auto"
-            and packable
-            and jax.default_backend() == "tpu"
-            and _pallas3d_sharded_fits(mesh, size)
-        ):
+        if engine == "pallas":
             # The fused word-tiled kernel per shard behind the two-phase
             # ring exchange; an explicit --engine pallas surfaces its
             # geometry constraints (H-unsharded mesh etc.) as clean
-            # errors rather than silently substituting a slower tier.
-            if not packable:
-                raise ValueError(
-                    "engine 'pallas' needs the x-shard width to pack "
-                    f"into whole 32-cell words (size {size} over mesh "
-                    f"{dict(mesh.shape)})"
-                )
+            # errors — auto only resolves here when the geometry fits.
             fn = sharded3d.compiled_evolve3d_pallas(mesh, steps, rule)
-        elif packable and engine in ("auto", "bitpack"):
+        elif engine == "bitpack":
             fn = sharded3d.compiled_evolve3d_packed(mesh, steps, rule)
         else:
             sharded3d.validate_geometry3d(spec_shape, mesh)
@@ -152,18 +143,6 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
         place = lambda v: jax.device_put(v, sharding)
         return fn.lower(spec).compile(), place
 
-    explicit_pallas = engine == "pallas"
-    if engine == "auto":
-        if (
-            jax.default_backend() == "tpu"
-            and size % 128 == 0
-            and size % 32 == 0
-        ):
-            engine = "pallas"
-        elif size % 32 == 0:
-            engine = "bitpack"
-        else:
-            engine = "dense"
     if engine == "pallas":
         from gol_tpu.ops import pallas_bitlife3d
 
@@ -187,6 +166,51 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
     return fn.lower(spec, *static).compile(), jax.device_put
 
 
+def _resolve_engine3d(engine: str, mesh, size: int) -> str:
+    """Map ``auto`` to the fastest tier this geometry supports (explicit
+    choices pass through and surface their own constraint errors).
+
+    The ONE auto policy — ``_build_evolver`` delegates here, so the
+    driver's checker-engine selection and the builder cannot drift.
+    ``auto`` never resolves to a Pallas configuration that would fall
+    back or raise: it promises the fastest *fit*, not a specific program.
+    """
+    import jax
+
+    if engine != "auto":
+        return engine
+    if mesh is not None:
+        from gol_tpu.parallel import sharded3d
+
+        packable = True
+        try:
+            sharded3d.validate_geometry3d_packed((size,) * 3, mesh)
+        except ValueError:
+            packable = False
+        if (
+            packable
+            and jax.default_backend() == "tpu"
+            and _pallas3d_sharded_fits(mesh, size)
+        ):
+            return "pallas"
+        return "bitpack" if packable else "dense"
+    if jax.default_backend() == "tpu" and size % 128 == 0:
+        # % 128 implies the % 32 word packing; still require a kernel
+        # window to actually fit scoped VMEM, else auto prefers the tier
+        # that runs as asked over one that silently substitutes.
+        from gol_tpu.ops import pallas_bitlife3d
+
+        nw = size // 32
+        if (
+            pallas_bitlife3d.pick_tile3d(size, nw, size)
+            or pallas_bitlife3d.pick_tile3d_wt(size, nw, size) is not None
+        ):
+            return "pallas"
+    if size % 32 == 0:
+        return "bitpack"
+    return "dense"
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     ext = argparse.ArgumentParser(prog="gol3d", add_help=True)
@@ -201,10 +225,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--outdir", default=".")
     # Checkpoint/resume, mirroring the 2-D driver: periodic
     # fingerprint-stamped volume snapshots, verified + rule-checked on
-    # resume (utils/checkpoint.py save3d/load3d).
+    # resume.  Sharded (mesh) runs write the piece-file directory format —
+    # no host ever assembles the volume (utils/checkpoint.py
+    # save_sharded3d); single-device runs keep the monolithic npz.
     ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
     ext.add_argument("--checkpoint-dir", default="checkpoints3d")
     ext.add_argument("--resume", default=None, metavar="CKPT")
+    # Multi-host trio + failure detection, exactly the 2-D driver's
+    # surface (gol_tpu/cli.py).
+    from gol_tpu.parallel import multihost
+
+    multihost.add_multihost_args(ext)
+    ext.add_argument("--guard-every", type=int, default=0, metavar="K")
+    ext.add_argument("--guard-max-restores", type=int, default=3, metavar="N")
+    ext.add_argument("--guard-redundant", action="store_true")
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -216,6 +250,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     on_off = atoi(ns.positionals[4])
 
     try:
+        topo = multihost.init_multihost(
+            coordinator_address=ns.coordinator,
+            num_processes=ns.num_processes,
+            process_id=ns.process_id,
+        )
+    except (ValueError, RuntimeError) as e:
+        print(e)
+        return 255
+    if topo.process_count > 1 and ns.mesh == "none":
+        print(
+            f"multi-host run ({topo.process_count} processes) requires a "
+            "device mesh; pass --mesh 3d"
+        )
+        return 255
+
+    guard_report = None
+    try:
         if size <= 0:
             raise ValueError(f"size must be positive, got {size}")
         if iterations < 0:
@@ -226,30 +277,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 f"--checkpoint-every must be >= 0, got {ns.checkpoint_every}"
             )
+        if ns.guard_every < 0:
+            raise ValueError(
+                f"--guard-every must be >= 0, got {ns.guard_every} "
+                "(0 disables the guard)"
+            )
+        if ns.guard_redundant and ns.guard_every <= 0:
+            raise ValueError(
+                "--guard-redundant audits chunks, so it requires "
+                "--guard-every K > 0"
+            )
         rule = parse_rule3d(ns.rule)
+
+        import jax
 
         from gol_tpu.ops.life3d import rulestring3d
         from gol_tpu.utils import checkpoint as ckpt_mod
 
-        generation = 0
-        if ns.resume:
-            snap = ckpt_mod.load3d(ns.resume)
-            if snap.volume.shape != (size, size, size):
-                raise ValueError(
-                    f"checkpoint volume {snap.volume.shape} != configured "
-                    f"{(size, size, size)}"
-                )
-            mine = rulestring3d(rule)
-            if snap.rule != mine:
-                raise ValueError(
-                    f"checkpoint was written by a {snap.rule} run; this "
-                    f"run is configured for {mine} — pass the matching "
-                    "--rule to resume"
-                )
-            vol = snap.volume
-            generation = snap.generation
-        else:
-            vol = init_volume(pattern, size)
+        rulestr = rulestring3d(rule)
 
         mesh = None
         if ns.mesh == "3d":
@@ -266,50 +311,200 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         f"{ns.mesh_shape!r}"
                     )
                 shape3 = tuple(int(p) for p in parts)
-            mesh = mesh_mod.make_mesh_3d(shape3)
+            if shape3 is not None:
+                # An explicit factorization may use a subset of the
+                # visible devices (e.g. an H-unsharded mesh on a pod
+                # whose count doesn't factor as P*1*C).
+                n3 = shape3[0] * shape3[1] * shape3[2]
+                if n3 > len(jax.devices()):
+                    raise ValueError(
+                        f"--mesh-shape {ns.mesh_shape} needs {n3} devices, "
+                        f"only {len(jax.devices())} visible"
+                    )
+                mesh = mesh_mod.make_mesh_3d(
+                    shape3, devices=jax.devices()[:n3]
+                )
+            else:
+                mesh = mesh_mod.make_mesh_3d()
         elif ns.mesh_shape:
             raise ValueError("--mesh-shape requires --mesh 3d")
 
+        def check_meta(shape, found_rule):
+            if tuple(shape) != (size, size, size):
+                raise ValueError(
+                    f"checkpoint volume {tuple(shape)} != configured "
+                    f"{(size, size, size)}"
+                )
+            if found_rule != rulestr:
+                raise ValueError(
+                    f"checkpoint was written by a {found_rule} run; this "
+                    f"run is configured for {rulestr} — pass the matching "
+                    "--rule to resume"
+                )
+
+        generation = 0
+        vol = None
+        placed = None  # sharded resumes build the device array directly
+        if ns.resume:
+            if ckpt_mod.is_sharded(ns.resume):
+                meta = ckpt_mod.load_sharded3d_meta(ns.resume)
+                check_meta(meta.shape, meta.rule)
+                generation = meta.generation
+                if mesh is not None:
+                    from gol_tpu.parallel import sharded3d
+
+                    # Each host reads back only the boxes its devices own.
+                    placed = jax.make_array_from_callback(
+                        meta.shape,
+                        sharded3d.volume_sharding(mesh),
+                        lambda idx: ckpt_mod.read_sharded3d_region(
+                            ns.resume, meta, idx
+                        ),
+                    )
+                else:
+                    vol = ckpt_mod.read_sharded3d_region(
+                        ns.resume,
+                        meta,
+                        (slice(None), slice(None), slice(None)),
+                    )
+            else:
+                snap = ckpt_mod.load3d(ns.resume)
+                check_meta(snap.volume.shape, snap.rule)
+                vol = snap.volume
+                generation = snap.generation
+        else:
+            vol = init_volume(pattern, size)
+
         from gol_tpu.utils.timing import Stopwatch, force_ready
+
+        # Evolvers receive the raw choice (auto keeps its silent-fallback
+        # contract inside _build_evolver); the resolved name picks the
+        # redundant checker's counterpart engine.
+        resolved = _resolve_engine3d(ns.engine, mesh, size)
+
+        def save_snapshot(b, g, fp=None):
+            if mesh is not None:
+                ckpt_mod.save_sharded3d(
+                    ckpt_mod.sharded_checkpoint3d_path(
+                        ns.checkpoint_dir, g
+                    ),
+                    b,
+                    g,
+                    rulestr,
+                    fingerprint=fp,
+                )
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("gol3d_checkpoint")
+            else:
+                ckpt_mod.save3d(
+                    ckpt_mod.checkpoint3d_path(ns.checkpoint_dir, g),
+                    np.asarray(b),
+                    g,
+                    rulestr,
+                    fingerprint=fp,
+                )
 
         sw = Stopwatch()
         if iterations > 0:
-            # GolRuntime's schedule policy: full checkpoint intervals plus
-            # one tail, one AOT-compiled evolver per distinct size.
+            # GolRuntime's schedule policy: full audit/checkpoint
+            # intervals plus one tail, one AOT evolver per distinct size.
             from gol_tpu.runtime import chunk_schedule
 
-            schedule = chunk_schedule(
-                iterations,
-                ns.checkpoint_every if ns.checkpoint_every > 0 else iterations,
+            interval = (
+                ns.guard_every
+                if ns.guard_every > 0
+                else (
+                    ns.checkpoint_every
+                    if ns.checkpoint_every > 0
+                    else iterations
+                )
             )
+            schedule = chunk_schedule(iterations, interval)
             with sw.phase("compile"):
                 evolvers = {
                     take: _build_evolver(ns.engine, mesh, take, rule, size)
                     for take in set(schedule)
                 }
                 place = evolvers[schedule[0]][1]
-                board = place(vol)
+                board = placed if placed is not None else place(vol)
                 force_ready(board)
-            for take in schedule:
-                compiled, _ = evolvers[take]
-                with sw.phase("total"):
-                    board = compiled(board)
-                    force_ready(board)
-                generation += take
-                if ns.checkpoint_every > 0:
-                    with sw.phase("checkpoint"):
-                        ckpt_mod.save3d(
-                            ckpt_mod.checkpoint3d_path(
-                                ns.checkpoint_dir, generation
-                            ),
-                            np.asarray(board),
-                            generation,
-                            rulestring3d(rule),
+                checker_evolvers = None
+                if ns.guard_redundant:
+                    # Second bit-exact engine: an independent program a
+                    # random flip cannot reproduce (guard._checker_runtime's
+                    # reasoning; bitlife3d and life3d are mutually
+                    # bit-exact, pinned by the 3-D equivalence tests).
+                    checker = "dense" if resolved != "dense" else "bitpack"
+                    if checker == "bitpack" and size % 32:
+                        raise ValueError(
+                            "the redundant audit needs a second bit-exact "
+                            "engine, and the only check for a dense run is "
+                            f"bit-packed — size {size} does not pack into "
+                            "32-cell words"
                         )
+                    checker_evolvers = {
+                        take: (
+                            _build_evolver(checker, mesh, take, rule, size)[0],
+                            (),
+                        )
+                        for take in set(schedule)
+                    }
+            if ns.guard_every > 0:
+                from gol_tpu.utils import guard as guard_mod
+
+                guard_report = guard_mod.GuardReport()
+                board, generation = guard_mod.guarded_loop(
+                    sw,
+                    guard_report,
+                    board,
+                    generation,
+                    schedule,
+                    {t: (c, ()) for t, (c, _) in evolvers.items()},
+                    checker_evolvers,
+                    guard_mod.GuardConfig(
+                        check_every=ns.guard_every,
+                        max_restores=ns.guard_max_restores,
+                        redundant=ns.guard_redundant,
+                    ),
+                    save_snapshot=save_snapshot,
+                    checkpoint_every=ns.checkpoint_every,
+                )
+            else:
+                for take in schedule:
+                    compiled, _ = evolvers[take]
+                    with sw.phase("total"):
+                        board = compiled(board)
+                        force_ready(board)
+                    generation += take
+                    if ns.checkpoint_every > 0:
+                        with sw.phase("checkpoint"):
+                            save_snapshot(board, generation)
             out = board
         else:
-            out = vol
-        out_np = np.asarray(out)
+            out = placed if placed is not None else vol
+        # Population via a device reduce (collective-safe on sharded
+        # volumes, and no 1 GB host gather at 1024³ just for the line).
+        # Per-plane uint32 counts (each < 2^32: a plane has size² cells)
+        # combined in uint64 on host — a single uint32 total would wrap
+        # for volumes with >= 2^32 live cells.
+        import jax.numpy as jnp
+
+        if hasattr(out, "sharding"):
+            reps = None
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                reps = NamedSharding(mesh, PartitionSpec())
+            plane_pops = jax.jit(
+                lambda b: jnp.sum(b.astype(jnp.uint32), axis=(1, 2)),
+                out_shardings=reps,
+            )(out)
+            population = int(
+                np.asarray(plane_pops).astype(np.uint64).sum()
+            )
+        else:
+            population = int(np.asarray(out).sum())
     except (ValueError, OSError) as e:
         # Same surface as the 2-D driver (gol_tpu/cli.py): bad --resume
         # paths, corrupt snapshots, unavailable engines, unwritable dirs
@@ -318,10 +513,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 255
 
     report = sw.report(size**3 * iterations)
-    print(report.duration_line())
-    print(f"POPULATION     : {int(out_np.sum())} live cells of {size**3}")
-    print("This is 3-D Life running on a TPU (capability addition).")
+    if topo.is_coordinator:
+        print(report.duration_line())
+        if guard_report is not None:
+            print(guard_report.summary_line())
+        print(f"POPULATION     : {population} live cells of {size**3}")
+        print("This is 3-D Life running on a TPU (capability addition).")
     if on_off == 1:
+        if topo.process_count > 1:
+            # Replication collective; only the coordinator writes.
+            full = multihost.fetch_global(out)
+            if not topo.is_coordinator:
+                return 0
+            out_np = full
+        else:
+            out_np = np.asarray(out)
         os.makedirs(ns.outdir, exist_ok=True)
         path = os.path.join(ns.outdir, "World3D_of_1.npy")
         np.save(path, out_np)

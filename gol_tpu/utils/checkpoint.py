@@ -200,18 +200,28 @@ def _vol_fingerprint(vol: np.ndarray) -> int:
     return fingerprint_np(vol.reshape(d * h, w))
 
 
-def save3d(path: str, vol: np.ndarray, generation: int, rule: str) -> str:
+def save3d(
+    path: str,
+    vol: np.ndarray,
+    generation: int,
+    rule: str,
+    fingerprint: Optional[int] = None,
+) -> str:
     """Atomic fingerprint-stamped 3-D snapshot (same contract as
-    :func:`save`, volume-shaped)."""
+    :func:`save`, volume-shaped).  A caller-supplied ``fingerprint`` (the
+    guard audit's device stamp — bit-identical to ``_vol_fingerprint`` by
+    construction) skips the host-side recompute pass over the volume."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     vol = np.asarray(vol, np.uint8)
+    if fingerprint is None:
+        fingerprint = _vol_fingerprint(vol)
     tmp = path + ".tmp.npz"
     np.savez_compressed(
         tmp,
         volume=vol,
         generation=np.int64(generation),
         rule=np.asarray(rule),
-        fingerprint=np.uint32(_vol_fingerprint(vol)),
+        fingerprint=np.uint32(fingerprint),
     )
     os.replace(tmp, path)
     return path
@@ -393,17 +403,30 @@ def load_sharded_meta(dirpath: str) -> ShardedMeta:
     """Read + validate the manifest: the cover must tile the board exactly,
     and (when a global stamp is present) the per-piece fingerprints must
     add up to it — both checked without assembling any board data."""
-    with np.load(os.path.join(dirpath, _MANIFEST)) as data:
-        meta = ShardedMeta(
-            shape=tuple(int(x) for x in data["shape"]),
-            generation=int(data["generation"]),
-            num_ranks=int(data["num_ranks"]),
-            rule=str(data["rule"]) if "rule" in data else None,
-            rects=data["rects"].copy(),
-            procs=data["procs"].copy(),
-            fingerprint=(
-                int(data["fingerprint"]) if "fingerprint" in data else None
-            ),
+    import zipfile
+
+    try:
+        with np.load(os.path.join(dirpath, _MANIFEST)) as data:
+            meta = ShardedMeta(
+                shape=tuple(int(x) for x in data["shape"]),
+                generation=int(data["generation"]),
+                num_ranks=int(data["num_ranks"]),
+                rule=str(data["rule"]) if "rule" in data else None,
+                rects=data["rects"].copy(),
+                procs=data["procs"].copy(),
+                fingerprint=(
+                    int(data["fingerprint"]) if "fingerprint" in data else None
+                ),
+            )
+    except (KeyError, ValueError, zipfile.BadZipFile) as e:
+        raise CorruptSnapshotError(
+            f"{dirpath}: not a 2-D sharded checkpoint manifest ({e}); a "
+            f"3-D {SHARD3D_DIR_SUFFIX} directory belongs to the 3-D driver"
+        ) from e
+    if len(meta.shape) != 2 or meta.rects.ndim != 2 or meta.rects.shape[1] != 4:
+        raise CorruptSnapshotError(
+            f"{dirpath}: malformed 2-D manifest geometry "
+            f"(shape {meta.shape}, rect table {meta.rects.shape})"
         )
     h, w = meta.shape
     area = 0
@@ -541,3 +564,302 @@ def _fill_region(dirpath, meta, out, lo_r, hi_r, lo_c, hi_c, by_proc):
         ]
         filled += (i1 - i0) * (j1 - j0)
     return filled
+
+
+# -- sharded 3-D checkpoints (the 3-D driver's multi-host persistence) -------
+#
+# Same design as the 2-D sharded format: per-process piece files + a
+# deterministic manifest, position-weighted additive fingerprints under the
+# volume's [D*H, W] flattening (matching ``_vol_fingerprint``), so a global
+# stamp verifies without any host assembling the volume.  Pieces are 3-D
+# boxes ``(d0, d1, r0, r1, c0, c1)``.
+
+SHARD3D_DIR_SUFFIX = ".gol3d.d"
+
+
+def sharded_checkpoint3d_path(directory: str, generation: int) -> str:
+    return os.path.join(
+        directory, f"ckpt3d_{generation:012d}{SHARD3D_DIR_SUFFIX}"
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Sharded3DMeta:
+    """The 3-D manifest: everything except the volume data itself."""
+
+    shape: tuple
+    generation: int
+    rule: str
+    boxes: np.ndarray  # [n, 6] (d0, d1, r0, r1, c0, c1) disjoint cover
+    procs: np.ndarray  # [n] writer process per box
+    fingerprint: Optional[int]
+
+
+def _box(idx, shape):
+    """Decode a 3-D shard index (tuple of slices) into a 6-tuple box."""
+    out = []
+    sl = list(idx) + [slice(None)] * (3 - len(idx))
+    for s, dim in zip(sl, shape):
+        out.append(0 if s.start is None else s.start)
+        out.append(dim if s.stop is None else s.stop)
+    return tuple(out)
+
+
+def fingerprint3d_np(
+    piece: np.ndarray, d0: int, r0: int, c0: int, global_h: int
+) -> int:
+    """Additive stamp of a 3-D piece at global offset ``(d0, r0, c0)``.
+
+    Computed under the volume's ``[D*H, W]`` flattening (plane ``d`` row
+    ``r`` lands at flattened row ``d*H + r``), so the stamps of a disjoint
+    box cover sum mod 2^32 to :func:`_vol_fingerprint` of the whole
+    volume.
+    """
+    from gol_tpu.utils.guard import fingerprint_np
+
+    total = np.uint32(0)
+    with np.errstate(over="ignore"):
+        for di in range(piece.shape[0]):
+            total = total + np.uint32(
+                fingerprint_np(piece[di], (d0 + di) * global_h + r0, c0)
+            )
+    return int(total)
+
+
+def _piece_table3d(sharding, shape):
+    """Deterministic (box -> lowest owning process) map, same on all hosts."""
+    owner = {}
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        b = _box(idx, shape)
+        p = dev.process_index
+        if b not in owner or p < owner[b]:
+            owner[b] = p
+    return owner
+
+
+def save_sharded3d(
+    dirpath: str,
+    arr,
+    generation: int,
+    rule: str,
+    fingerprint: Optional[int] = None,
+) -> list:
+    """Write this process's pieces of a sharded volume (collective call).
+
+    Contract matches :func:`save_sharded`: every process writes exactly
+    the boxes assigned to it, process 0 additionally writes the manifest,
+    no process ever holds more than its own addressable shards, and the
+    caller fences with a barrier before relying on the checkpoint.
+    """
+    import jax
+
+    os.makedirs(dirpath, exist_ok=True)
+    shape = tuple(arr.shape)
+    owner = _piece_table3d(arr.sharding, shape)
+    me = jax.process_index()
+    written = []
+    pieces, seen = [], set()
+    for shard in arr.addressable_shards:
+        b = _box(shard.index, shape)
+        if owner[b] != me or b in seen:
+            continue
+        seen.add(b)
+        pieces.append((b, np.asarray(shard.data, np.uint8)))
+    arrays = dict(
+        boxes=np.asarray([b for b, _ in pieces], np.int64).reshape(-1, 6),
+        fps=np.asarray(
+            [
+                fingerprint3d_np(data, b[0], b[2], b[4], shape[1])
+                for b, data in pieces
+            ],
+            np.uint32,
+        ),
+    )
+    for i, (_, data) in enumerate(pieces):
+        arrays[f"piece_{i}"] = data
+    path = os.path.join(dirpath, f"shards_{me:05d}.npz")
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+    written.append(path)
+    if me == 0:
+        table = sorted(owner.items())
+        manifest = dict(
+            shape=np.asarray(shape, np.int64),
+            generation=np.int64(generation),
+            rule=np.asarray(rule),
+            boxes=np.asarray(
+                [b for b, _ in table], np.int64
+            ).reshape(-1, 6),
+            procs=np.asarray([p for _, p in table], np.int64),
+        )
+        if fingerprint is not None:
+            manifest["fingerprint"] = np.uint32(fingerprint)
+        mpath = os.path.join(dirpath, _MANIFEST)
+        tmp = mpath + ".tmp.npz"
+        np.savez_compressed(tmp, **manifest)
+        os.replace(tmp, mpath)
+        written.append(mpath)
+    return written
+
+
+def load_sharded3d_meta(dirpath: str) -> Sharded3DMeta:
+    """Read + validate the 3-D manifest: the box cover must tile the
+    volume exactly (bounds, total volume, pairwise disjointness), and a
+    global stamp must equal the sum of the piece stamps — all without
+    assembling any volume data."""
+    import zipfile
+
+    try:
+        with np.load(os.path.join(dirpath, _MANIFEST)) as data:
+            meta = Sharded3DMeta(
+                shape=tuple(int(x) for x in data["shape"]),
+                generation=int(data["generation"]),
+                rule=str(data["rule"]),
+                boxes=data["boxes"].copy(),
+                procs=data["procs"].copy(),
+                fingerprint=(
+                    int(data["fingerprint"]) if "fingerprint" in data else None
+                ),
+            )
+    except (KeyError, ValueError, zipfile.BadZipFile) as e:
+        raise CorruptSnapshotError(
+            f"{dirpath}: not a 3-D sharded checkpoint manifest ({e}); a "
+            f"2-D {SHARD_DIR_SUFFIX} directory belongs to the 2-D driver"
+        ) from e
+    if len(meta.shape) != 3 or meta.boxes.ndim != 2 or meta.boxes.shape[1] != 6:
+        raise CorruptSnapshotError(
+            f"{dirpath}: malformed 3-D manifest geometry "
+            f"(shape {meta.shape}, box table {meta.boxes.shape})"
+        )
+    d, h, w = meta.shape
+    vol = 0
+    boxes = []
+    for row in meta.boxes:
+        d0, d1, r0, r1, c0, c1 = (int(x) for x in row)
+        if not (
+            0 <= d0 < d1 <= d
+            and 0 <= r0 < r1 <= h
+            and 0 <= c0 < c1 <= w
+        ):
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece box ({d0},{d1},{r0},{r1},{c0},{c1}) "
+                f"falls outside the {d}x{h}x{w} volume; the manifest is "
+                "corrupt"
+            )
+        vol += (d1 - d0) * (r1 - r0) * (c1 - c0)
+        boxes.append((d0, d1, r0, r1, c0, c1))
+    if vol != d * h * w:
+        raise CorruptSnapshotError(
+            f"{dirpath}: piece table covers {vol} cells of {d * h * w}; "
+            "the manifest is corrupt or incomplete"
+        )
+    boxes.sort()
+    for i, a in enumerate(boxes):
+        for b in boxes[i + 1 :]:
+            if b[0] >= a[1]:
+                break  # sorted by d0: no later box can overlap planes
+            if b[2] < a[3] and b[3] > a[2] and b[4] < a[5] and b[5] > a[4]:
+                raise CorruptSnapshotError(
+                    f"{dirpath}: piece boxes {a} and {b} overlap; the "
+                    "manifest is corrupt"
+                )
+    if meta.fingerprint is not None:
+        total = np.uint32(0)
+        with np.errstate(over="ignore"):
+            for proc in sorted(set(int(p) for p in meta.procs)):
+                with np.load(
+                    os.path.join(dirpath, f"shards_{proc:05d}.npz")
+                ) as sf:
+                    total = total + np.sum(
+                        sf["fps"].astype(np.uint32), dtype=np.uint32
+                    )
+        if int(total) != meta.fingerprint:
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece fingerprints sum to {int(total):#010x} "
+                f"!= stamped {meta.fingerprint:#010x}; some shard file is "
+                "corrupt"
+            )
+    return meta
+
+
+def read_sharded3d_region(
+    dirpath: str, meta: Sharded3DMeta, index
+) -> np.ndarray:
+    """Assemble one box-shaped region from the 3-D piece files.
+
+    ``index`` is a tuple of slices over the global volume (the
+    ``jax.make_array_from_callback`` contract); each consulted piece is
+    fingerprint-verified once, pieces outside the region never read.
+    """
+    from gol_tpu.utils.guard import fingerprint_np
+
+    d, h, w = meta.shape
+    sl = list(index) + [slice(None)] * (3 - len(index))
+    lo = [s.start or 0 for s in sl]
+    hi = [
+        dim if s.stop is None else s.stop for s, dim in zip(sl, (d, h, w))
+    ]
+    out = np.empty(
+        (hi[0] - lo[0], hi[1] - lo[1], hi[2] - lo[2]), np.uint8
+    )
+    filled = 0
+    by_proc = {}
+    try:
+        for row, proc in zip(meta.boxes, meta.procs):
+            box = tuple(int(x) for x in row)
+            inter = [
+                (max(box[2 * a], lo[a]), min(box[2 * a + 1], hi[a]))
+                for a in range(3)
+            ]
+            if any(i0 >= i1 for i0, i1 in inter):
+                continue
+            proc = int(proc)
+            if proc not in by_proc:
+                by_proc[proc] = np.load(
+                    os.path.join(dirpath, f"shards_{proc:05d}.npz")
+                )
+            sf = by_proc[proc]
+            hit = np.nonzero(
+                np.all(sf["boxes"] == np.asarray(box, np.int64), axis=1)
+            )[0]
+            if hit.size != 1:
+                raise CorruptSnapshotError(
+                    f"{dirpath}: piece {box} missing from "
+                    f"shards_{proc:05d}.npz"
+                )
+            k = int(hit[0])
+            data = sf[f"piece_{k}"].astype(np.uint8)
+            want = tuple(box[2 * a + 1] - box[2 * a] for a in range(3))
+            if data.shape != want:
+                raise CorruptSnapshotError(
+                    f"{dirpath}: piece {box} has shape {data.shape}, "
+                    f"expected {want}"
+                )
+            stored = int(sf["fps"][k])
+            actual = fingerprint3d_np(data, box[0], box[2], box[4], h)
+            if stored != actual:
+                raise CorruptSnapshotError(
+                    f"{dirpath}: piece {box} fingerprint {actual:#010x} "
+                    f"!= stored {stored:#010x}; the shard file is corrupt"
+                )
+            (i0, i1), (j0, j1), (k0, k1) = inter
+            out[
+                i0 - lo[0] : i1 - lo[0],
+                j0 - lo[1] : j1 - lo[1],
+                k0 - lo[2] : k1 - lo[2],
+            ] = data[
+                i0 - box[0] : i1 - box[0],
+                j0 - box[2] : j1 - box[2],
+                k0 - box[4] : k1 - box[4],
+            ]
+            filled += (i1 - i0) * (j1 - j0) * (k1 - k0)
+    finally:
+        for sf in by_proc.values():
+            sf.close()
+    if filled != out.size:
+        raise CorruptSnapshotError(
+            f"{dirpath}: region {index} only covered {filled} of "
+            f"{out.size} cells"
+        )
+    return out

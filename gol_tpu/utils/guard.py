@@ -114,7 +114,28 @@ def _audit_device(board: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
     )
 
 
+def _audit_device3(vol: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """3-D twin of :func:`_audit_device`, weighted under the volume's
+    ``[D*H, W]`` flattening so the fingerprint is bit-identical to
+    ``checkpoint._vol_fingerprint`` — one audit convention per driver.
+    All elementwise: shard-local under any volume sharding."""
+    d, h, w = vol.shape
+    ri = (
+        jnp.arange(d, dtype=jnp.uint32)[:, None, None] * jnp.uint32(h)
+        + jnp.arange(h, dtype=jnp.uint32)[None, :, None]
+    ) * _ROW_MIX + jnp.uint32(1)
+    cj = jnp.arange(w, dtype=jnp.uint32)[None, None, :] * _COL_MIX + jnp.uint32(1)
+    weights = jnp.uint32(1) + ri * cj * _VAL_MIX
+    cells = vol.astype(jnp.uint32)
+    return (
+        jnp.max(vol),
+        jnp.sum(cells, dtype=jnp.uint32),
+        jnp.sum(cells * weights, dtype=jnp.uint32),
+    )
+
+
 _audit_jit = jax.jit(_audit_device)
+_audit3_jit = jax.jit(_audit_device3)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,8 +159,13 @@ class Audit:
 
 
 def audit_board(board, generation: int = 0) -> Audit:
-    """Run the on-device detector; scalars replicate to every host."""
-    max_cell, pop, fp = _audit_jit(board)
+    """Run the on-device detector; scalars replicate to every host.
+
+    Accepts 2-D boards and 3-D volumes (the latter fingerprinted under
+    the ``[D*H, W]`` flattening the 3-D checkpoint format stamps)."""
+    max_cell, pop, fp = (
+        _audit_jit(board) if board.ndim == 2 else _audit3_jit(board)
+    )
     max_cell = int(max_cell)
     return Audit(
         generation=generation,
@@ -304,6 +330,45 @@ def run_guarded(
             )
 
     generation = int(state.generation)
+    board, generation = guarded_loop(
+        sw,
+        guard,
+        board,
+        generation,
+        schedule,
+        evolvers,
+        checker_evolvers,
+        config,
+        save_snapshot=lambda b, g, fp: rt._save_snapshot(
+            GolState.create(b, g), fingerprint=fp
+        ),
+        checkpoint_every=rt.checkpoint_every,
+    )
+
+    report = sw.report(rt.geometry.cell_updates(iterations))
+    return report, GolState.create(board, generation), guard
+
+
+def guarded_loop(
+    sw: Stopwatch,
+    guard: GuardReport,
+    board,
+    generation: int,
+    schedule,
+    evolvers,
+    checker_evolvers,
+    config: GuardConfig,
+    save_snapshot=None,
+    checkpoint_every: int = 0,
+):
+    """The chunk/audit/rollback core, shared by the 2-D and 3-D drivers.
+
+    ``evolvers[take]`` is ``(compiled, dynamic_args)``; the compiled
+    program donates its input.  ``save_snapshot(board, generation,
+    fingerprint)`` persists an audited-good state (the audit's device
+    fingerprint rides along so no host-side recompute happens).  Returns
+    the final ``(board, generation)``; the caller owns reporting.
+    """
     # The rollback base lives on device (in the same fault domain as the
     # board — the price of not all-gathering per chunk), so its audit
     # fingerprint is recorded at snapshot time and re-verified before any
@@ -311,7 +376,7 @@ def run_guarded(
     # loudly, never silently replay-and-certify corruption.
     last_good = (_device_copy(board), generation, audit_board(board).fingerprint)
     next_ckpt = (
-        generation + rt.checkpoint_every if rt.checkpoint_every > 0 else None
+        generation + checkpoint_every if checkpoint_every > 0 else None
     )
     i = 0
     restores_this_chunk = 0
@@ -384,12 +449,7 @@ def run_guarded(
                 # The audit already fingerprinted this exact board on
                 # device — no host-side fingerprint pass; multi-host runs
                 # write sharded pieces with no gather at all.
-                rt._save_snapshot(
-                    GolState.create(board, generation),
-                    fingerprint=audit.fingerprint,
-                )
-            next_ckpt = generation + rt.checkpoint_every
+                save_snapshot(board, generation, audit.fingerprint)
+            next_ckpt = generation + checkpoint_every
         i += 1
-
-    report = sw.report(rt.geometry.cell_updates(iterations))
-    return report, GolState.create(board, generation), guard
+    return board, generation

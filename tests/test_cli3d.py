@@ -259,3 +259,176 @@ def test_mesh_shape_validation(capsys):
     )
     assert rc == 255
     assert "P,R,C" in capsys.readouterr().out
+
+# -- round-3 driver parity: sharded checkpoints, guard, resume ---------------
+
+
+def test_sharded_checkpoint_and_resume_byte_exact(tmp_path, capsys):
+    """Mesh run writes the sharded piece-file format (no monolithic npz,
+    no host gather); resume from it == straight run, byte-exact."""
+    import os
+
+    common = ["2", "64", "10", "64", "1", "--mesh", "3d", "--mesh-shape",
+              "2,1,2", "--engine", "bitpack"]
+    rc = cli3d.main(common + ["--outdir", str(tmp_path / "straight")])
+    assert rc == 0
+
+    rc = cli3d.main(
+        ["2", "64", "4", "64", "0", "--mesh", "3d", "--mesh-shape",
+         "2,1,2", "--engine", "bitpack", "--checkpoint-every", "4",
+         "--checkpoint-dir", str(tmp_path / "ck")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    ckdir = tmp_path / "ck" / "ckpt3d_000000000004.gol3d.d"
+    assert ckdir.is_dir()  # the sharded format, not a monolithic npz
+    assert (ckdir / "manifest.npz").exists()
+    rc = cli3d.main(
+        ["2", "64", "6", "64", "1", "--mesh", "3d", "--mesh-shape",
+         "2,1,2", "--engine", "bitpack", "--resume", str(ckdir),
+         "--outdir", str(tmp_path / "resumed")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    a = np.load(tmp_path / "straight" / "World3D_of_1.npy")
+    b = np.load(tmp_path / "resumed" / "World3D_of_1.npy")
+    np.testing.assert_array_equal(a, b)
+    # Single-device resume from the same sharded checkpoint.
+    rc = cli3d.main(
+        ["2", "64", "6", "64", "1", "--engine", "bitpack", "--resume",
+         str(ckdir), "--outdir", str(tmp_path / "resumed1")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    c = np.load(tmp_path / "resumed1" / "World3D_of_1.npy")
+    np.testing.assert_array_equal(a, c)
+
+
+def test_guarded_run_matches_unguarded(tmp_path, capsys):
+    rc = cli3d.main(
+        ["2", "32", "9", "64", "1", "--engine", "bitpack",
+         "--guard-every", "4", "--outdir", str(tmp_path / "g")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "GUARD          : 3 checks, 0 failures, 0 restores" in out
+    rc = cli3d.main(
+        ["2", "32", "9", "64", "1", "--engine", "bitpack",
+         "--outdir", str(tmp_path / "p")]
+    )
+    assert rc == 0
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "g" / "World3D_of_1.npy"),
+        np.load(tmp_path / "p" / "World3D_of_1.npy"),
+    )
+
+
+def test_guarded_redundant_run(tmp_path, capsys):
+    rc = cli3d.main(
+        ["2", "32", "8", "64", "1", "--engine", "bitpack",
+         "--guard-every", "4", "--guard-redundant",
+         "--outdir", str(tmp_path / "r")]
+    )
+    assert rc == 0, capsys.readouterr().out
+    assert "GUARD          : 2 checks" in capsys.readouterr().out
+    rc = cli3d.main(
+        ["2", "32", "8", "64", "1", "--engine", "dense",
+         "--outdir", str(tmp_path / "p")]
+    )
+    assert rc == 0
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "r" / "World3D_of_1.npy"),
+        np.load(tmp_path / "p" / "World3D_of_1.npy"),
+    )
+
+
+def test_guard_redundant_requires_guard_every(capsys):
+    rc = cli3d.main(["2", "32", "4", "64", "0", "--guard-redundant"])
+    assert rc == 255
+    assert "--guard-every" in capsys.readouterr().out
+
+
+def test_guard3d_fault_drill():
+    """guarded_loop + the 3-D driver's evolvers: an out-of-range flip is
+    detected and rolled back; an in-range flip needs the redundant audit."""
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import life3d
+    from gol_tpu.utils import guard as guard_mod
+    from gol_tpu.utils.timing import Stopwatch
+
+    size, rule = 32, cli3d.parse_rule3d("bays4555")
+    vol = cli3d.init_volume(2, size)
+    compiled, place = cli3d._build_evolver("bitpack", None, 4, rule, size)
+    evolvers = {4: (compiled, ())}
+    fired = []
+
+    def hook(board, gen):
+        if gen == 8 and not fired:
+            fired.append(gen)
+            return board.at[1, 2, 3].set(jnp.uint8(0xA5))  # out-of-range
+        return board
+
+    sw, rep = Stopwatch(), guard_mod.GuardReport()
+    board, generation = guard_mod.guarded_loop(
+        sw, rep, place(vol), 0, [4, 4, 4], evolvers, None,
+        guard_mod.GuardConfig(check_every=4, fault_hook=hook),
+    )
+    assert generation == 12
+    assert rep.failures == 1 and rep.restores == 1 and rep.checks == 4
+    ref = jnp.asarray(vol)
+    for _ in range(12):
+        ref = life3d.step3d(ref)
+    np.testing.assert_array_equal(np.asarray(board), np.asarray(ref))
+
+
+def test_guard3d_redundant_catches_inrange_flip():
+    import jax.numpy as jnp
+
+    from gol_tpu.utils import guard as guard_mod
+    from gol_tpu.utils.timing import Stopwatch
+
+    size, rule = 32, cli3d.parse_rule3d("bays4555")
+    vol = cli3d.init_volume(2, size)
+    evolvers = {
+        4: (cli3d._build_evolver("bitpack", None, 4, rule, size)[0], ())
+    }
+    checkers = {
+        4: (cli3d._build_evolver("dense", None, 4, rule, size)[0], ())
+    }
+    fired = []
+
+    def hook(board, gen):
+        if gen == 4 and not fired:
+            fired.append(gen)
+            v = int(board[0, 0, 0])
+            return board.at[0, 0, 0].set(jnp.uint8(1 - v))  # IN-range
+        return board
+
+    sw, rep = Stopwatch(), guard_mod.GuardReport()
+    import jax
+
+    board, generation = guard_mod.guarded_loop(
+        sw, rep, jax.device_put(vol), 0, [4, 4], evolvers, checkers,
+        guard_mod.GuardConfig(check_every=4, fault_hook=hook, redundant=True),
+    )
+    assert generation == 8
+    assert rep.failures == 1 and rep.restores == 1
+
+def test_resume_from_2d_sharded_dir_clean_error(tmp_path, capsys):
+    """Pointing --resume at a 2-D sharded checkpoint dir must exit 255
+    with a clean cross-driver message, not a KeyError traceback."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    mesh = mesh_mod.make_mesh_1d(4)
+    board = jax.device_put(
+        jnp.zeros((32, 32), jnp.uint8), mesh_mod.board_sharding(mesh)
+    )
+    d = ckpt_mod.sharded_checkpoint_path(str(tmp_path), 3)
+    ckpt_mod.save_sharded(d, board, 3, num_ranks=4)
+    rc = cli3d.main(
+        ["2", "32", "2", "64", "0", "--engine", "dense", "--resume", d]
+    )
+    assert rc == 255
+    assert "3-D sharded checkpoint manifest" in capsys.readouterr().out
