@@ -96,6 +96,12 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     # second bit-exact engine and require matching fingerprints (catches
     # in-range flips the 0/1 invariant cannot see; ~2x audited compute).
     ext.add_argument("--guard-redundant", action="store_true")
+    # Sampling for the redundancy audit: recompute every Nth audited
+    # chunk (see utils/guard.py GuardConfig.redundant_every for the
+    # coverage trade-off).
+    ext.add_argument(
+        "--guard-redundant-every", type=int, default=1, metavar="N"
+    )
     ns = ext.parse_args(list(argv))
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE)
@@ -180,6 +186,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--guard-redundant audits chunks, so it requires "
                 "--guard-every K > 0"
             )
+        if ns.guard_redundant_every != 1 and not ns.guard_redundant:
+            raise ValueError(
+                "--guard-redundant-every samples the redundancy audit, "
+                "so it requires --guard-redundant"
+            )
         if ns.guard_every < 0:
             raise ValueError(
                 f"--guard-every must be >= 0, got {ns.guard_every} "
@@ -218,6 +229,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     check_every=ns.guard_every,
                     max_restores=ns.guard_max_restores,
                     redundant=ns.guard_redundant,
+                    redundant_every=ns.guard_redundant_every,
                 ),
                 resume=ns.resume,
             )

@@ -239,6 +239,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--guard-every", type=int, default=0, metavar="K")
     ext.add_argument("--guard-max-restores", type=int, default=3, metavar="N")
     ext.add_argument("--guard-redundant", action="store_true")
+    ext.add_argument(
+        "--guard-redundant-every", type=int, default=1, metavar="N"
+    )
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -286,6 +289,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 "--guard-redundant audits chunks, so it requires "
                 "--guard-every K > 0"
+            )
+        if ns.guard_redundant_every != 1 and not ns.guard_redundant:
+            raise ValueError(
+                "--guard-redundant-every samples the redundancy audit, "
+                "so it requires --guard-redundant"
             )
         rule = parse_rule3d(ns.rule)
 
@@ -466,6 +474,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         check_every=ns.guard_every,
                         max_restores=ns.guard_max_restores,
                         redundant=ns.guard_redundant,
+                        redundant_every=ns.guard_redundant_every,
                     ),
                     save_snapshot=save_snapshot,
                     checkpoint_every=ns.checkpoint_every,

@@ -204,6 +204,17 @@ class GuardConfig:
     # second bit-exact engine and require matching fingerprints.  Doubles
     # the audited compute; the only in-run detector for in-range flips.
     redundant: bool = False
+    # Sampling for the redundancy audit: recompute only every Nth audited
+    # chunk (starting with the first).  Overhead drops from 2x to
+    # ~(1 + 1/N)x of the guarded path; the trade is *coverage*, not
+    # latency — a flip landing in an unsampled chunk is carried into the
+    # recompute baseline and never caught, so per-corrupted-chunk
+    # detection probability is 1/N and a recurring fault source is caught
+    # within ~N audits in expectation.  (Catching every single flip
+    # fundamentally requires an unbroken independent recompute chain —
+    # i.e. N=1's full 2x.)  A replay forced by a redundant mismatch is
+    # always re-verified redundantly, whatever the sampling phase.
+    redundant_every: int = 1
 
     def __post_init__(self) -> None:
         if self.check_every < 1:
@@ -211,6 +222,15 @@ class GuardConfig:
         if self.max_restores < 0:
             raise ValueError(
                 f"max_restores must be >= 0, got {self.max_restores}"
+            )
+        if self.redundant_every < 1:
+            raise ValueError(
+                f"redundant_every must be >= 1, got {self.redundant_every}"
+            )
+        if self.redundant_every != 1 and not self.redundant:
+            raise ValueError(
+                "redundant_every samples the redundancy audit, so it "
+                "requires redundant=True"
             )
 
 
@@ -390,7 +410,12 @@ def guarded_loop(
             candidate = config.fault_hook(candidate, generation + take)
         with sw.phase("audit"):
             audit = audit_board(candidate, generation + take)
-        if checker_evolvers is not None and audit.ok:
+        # Sampling keys on the stable chunk index, so a sampled chunk's
+        # replays — after either a cheap-audit or a recompute failure —
+        # are re-verified redundantly, and failures cannot drift the
+        # sampling phase onto different chunks.
+        sampled = i % config.redundant_every == 0
+        if checker_evolvers is not None and audit.ok and sampled:
             # Redundant recompute of the same chunk from the same input
             # (last_good still holds it — it only advances below) on the
             # second engine; fingerprints of two independent programs can

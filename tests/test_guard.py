@@ -497,3 +497,124 @@ def test_corrupt_rollback_base_fails_loud(monkeypatch):
         guard.run_guarded(
             rt, 4, 6, guard.GuardConfig(check_every=3, fault_hook=fault_once)
         )
+
+# -- redundancy-audit sampling (--guard-redundant-every, round 3) ------------
+
+
+def test_sampled_redundant_catches_flip_in_sampled_chunk():
+    """N=4 sampling: a one-shot in-range flip landing in a SAMPLED chunk
+    is caught, rolled back, and replayed to the exact clean result.
+    (Pattern 4 is a corner blinker, so cell (20,20) is 0 on every clean
+    trajectory — the flip provably changes the board.)"""
+    geom = Geometry(size=32, num_ranks=2)
+    fired = []
+
+    def flip_once(board, generation):
+        if generation == 10 and not fired:  # audit ordinal 4: sampled
+            fired.append(generation)
+            return guard.inject_bitflip(board, 20, 20, value=1)
+        return board
+
+    rt = GolRuntime(geometry=geom)
+    _, state, greport = guard.run_guarded(
+        rt,
+        4,
+        16,
+        guard.GuardConfig(
+            check_every=2,
+            fault_hook=flip_once,
+            redundant=True,
+            redundant_every=4,
+        ),
+    )
+    assert greport.failures == 1 and greport.restores == 1
+    first_fail = next(i for i, a in enumerate(greport.audits) if not a.ok)
+    assert first_fail == 4  # the sampled ordinal
+    # Only sampled audits paid the recompute: ordinals 0 and 4 (plus 4's
+    # forced-redundant replay); the other audits are cheap.
+    unsampled = [greport.audits[i] for i in (1, 2, 3)]
+    assert all(a.ok and a.redundant_fingerprint is None for a in unsampled)
+    assert greport.audits[0].redundant_fingerprint is not None
+    np.testing.assert_array_equal(
+        np.asarray(state.board), _run_plain(geom, 4, 16)
+    )
+
+
+def test_sampled_redundant_documents_missed_coverage():
+    """The trade-off, pinned honestly: a one-shot flip in an UNSAMPLED
+    chunk is never caught (it becomes the recompute baseline)."""
+    geom = Geometry(size=32, num_ranks=2)
+    fired = []
+
+    def flip_once(board, generation):
+        if generation == 4 and not fired:  # audit 1: unsampled at N=4
+            fired.append(generation)
+            return guard.inject_bitflip(board, 2, 2, value=1)
+        return board
+
+    rt = GolRuntime(geometry=geom)
+    _, state, greport = guard.run_guarded(
+        rt,
+        4,
+        16,
+        guard.GuardConfig(
+            check_every=2,
+            fault_hook=flip_once,
+            redundant=True,
+            redundant_every=4,
+        ),
+    )
+    assert greport.failures == 0  # missed by design
+    with pytest.raises(AssertionError):
+        np.testing.assert_array_equal(
+            np.asarray(state.board), _run_plain(geom, 4, 16)
+        )
+
+
+def test_sampled_redundant_replay_stays_verified():
+    """A persistent fault first caught at a sampled audit must keep
+    failing its replays (force_redundant), exhausting the budget — never
+    slip through on an unsampled cheap-audit replay."""
+    geom = Geometry(size=32, num_ranks=2)
+
+    def always_flip(board, generation):
+        return guard.inject_bitflip(board, 1, 1, value=1)
+
+    rt = GolRuntime(geometry=geom)
+    with pytest.raises(guard.GuardError, match="redundant recompute"):
+        guard.run_guarded(
+            rt,
+            4,
+            8,
+            guard.GuardConfig(
+                check_every=2,
+                max_restores=2,
+                fault_hook=always_flip,
+                redundant=True,
+                redundant_every=4,
+            ),
+        )
+
+
+def test_redundant_every_validation():
+    with pytest.raises(ValueError, match="redundant_every"):
+        guard.GuardConfig(check_every=1, redundant_every=0)
+
+
+def test_cli_guard_redundant_every_flag(tmp_path, capsys, monkeypatch):
+    from gol_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    rc = cli.main(
+        ["4", "32", "8", "16", "0", "--guard-every", "2",
+         "--guard-redundant", "--guard-redundant-every", "2"]
+    )
+    assert rc == 0
+    assert "GUARD          : 4 checks, 0 failures" in capsys.readouterr().out
+    assert (
+        cli.main(
+            ["4", "32", "8", "16", "0", "--guard-every", "2",
+             "--guard-redundant-every", "2"]
+        )
+        == 255
+    )
