@@ -128,16 +128,27 @@ def main() -> None:
         raise SystemExit(1)
     best_name = max(results, key=lambda n: results[n][0])
     value, best_steps = results[best_name]
-    print(
-        json.dumps(
-            {
-                "metric": f"cell_updates_per_sec_per_chip@{size}^2x{best_steps}({best_name})",
-                "value": value,
-                "unit": "cell-updates/s",
-                "vs_baseline": value / PER_CHIP_TARGET,
-            }
+    line = {
+        "metric": f"cell_updates_per_sec_per_chip@{size}^2x{best_steps}({best_name})",
+        "value": value,
+        "unit": "cell-updates/s",
+        "vs_baseline": value / PER_CHIP_TARGET,
+    }
+    if best_name in ("pallas_bitpack", "pallas_ring"):
+        # Roofline attribution (utils/roofline.py): emitted lane-ops/s —
+        # including the temporal blocking's recomputed halo bands —
+        # against the v5e VPU issue-peak model.  The kernel is
+        # VPU-issue-bound: its HBM traffic at this shape is ~30 GB/s
+        # against ~819 GB/s peak, two orders below the bandwidth roof.
+        from gol_tpu.utils import roofline
+
+        rl = (
+            roofline.bench_roofline_2d(value, size, size, best_steps)
+            if best_name == "pallas_bitpack"
+            else roofline.bench_roofline_2d_ring(value, size, size)
         )
-    )
+        line["mfu_vpu"] = rl.as_dict()
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

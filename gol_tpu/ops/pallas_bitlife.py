@@ -587,6 +587,17 @@ def _pick_block(
     return max(1, k)
 
 
+def blocking_plan(
+    height: int, packed_width: int, steps: int, tile_hint: int
+) -> tuple:
+    """(tile, k) exactly as :func:`evolve` runs them — shared with the
+    roofline attribution (utils/roofline.py) so the reported
+    configuration cannot drift from the executed one."""
+    cap = min(tile_hint, _BLOCK_TILE) if steps > 1 else tile_hint
+    tile = pick_tile(height, packed_width, cap)
+    return tile, _pick_block(steps, tile)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3), donate_argnums=(0,))
 def evolve(
     board: jax.Array, steps: int, tile_hint: int = 512, rule=None
@@ -610,9 +621,7 @@ def evolve(
     # The blocked path prefers its own (smaller) tile: the k-deep scratch
     # plus temporaries must still fit VMEM.  Single-step runs keep the
     # caller's full hint — no pad, no reason to halve the tile.
-    cap = min(tile_hint, _BLOCK_TILE) if steps > 1 else tile_hint
-    tile = pick_tile(height, nw, cap)
-    k = _pick_block(steps, tile)
+    tile, k = blocking_plan(height, nw, steps, tile_hint)
     full, rem = divmod(steps, k)
     packed_i32 = lax.fori_loop(
         0,

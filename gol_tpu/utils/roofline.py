@@ -1,0 +1,182 @@
+"""Roofline/MFU attribution for the fused Pallas kernels (VERDICT r2 #4).
+
+The flagship kernels are bitwise VPU programs, so the meaningful
+"model-FLOPs-utilization" analog is **lane-ops/s against the VPU's vector
+issue peak**: every op processes one int32 word = 32 cells.  This module
+owns the arithmetic the benchmarks report: audited per-word op counts for
+each kernel, the halo-recompute multiplier of the temporal blocking, and
+the peak model.
+
+**Peak model** (documented assumption, not vendor-published): a TPU v5e
+TensorCore's VPU is an (8 sublanes × 128 lanes) vector unit with 4
+independent ALUs issuing per cycle at the public 940 MHz clock:
+
+    8 * 128 * 4 * 0.94e9 = 3.85e12 int32 lane-ops/s per chip.
+
+Counts treat every emitted vector op (roll/shift/and/or/xor/not/select)
+as one issue slot; XLA/Mosaic may fuse some (e.g. and-not) or add
+copies, so reported MFU is an estimate good to ~±15%, meant to answer
+"which resource binds, and how far from it are we" — not to be a cycle
+count.
+
+**Audited op counts** (per 32-cell word, per generation):
+
+2-D B3/S23 kernel (:func:`gol_tpu.ops.pallas_bitlife._one_generation`):
+  - horizontal stage, per *extended* row: 2 lane rolls + west (shift,
+    shift, mask, or = 4) + east (4) + full-adder (2 xor + 2 and + 1 or
+    = 5) = **15**;
+  - rule tail, per *output* row: ``_sum3_2bit`` (2 full adders + 4 = 14)
+    + ``eq3`` (4) + ``eq4`` (6) + combine (2) = **26**;
+  - lane-folded variant (``groups > 1``): + 2 rolls + 2 selects per
+    extended row = **19**/15.
+
+3-D Bays-4555 word-tiled kernel
+(:func:`gol_tpu.ops.pallas_bitlife3d._one_generation_wt`), per window
+word: x stage (2 shifts + 4 + 4 + 5) = 15; count-of-9 (4 lane rolls +
+14) = 18; count-of-27 (``_sum3_planes`` width 5: 4 full adders = 20 +
+zero-folded ripple ~19) = 39; count-of-26 (``_sub_bit`` over 5 planes,
+zero folds) = 13; rule match (B={5}: ~8, S={4,5}: ~17) = 25; combine 5 —
+**115** total.
+
+The temporal blocking recomputes halo bands: a tile of ``t`` rows stepped
+``k`` generations computes windows of ``t + 2(k-j)`` rows at step ``j``,
+so useful output pays a ``(t + k + 1)/t``-ish multiplier (exact sums
+below); the 3-D word tiling additionally pays ``(tw + 2)/tw`` on the word
+axis and ``(td + 2*pad)/td`` on the plane axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+V5E_VPU_LANE_OPS = 8 * 128 * 4 * 0.94e9  # ~3.85e12 int32 lane-ops/s
+
+# 2-D B3/S23 fused kernel, per word (see module docstring for the audit).
+OPS_2D_HSUM_PER_EXT_ROW = 15
+OPS_2D_HSUM_PER_EXT_ROW_FOLDED = 19
+OPS_2D_RULE_PER_OUT_ROW = 26
+# 3-D Bays-4555 word-tiled kernel, per window word per generation.
+OPS_3D_WT_PER_WORD = 115
+
+BITS = 32  # cells per packed word
+
+
+@dataclasses.dataclass(frozen=True)
+class Roofline:
+    """One kernel configuration's attribution."""
+
+    ops_per_useful_word: float  # incl. halo recompute
+    recompute_factor: float  # total windowed work / useful work
+    lane_ops_per_sec: float  # at the measured cell rate
+    mfu: float  # fraction of V5E_VPU_LANE_OPS
+
+    def as_dict(self) -> dict:
+        return {
+            "ops_per_useful_word": round(self.ops_per_useful_word, 2),
+            "recompute_factor": round(self.recompute_factor, 3),
+            "lane_ops_per_sec": float(f"{self.lane_ops_per_sec:.4g}"),
+            "mfu": round(self.mfu, 3),
+        }
+
+
+def ops_2d_per_useful_word(tile: int, k: int, folded: bool = False) -> float:
+    """Mean emitted ops per useful output word of the 2-D fused kernel.
+
+    A ``tile``-row window stepped ``k`` generations runs the horizontal
+    stage over ``tile + 2(k-j)`` rows and the rule tail over two fewer, at
+    step ``j``; useful output is ``tile * k`` word-rows.
+    """
+    h_ops = (
+        OPS_2D_HSUM_PER_EXT_ROW_FOLDED if folded else OPS_2D_HSUM_PER_EXT_ROW
+    )
+    total = 0.0
+    for j in range(k):
+        window = tile + 2 * (k - j)
+        total += window * h_ops + (window - 2) * OPS_2D_RULE_PER_OUT_ROW
+    return total / (tile * k)
+
+
+def recompute_2d(tile: int, k: int) -> float:
+    """Windowed rows / useful rows for the 2-D temporal blocking."""
+    return sum(tile + 2 * (k - j) for j in range(k)) / (tile * k)
+
+
+def roofline_2d(
+    cells_per_sec: float, tile: int, k: int, folded: bool = False
+) -> Roofline:
+    ops_word = ops_2d_per_useful_word(tile, k, folded)
+    lane_ops = cells_per_sec / BITS * ops_word
+    # Same per-row basis as the numerator, so the factor isolates the
+    # temporal-blocking recompute and never conflates fold overhead.
+    flat = (
+        OPS_2D_HSUM_PER_EXT_ROW_FOLDED
+        if folded
+        else OPS_2D_HSUM_PER_EXT_ROW
+    ) + OPS_2D_RULE_PER_OUT_ROW
+    return Roofline(
+        ops_per_useful_word=ops_word,
+        recompute_factor=ops_word / flat,
+        lane_ops_per_sec=lane_ops,
+        mfu=lane_ops / V5E_VPU_LANE_OPS,
+    )
+
+
+def ops_3d_wt_per_useful_word(tile_d: int, tile_w: int, k: int) -> float:
+    """Mean ops per useful word of the 3-D word-tiled kernel.
+
+    Window at step ``j``: ``(tile_w + 2)`` words × ``tile_d + 2(k-j)``
+    planes (the shrink runs on the plane axis; the ghost words are carried
+    the whole way); useful output ``tile_w * tile_d * k``.
+    """
+    total = 0.0
+    for j in range(k):
+        total += (tile_w + 2) * (tile_d + 2 * (k - j)) * OPS_3D_WT_PER_WORD
+    return total / (tile_w * tile_d * k)
+
+
+def roofline_3d_wt(
+    cells_per_sec: float, tile_d: int, tile_w: int, k: int
+) -> Roofline:
+    ops_word = ops_3d_wt_per_useful_word(tile_d, tile_w, k)
+    lane_ops = cells_per_sec / BITS * ops_word
+    return Roofline(
+        ops_per_useful_word=ops_word,
+        recompute_factor=ops_word / OPS_3D_WT_PER_WORD,
+        lane_ops_per_sec=lane_ops,
+        mfu=lane_ops / V5E_VPU_LANE_OPS,
+    )
+
+
+def bench_roofline_2d(
+    cells_per_sec: float, height: int, width: int, steps: int,
+    tile_hint: int = 1024,
+) -> Roofline:
+    """Attribution for ``pallas_bitlife.evolve`` exactly as the benchmark
+    runs it, via the engine's own :func:`~gol_tpu.ops.pallas_bitlife.
+    blocking_plan` — the reported configuration is the executed one."""
+    from gol_tpu.ops import bitlife, pallas_bitlife
+
+    tile, k = pallas_bitlife.blocking_plan(
+        height, bitlife.packed_width(width), steps, tile_hint
+    )
+    return roofline_2d(cells_per_sec, tile, k)
+
+
+def bench_roofline_2d_ring(
+    cells_per_sec: float, height: int, width: int
+) -> Roofline:
+    """Attribution for the sharded ring engine
+    (``packed.compiled_evolve_packed_pallas``) at its defaults, read off
+    the engine's own signature so a default change cannot drift this."""
+    import inspect
+
+    from gol_tpu.ops import bitlife, pallas_bitlife
+    from gol_tpu.parallel import packed
+
+    sig = inspect.signature(packed.compiled_evolve_packed_pallas)
+    k = sig.parameters["halo_depth"].default
+    hint = sig.parameters["tile_hint"].default
+    nw = bitlife.packed_width(width)
+    tile = pallas_bitlife.pick_tile(height, nw, hint)
+    folded = pallas_bitlife.fold_factor(nw) > 1
+    return roofline_2d(cells_per_sec, tile, k, folded)
