@@ -89,14 +89,16 @@ def _pallas3d_sharded_fits(mesh, size: int) -> bool:
     from gol_tpu.ops import bitlife, pallas_bitlife3d
     from gol_tpu.parallel.mesh import COLS, PLANES, ROWS
 
-    if mesh.shape.get(ROWS, 1) != 1 or size % 128:
+    planes = mesh.shape.get(PLANES, 1)
+    rows = mesh.shape.get(ROWS, 1)
+    if (planes != 1 and rows != 1) or size % 128:
         return False
-    d = size // mesh.shape.get(PLANES, 1)
+    band = size // (planes if rows == 1 else rows)
     nw = size // mesh.shape.get(COLS, 1) // bitlife.BITS
     return (
-        d >= 8
+        band >= 8
         and nw >= 1
-        and pallas_bitlife3d.pick_tile3d_wt(d, nw, size, 8) is not None
+        and pallas_bitlife3d.pick_tile3d_wt(band, nw, size, 8) is not None
     )
 
 
@@ -219,8 +221,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--engine", choices=ENGINES3D, default="auto")
     ext.add_argument("--mesh", choices=["none", "3d"], default="none")
     # Explicit (planes, rows, cols) factorization: the fused sharded
-    # kernel needs rows == 1 (H unsharded), which the default most-cubic
-    # factorization of 8 devices (2,2,2) is not.
+    # kernel needs one of planes/rows to be 1 ((P,1,C) or (1,R,C)),
+    # which the default most-cubic factorization of 8 devices (2,2,2)
+    # is not.
     ext.add_argument("--mesh-shape", default=None, metavar="P,R,C")
     ext.add_argument("--outdir", default=".")
     # Checkpoint/resume, mirroring the 2-D driver: periodic

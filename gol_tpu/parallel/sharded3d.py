@@ -162,10 +162,16 @@ def compiled_evolve3d_pallas(
     outer-ghost light cone already supports exactly this 1-word x halo
     for k <= 32 generations.
 
-    **Mesh constraint**: the ROWS axis must have size 1 (H unsharded) —
-    the kernel's h wrap is a lane roll, true only when the shard owns the
-    full H axis.  1024³ on 8 chips still has its pick of (8,1,1),
-    (4,1,2), (2,1,4), (1,1,8) decompositions.  A non-multiple-of-
+    **Mesh constraint**: at least one of the PLANES/ROWS axes must have
+    size 1.  The kernel's two non-word spatial axes are geometrically
+    interchangeable: its *sublane* axis carries the exchanged band
+    (slices, shrink-per-generation) and its *lane* axis wraps with a
+    local roll — so the lane axis must be the volume axis the mesh does
+    NOT shard.  ``rows == 1`` runs the natural ``[nw, D, H]`` layout
+    (band over the PLANES ring, lanes = H); ``planes == 1`` transposes
+    to ``[nw, H, D]`` (band over the ROWS ring, lanes = D).  Meshes
+    sharding *both* D and H (e.g. (2,2,2)) are rejected — every device
+    count factors as (P,1,C) or (1,R,C) instead.  A non-multiple-of-
     ``halo_depth`` remainder of ``steps`` runs on the XLA packed step.
     """
     from gol_tpu.ops import bitlife, bitlife3d, pallas_bitlife3d
@@ -174,13 +180,19 @@ def compiled_evolve3d_pallas(
     num_planes = mesh.shape.get(PLANES, 1)
     num_rows = mesh.shape.get(ROWS, 1)
     num_cols = mesh.shape.get(COLS, 1)
-    if num_rows != 1:
+    if num_planes != 1 and num_rows != 1:
         raise ValueError(
-            "the sharded 3-D Pallas engine needs an H-unsharded mesh "
-            "(rows axis of size 1): the kernel's h wrap is a lane roll, "
-            f"true only when the shard owns the full H; got mesh "
-            f"{dict(mesh.shape)}"
+            "the sharded 3-D Pallas engine needs an H-unsharded or "
+            "D-unsharded mesh (planes or rows axis of size 1): the "
+            "kernel's lane wrap is a local roll, true only when the "
+            f"shard owns that full axis; got mesh {dict(mesh.shape)} — "
+            "factor the devices as (P,1,C) or (1,R,C) instead"
         )
+    # Band rides whichever of the two spatial axes the mesh shards; the
+    # other becomes the kernel's lane axis.
+    band_over_planes = num_rows == 1
+    band_axis_name = PLANES if band_over_planes else ROWS
+    band_ring = num_planes if band_over_planes else num_rows
     if halo_depth < 8 or halo_depth % 8:
         raise ValueError(
             f"the sharded 3-D Pallas engine needs halo_depth to be a "
@@ -200,9 +212,11 @@ def compiled_evolve3d_pallas(
 
     def chunk(pw, tile_d, tile_w):
         # Two-phase exchange; x ghost words sliced from the already
-        # plane-extended array carry the x/d corner planes for free.
-        top = lax.ppermute(pw[:, -pad:], PLANES, ring(num_planes, 1))
-        bot = lax.ppermute(pw[:, :pad], PLANES, ring(num_planes, -1))
+        # band-extended array carry the x/band corner data for free.
+        # ``pw``'s middle axis is whichever spatial axis the mesh shards
+        # (D in the natural layout, H in the transposed one).
+        top = lax.ppermute(pw[:, -pad:], band_axis_name, ring(band_ring, 1))
+        bot = lax.ppermute(pw[:, :pad], band_axis_name, ring(band_ring, -1))
         ext_d = jnp.concatenate([top, pw, bot], axis=1)
         left = lax.ppermute(ext_d[-1:], COLS, ring(num_cols, 1))
         right = lax.ppermute(ext_d[:1], COLS, ring(num_cols, -1))
@@ -214,32 +228,46 @@ def compiled_evolve3d_pallas(
     def local(vol):
         d, h, w = vol.shape  # per-shard block (static under shard_map)
         nw = w // bitlife.BITS
-        if jax.default_backend() == "tpu" and h % 128:
+        # Kernel-axis mapping: band = the sharded spatial axis, lanes =
+        # the unsharded one (see the mesh-constraint note above).
+        band_extent, lane_extent = (d, h) if band_over_planes else (h, d)
+        if jax.default_backend() == "tpu" and lane_extent % 128:
             raise ValueError(
-                "the sharded 3-D Pallas engine needs the (unsharded) H "
-                f"axis to fill whole 128-lane tiles on TPU, got H={h}"
+                "the sharded 3-D Pallas engine needs the unsharded "
+                f"{'H' if band_over_planes else 'D'} axis to fill whole "
+                f"128-lane tiles on TPU, got {lane_extent}"
             )
-        if d < pad:
+        if band_extent < pad:
             raise ValueError(
-                f"shard depth {d} < exchanged plane band {pad}: the ghost "
-                "band would need planes from beyond the ring neighbor"
+                f"shard extent {band_extent} on the banded axis < "
+                f"exchanged band {pad}: the ghost band would need layers "
+                "from beyond the ring neighbor"
             )
-        wt = pallas_bitlife3d.pick_tile3d_wt(d, nw, h, pad)
+        wt = pallas_bitlife3d.pick_tile3d_wt(
+            band_extent, nw, lane_extent, pad
+        )
         if wt is None:
             raise ValueError(
                 f"no word-tiled kernel window fits scoped VMEM for shard "
                 f"{(d, h, w)} at band depth {pad}"
             )
         tile_d, tile_w = wt
-        packed = lax.bitcast_convert_type(
+        packed3 = lax.bitcast_convert_type(
             bitlife3d.pack3d(vol), jnp.int32
-        ).transpose(2, 0, 1)  # word-leading [nw, d, h]
+        )  # [d, h, nw]
+        # Natural: [nw, d, h] (band=d, lanes=h); transposed: [nw, h, d].
+        packed = packed3.transpose(
+            (2, 0, 1) if band_over_planes else (2, 1, 0)
+        )
         if full:
             packed = lax.fori_loop(
                 0, full, lambda _, p: chunk(p, tile_d, tile_w), packed
             )
         p3 = lax.bitcast_convert_type(
-            packed.transpose(1, 2, 0), jnp.uint32
+            packed.transpose(
+                (1, 2, 0) if band_over_planes else (2, 1, 0)
+            ),
+            jnp.uint32,
         )
         if rem:
             # Leftover generations on the XLA packed step, one exchange

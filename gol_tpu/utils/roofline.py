@@ -163,11 +163,12 @@ def bench_roofline_2d(
 
 
 def bench_roofline_2d_ring(
-    cells_per_sec: float, height: int, width: int
+    cells_per_sec: float, height: int, width: int, num_devices: int = 1
 ) -> Roofline:
-    """Attribution for the sharded ring engine
+    """Attribution for the 1-D sharded ring engine
     (``packed.compiled_evolve_packed_pallas``) at its defaults, read off
-    the engine's own signature so a default change cannot drift this."""
+    the engine's own signature, with the engine's shard-height and
+    lane-fold tile derivation mirrored (packed.py ``local``)."""
     import inspect
 
     from gol_tpu.ops import bitlife, pallas_bitlife
@@ -176,7 +177,12 @@ def bench_roofline_2d_ring(
     sig = inspect.signature(packed.compiled_evolve_packed_pallas)
     k = sig.parameters["halo_depth"].default
     hint = sig.parameters["tile_hint"].default
-    nw = bitlife.packed_width(width)
-    tile = pallas_bitlife.pick_tile(height, nw, hint)
-    folded = pallas_bitlife.fold_factor(nw) > 1
+    nw = bitlife.packed_width(width)  # 1-D ring: width unsharded
+    shard_h = height // num_devices
+    fold = pallas_bitlife.fold_factor(nw)
+    folded = fold > 1 and shard_h % (fold * 8) == 0
+    if folded:
+        tile = pallas_bitlife.pick_tile(shard_h // fold, fold * nw, hint)
+    else:
+        tile = pallas_bitlife.pick_tile(shard_h, nw, hint)
     return roofline_2d(cells_per_sec, tile, k, folded)

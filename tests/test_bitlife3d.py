@@ -197,9 +197,30 @@ def test_sharded3d_pallas_rejections():
     # Shard depth below the exchanged plane band.
     shallow = _vol3((8, 128, 128), seed=1)
     mesh8 = mesh_mod.make_mesh_3d((8, 1, 1), devices=jax.devices()[:8])
-    with pytest.raises(Exception, match="plane band"):
+    with pytest.raises(Exception, match="exchanged band"):
         np.asarray(
             sharded3d.evolve_sharded3d_pallas(
                 jnp.asarray(shallow), 8, mesh8
             )
         )
+
+
+@pytest.mark.parametrize("shape", [(1, 2, 4), (1, 8, 1), (1, 4, 2)])
+@pytest.mark.parametrize("steps", [8, 19])
+def test_sharded3d_pallas_h_sharded_transposed_layout(shape, steps):
+    """planes == 1 meshes run the transposed kernel layout (band over the
+    ROWS ring, lanes = the unsharded D axis) — same kernel, axes
+    relabeled; byte-equality against the dense oracle."""
+    n = shape[0] * shape[1] * shape[2]
+    mesh = mesh_mod.make_mesh_3d(shape, devices=jax.devices()[:n])
+    vol = _vol3((128, 64, 256), seed=100 + sum(shape) + steps)
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), steps, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, steps))
+
+
+def test_sharded3d_pallas_rejects_doubly_sharded_spatial_axes():
+    mesh = mesh_mod.make_mesh_3d((2, 2, 2), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match=r"\(P,1,C\) or \(1,R,C\)"):
+        sharded3d.compiled_evolve3d_pallas(mesh, 8)

@@ -59,23 +59,29 @@ def test_folded_costs_more_per_row():
     assert folded > plain
     assert (folded - plain) < 5  # ~4 extra ops on the hsum stage
 
-def test_ring_attribution_reads_engine_defaults():
-    """The ring attribution must follow the engine's signature defaults."""
-    import inspect
-
-    from gol_tpu.parallel import packed
-
-    sig = inspect.signature(packed.compiled_evolve_packed_pallas)
+def test_ring_attribution_matches_engine_tiling():
+    """The ring attribution must mirror the engine's own shard/fold tile
+    derivation — pinned against hand-derived expected configurations, not
+    by re-running the attribution's implementation."""
+    # Wide single-device ring at the bench geometry: nw=512 fills lanes,
+    # no fold; engine defaults tile_hint=128, halo_depth=8 -> tile 128.
     r = roofline.bench_roofline_2d_ring(1.8e12, 16384, 16384)
-    k = sig.parameters["halo_depth"].default
-    from gol_tpu.ops import bitlife, pallas_bitlife
-
-    tile = pallas_bitlife.pick_tile(
-        16384, bitlife.packed_width(16384),
-        sig.parameters["tile_hint"].default,
-    )
     assert r.ops_per_useful_word == pytest.approx(
-        roofline.ops_2d_per_useful_word(tile, k)
+        roofline.ops_2d_per_useful_word(128, 8)
+    )
+    # Folded narrow board: nw=32 -> fold=4; the engine tiles the FOLDED
+    # height 640/4=160 (largest dividing 8-multiple <= 128 is 80), not
+    # the unfolded pick(640, 32).
+    r = roofline.bench_roofline_2d_ring(1e12, 640, 1024)
+    assert r.ops_per_useful_word == pytest.approx(
+        roofline.ops_2d_per_useful_word(80, 8, folded=True)
+    )
+    # Multi-device ring tiles the shard height, not the global height:
+    # 4 devices over 512 rows -> shard 128 -> tile 128 even though the
+    # global height would allow bigger windows.
+    r = roofline.bench_roofline_2d_ring(1e12, 512, 16384, num_devices=4)
+    assert r.ops_per_useful_word == pytest.approx(
+        roofline.ops_2d_per_useful_word(128, 8)
     )
 
 
