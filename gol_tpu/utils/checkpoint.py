@@ -269,11 +269,12 @@ def load3d(path: str) -> Snapshot3D:
 
 # -- sharded checkpoints (multi-host: no host materializes the board) --------
 #
-# Layout of a ``ckpt_<gen>.gol.d/`` directory:
-#   manifest.npz          — geometry + the full piece table (rect -> writer
+# Layout of a sharded checkpoint directory (2-D ``ckpt_<gen>.gol.d/`` and 3-D
+# ``ckpt3d_<gen>.gol3d.d/`` share it):
+#   manifest.npz          — geometry + the full piece table (box -> writer
 #                           process), identical on every host by construction
-#   shards_<proc>.npz     — that process's pieces: one array per rectangle of
-#                           the board it owns, each stamped with a
+#   shards_<proc>.npz     — that process's pieces: one array per box of the
+#                           board/volume it owns, each stamped with a
 #                           global-offset fingerprint
 #
 # The piece table is computed deterministically on every process from
@@ -281,11 +282,18 @@ def load3d(path: str) -> Snapshot3D:
 # ``multihost.write_host_dumps``), so save needs zero coordination traffic;
 # the only collective is the caller's barrier after the files land.  Because
 # the fingerprint is a position-weighted sum mod 2^32
-# (:func:`gol_tpu.utils.guard.fingerprint_np`), the per-piece stamps of the
-# disjoint cover add up to the whole board's fingerprint — so a global
-# audit stamp can be verified at load without assembling the board.
+# (:func:`gol_tpu.utils.guard.fingerprint_np`; 3-D volumes under their
+# ``[D*H, W]`` flattening), the per-piece stamps of the disjoint cover add
+# up to the whole array's fingerprint — so a global audit stamp can be
+# verified at load without assembling the data.
+#
+# Everything dimension-independent lives in the ``_nd`` helpers below; the
+# 2-D and 3-D formats are thin wrappers differing only in box arity
+# (``(r0, r1, c0, c1)`` vs ``(d0, d1, r0, r1, c0, c1)``), piece fingerprint
+# offsets, and manifest fields.
 
 SHARD_DIR_SUFFIX = ".gol.d"
+SHARD3D_DIR_SUFFIX = ".gol3d.d"
 _MANIFEST = "manifest.npz"
 
 
@@ -295,13 +303,19 @@ def sharded_checkpoint_path(directory: str, generation: int) -> str:
     )
 
 
+def sharded_checkpoint3d_path(directory: str, generation: int) -> str:
+    return os.path.join(
+        directory, f"ckpt3d_{generation:012d}{SHARD3D_DIR_SUFFIX}"
+    )
+
+
 def is_sharded(path: str) -> bool:
     return os.path.isdir(path)
 
 
 @dataclasses.dataclass(frozen=True)
 class ShardedMeta:
-    """The manifest: everything except the board data itself."""
+    """The 2-D manifest: everything except the board data itself."""
 
     shape: tuple
     generation: int
@@ -312,17 +326,126 @@ class ShardedMeta:
     fingerprint: Optional[int]  # global stamp (guard audit), if known
 
 
-def _piece_table(sharding, shape):
-    """Deterministic (rect -> lowest owning process) map, same on all hosts."""
-    from gol_tpu.parallel.multihost import _rect
+@dataclasses.dataclass(frozen=True)
+class Sharded3DMeta:
+    """The 3-D manifest: everything except the volume data itself."""
 
+    shape: tuple
+    generation: int
+    rule: str
+    boxes: np.ndarray  # [n, 6] (d0, d1, r0, r1, c0, c1) disjoint cover
+    procs: np.ndarray  # [n] writer process per box
+    fingerprint: Optional[int]
+
+
+def fingerprint3d_np(
+    piece: np.ndarray, d0: int, r0: int, c0: int, global_h: int
+) -> int:
+    """Additive stamp of a 3-D piece at global offset ``(d0, r0, c0)``.
+
+    Computed under the volume's ``[D*H, W]`` flattening (plane ``d`` row
+    ``r`` lands at flattened row ``d*H + r``), so the stamps of a disjoint
+    box cover sum mod 2^32 to :func:`_vol_fingerprint` of the whole
+    volume.
+    """
+    from gol_tpu.utils.guard import fingerprint_np
+
+    total = np.uint32(0)
+    with np.errstate(over="ignore"):
+        for di in range(piece.shape[0]):
+            total = total + np.uint32(
+                fingerprint_np(piece[di], (d0 + di) * global_h + r0, c0)
+            )
+    return int(total)
+
+
+def _piece_fp(piece: np.ndarray, box, shape) -> int:
+    """Global-offset fingerprint of one piece, 2-D or 3-D by arity."""
+    from gol_tpu.utils.guard import fingerprint_np
+
+    if len(box) == 4:
+        return fingerprint_np(piece, box[0], box[2])
+    return fingerprint3d_np(piece, box[0], box[2], box[4], shape[1])
+
+
+def _box_nd(idx, shape):
+    """Decode a shard index (tuple of slices) into a flat 2*ndim box."""
+    out = []
+    sl = list(idx) + [slice(None)] * (len(shape) - len(idx))
+    for s, dim in zip(sl, shape):
+        out.append(0 if s.start is None else s.start)
+        out.append(dim if s.stop is None else s.stop)
+    return tuple(out)
+
+
+def _piece_table_nd(sharding, shape):
+    """Deterministic (box -> lowest owning process) map, same on all hosts."""
     owner = {}
     for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
-        r = _rect(idx, shape)
+        b = _box_nd(idx, shape)
         p = dev.process_index
-        if r not in owner or p < owner[r]:
-            owner[r] = p
+        if b not in owner or p < owner[b]:
+            owner[b] = p
     return owner
+
+
+def _save_sharded_nd(dirpath: str, arr, box_key: str, manifest_fields):
+    """Write this process's pieces + (process 0) the manifest.
+
+    The dimension-independent core of :func:`save_sharded` /
+    :func:`save_sharded3d`: every process writes one ``shards_<proc>.npz``
+    holding exactly the boxes assigned to it (lowest process index owning
+    a box writes it — replicas dedupe), and process 0 additionally writes
+    the manifest.  No process ever holds more than its own addressable
+    shards; the caller is responsible for a barrier before using the
+    checkpoint.  Returns the paths this process wrote.
+    """
+    import jax
+
+    os.makedirs(dirpath, exist_ok=True)
+    shape = tuple(arr.shape)
+    owner = _piece_table_nd(arr.sharding, shape)
+    me = jax.process_index()
+    written = []
+    pieces, seen = [], set()
+    for shard in arr.addressable_shards:
+        b = _box_nd(shard.index, shape)
+        if owner[b] != me or b in seen:
+            continue
+        seen.add(b)
+        pieces.append((b, np.asarray(shard.data, np.uint8)))
+    arity = 2 * len(shape)
+    arrays = {
+        box_key: np.asarray(
+            [b for b, _ in pieces], np.int64
+        ).reshape(-1, arity),
+        "fps": np.asarray(
+            [_piece_fp(data, b, shape) for b, data in pieces], np.uint32
+        ),
+    }
+    for i, (_, data) in enumerate(pieces):
+        arrays[f"piece_{i}"] = data
+    path = os.path.join(dirpath, f"shards_{me:05d}.npz")
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path)
+    written.append(path)
+    if me == 0:
+        table = sorted(owner.items())
+        manifest = dict(
+            shape=np.asarray(shape, np.int64),
+            **manifest_fields,
+        )
+        manifest[box_key] = np.asarray(
+            [b for b, _ in table], np.int64
+        ).reshape(-1, arity)
+        manifest["procs"] = np.asarray([p for _, p in table], np.int64)
+        mpath = os.path.join(dirpath, _MANIFEST)
+        tmp = mpath + ".tmp.npz"
+        np.savez_compressed(tmp, **manifest)
+        os.replace(tmp, mpath)
+        written.append(mpath)
+    return written
 
 
 def save_sharded(
@@ -335,74 +458,109 @@ def save_sharded(
 ) -> list:
     """Write this process's pieces of a sharded board (collective call).
 
-    Every process calls this; each writes one ``shards_<proc>.npz`` holding
-    exactly the rectangles assigned to it (lowest process index owning a
-    rect writes it — replicas dedupe), and process 0 additionally writes
-    the manifest.  No process ever holds more than its own addressable
-    shards.  The caller is responsible for a barrier before using the
-    checkpoint (``runtime._save_snapshot`` fences with
-    ``sync_global_devices``).  Returns the paths this process wrote.
+    See :func:`_save_sharded_nd` for the write protocol; the caller fences
+    with a barrier before relying on the checkpoint
+    (``runtime._save_snapshot`` uses ``sync_global_devices``).
     """
-    import jax
-
-    from gol_tpu.parallel.multihost import _rect
-    from gol_tpu.utils.guard import fingerprint_np
-
-    os.makedirs(dirpath, exist_ok=True)
-    sharding = arr.sharding
-    shape = tuple(arr.shape)
-    owner = _piece_table(sharding, shape)
-    me = jax.process_index()
-    written = []
-    pieces, seen = [], set()
-    for shard in arr.addressable_shards:
-        r = _rect(shard.index, shape)
-        if owner[r] != me or r in seen:
-            continue
-        seen.add(r)
-        pieces.append((r, np.asarray(shard.data, np.uint8)))
-    arrays = dict(
-        rects=np.asarray([r for r, _ in pieces], np.int64).reshape(-1, 4),
-        fps=np.asarray(
-            [
-                fingerprint_np(data, r0, c0)
-                for (r0, _, c0, _), data in pieces
-            ],
-            np.uint32,
-        ),
+    fields = dict(
+        generation=np.int64(generation), num_ranks=np.int64(num_ranks)
     )
-    for i, (_, data) in enumerate(pieces):
-        arrays[f"piece_{i}"] = data
-    path = os.path.join(dirpath, f"shards_{me:05d}.npz")
-    tmp = path + ".tmp.npz"
-    np.savez_compressed(tmp, **arrays)
-    os.replace(tmp, path)
-    written.append(path)
-    if me == 0:
-        table = sorted(owner.items())
-        manifest = dict(
-            shape=np.asarray(shape, np.int64),
-            generation=np.int64(generation),
-            num_ranks=np.int64(num_ranks),
-            rects=np.asarray([r for r, _ in table], np.int64).reshape(-1, 4),
-            procs=np.asarray([p for _, p in table], np.int64),
+    if rule is not None:
+        fields["rule"] = np.asarray(rule)
+    if fingerprint is not None:
+        fields["fingerprint"] = np.uint32(fingerprint)
+    return _save_sharded_nd(dirpath, arr, "rects", fields)
+
+
+def save_sharded3d(
+    dirpath: str,
+    arr,
+    generation: int,
+    rule: str,
+    fingerprint: Optional[int] = None,
+) -> list:
+    """3-D counterpart of :func:`save_sharded` (same write protocol)."""
+    fields = dict(generation=np.int64(generation), rule=np.asarray(rule))
+    if fingerprint is not None:
+        fields["fingerprint"] = np.uint32(fingerprint)
+    return _save_sharded_nd(dirpath, arr, "boxes", fields)
+
+
+def _validate_box_cover(dirpath: str, shape, boxes) -> list:
+    """Bounds + exact-measure + pairwise-disjointness of a box cover.
+
+    In-bounds + exact total measure only proves a tiling if the boxes are
+    also pairwise disjoint; overlapping boxes that happen to sum to the
+    array's size would otherwise let a region read double-count coverage
+    and return ``np.empty`` garbage in the genuinely uncovered cells.
+    Piece counts are O(hosts), so the quadratic sweep is cheap.  Returns
+    the boxes as sorted int tuples.
+    """
+    ndim = len(shape)
+    measure_total = 0
+    out = []
+    for row in boxes:
+        b = tuple(int(x) for x in row)
+        ok = all(
+            0 <= b[2 * a] < b[2 * a + 1] <= shape[a] for a in range(ndim)
         )
-        if rule is not None:
-            manifest["rule"] = np.asarray(rule)
-        if fingerprint is not None:
-            manifest["fingerprint"] = np.uint32(fingerprint)
-        mpath = os.path.join(dirpath, _MANIFEST)
-        tmp = mpath + ".tmp.npz"
-        np.savez_compressed(tmp, **manifest)
-        os.replace(tmp, mpath)
-        written.append(mpath)
-    return written
+        if not ok:
+            raise CorruptSnapshotError(
+                f"{dirpath}: piece box {b} falls outside the "
+                f"{'x'.join(map(str, shape))} array; the manifest is corrupt"
+            )
+        m = 1
+        for a in range(ndim):
+            m *= b[2 * a + 1] - b[2 * a]
+        measure_total += m
+        out.append(b)
+    total = 1
+    for dim in shape:
+        total *= dim
+    if measure_total != total:
+        raise CorruptSnapshotError(
+            f"{dirpath}: piece table covers {measure_total} cells of "
+            f"{total}; the manifest is corrupt or incomplete"
+        )
+    out.sort()
+    for i, a in enumerate(out):
+        for b in out[i + 1 :]:
+            if b[0] >= a[1]:
+                break  # sorted by the leading axis: no later overlap
+            if all(
+                b[2 * ax] < a[2 * ax + 1] and b[2 * ax + 1] > a[2 * ax]
+                for ax in range(1, ndim)
+            ):
+                raise CorruptSnapshotError(
+                    f"{dirpath}: piece boxes {a} and {b} overlap; the "
+                    "manifest is corrupt"
+                )
+    return out
+
+
+def _verify_global_stamp(dirpath: str, procs, stamp: int) -> None:
+    """sum(per-piece fingerprints) must equal the stamped global hash."""
+    total = np.uint32(0)
+    with np.errstate(over="ignore"):
+        for proc in sorted(set(int(p) for p in procs)):
+            with np.load(
+                os.path.join(dirpath, f"shards_{proc:05d}.npz")
+            ) as sf:
+                total = total + np.sum(
+                    sf["fps"].astype(np.uint32), dtype=np.uint32
+                )
+    if int(total) != stamp:
+        raise CorruptSnapshotError(
+            f"{dirpath}: piece fingerprints sum to {int(total):#010x} "
+            f"!= stamped {stamp:#010x}; some shard file is corrupt"
+        )
 
 
 def load_sharded_meta(dirpath: str) -> ShardedMeta:
-    """Read + validate the manifest: the cover must tile the board exactly,
-    and (when a global stamp is present) the per-piece fingerprints must
-    add up to it — both checked without assembling any board data."""
+    """Read + validate the 2-D manifest: the cover must tile the board
+    exactly, and (when a global stamp is present) the per-piece
+    fingerprints must add up to it — both checked without assembling any
+    board data."""
     import zipfile
 
     try:
@@ -428,286 +586,14 @@ def load_sharded_meta(dirpath: str) -> ShardedMeta:
             f"{dirpath}: malformed 2-D manifest geometry "
             f"(shape {meta.shape}, rect table {meta.rects.shape})"
         )
-    h, w = meta.shape
-    area = 0
-    rects = []
-    for r0, r1, c0, c1 in meta.rects:
-        r0, r1, c0, c1 = int(r0), int(r1), int(c0), int(c1)
-        if not (0 <= r0 < r1 <= h and 0 <= c0 < c1 <= w):
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece rect ({r0},{r1},{c0},{c1}) falls outside "
-                f"the {h}x{w} board; the manifest is corrupt"
-            )
-        area += (r1 - r0) * (c1 - c0)
-        rects.append((r0, r1, c0, c1))
-    if area != h * w:
-        raise CorruptSnapshotError(
-            f"{dirpath}: piece table covers {area} cells of {h * w}; the "
-            "manifest is corrupt or incomplete"
-        )
-    # In-bounds + exact total area only proves a tiling if the rects are
-    # also pairwise disjoint; overlapping rects that happen to sum to h*w
-    # would otherwise let read_sharded_region double-count coverage and
-    # return np.empty garbage in the genuinely uncovered cells.  Piece
-    # counts are O(hosts), so the quadratic check is cheap.
-    rects.sort()
-    for i, (r0, r1, c0, c1) in enumerate(rects):
-        for q0, q1, s0, s1 in rects[i + 1 :]:
-            if q0 >= r1:
-                break  # sorted by r0: no later rect can overlap rows
-            # rows overlap (r0 <= q0 < r1); overlap iff columns intersect
-            if s1 > c0 and s0 < c1:
-                raise CorruptSnapshotError(
-                    f"{dirpath}: piece rects ({r0},{r1},{c0},{c1}) and "
-                    f"({q0},{q1},{s0},{s1}) overlap; the manifest is corrupt"
-                )
+    _validate_box_cover(dirpath, meta.shape, meta.rects)
     if meta.fingerprint is not None:
-        total = np.uint32(0)
-        with np.errstate(over="ignore"):
-            for proc in sorted(set(int(p) for p in meta.procs)):
-                with np.load(
-                    os.path.join(dirpath, f"shards_{proc:05d}.npz")
-                ) as sf:
-                    total = total + np.sum(
-                        sf["fps"].astype(np.uint32), dtype=np.uint32
-                    )
-        if int(total) != meta.fingerprint:
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece fingerprints sum to {int(total):#010x} "
-                f"!= stamped {meta.fingerprint:#010x}; some shard file is "
-                "corrupt"
-            )
+        _verify_global_stamp(dirpath, meta.procs, meta.fingerprint)
     return meta
 
 
-def read_sharded_region(
-    dirpath: str, meta: ShardedMeta, index
-) -> np.ndarray:
-    """Assemble one rectangular region from the piece files.
-
-    ``index`` is a tuple of slices over the global board (the contract of
-    ``jax.make_array_from_callback``, so a resuming host reads *only* the
-    rows its devices own).  Each piece consulted is fingerprint-verified
-    once per call; pieces that don't intersect the region are never read.
-    """
-    h, w = meta.shape
-    rs, cs = index[0], index[1] if len(index) > 1 else slice(None)
-    lo_r = 0 if rs.start is None else rs.start
-    hi_r = h if rs.stop is None else rs.stop
-    lo_c = 0 if cs.start is None else cs.start
-    hi_c = w if cs.stop is None else cs.stop
-    out = np.empty((hi_r - lo_r, hi_c - lo_c), np.uint8)
-    filled = 0
-    by_proc = {}
-    try:
-        filled = _fill_region(
-            dirpath, meta, out, lo_r, hi_r, lo_c, hi_c, by_proc
-        )
-    finally:
-        for sf in by_proc.values():
-            sf.close()
-    if filled != out.size:
-        raise CorruptSnapshotError(
-            f"{dirpath}: region {index} only covered {filled} of "
-            f"{out.size} cells"
-        )
-    return out
-
-
-def _fill_region(dirpath, meta, out, lo_r, hi_r, lo_c, hi_c, by_proc):
-    """Copy every intersecting, fingerprint-verified piece into ``out``;
-    opened shard files land in ``by_proc`` for the caller to close."""
-    from gol_tpu.utils.guard import fingerprint_np
-
-    filled = 0
-    for (r0, r1, c0, c1), proc in zip(meta.rects, meta.procs):
-        r0, r1, c0, c1 = int(r0), int(r1), int(c0), int(c1)
-        i0, i1 = max(r0, lo_r), min(r1, hi_r)
-        j0, j1 = max(c0, lo_c), min(c1, hi_c)
-        if i0 >= i1 or j0 >= j1:
-            continue
-        proc = int(proc)
-        if proc not in by_proc:
-            by_proc[proc] = np.load(
-                os.path.join(dirpath, f"shards_{proc:05d}.npz")
-            )
-        sf = by_proc[proc]
-        rects = sf["rects"]
-        hit = np.nonzero(
-            (rects[:, 0] == r0)
-            & (rects[:, 1] == r1)
-            & (rects[:, 2] == c0)
-            & (rects[:, 3] == c1)
-        )[0]
-        if hit.size != 1:
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece ({r0},{r1},{c0},{c1}) missing from "
-                f"shards_{proc:05d}.npz"
-            )
-        k = int(hit[0])
-        data = sf[f"piece_{k}"].astype(np.uint8)
-        if data.shape != (r1 - r0, c1 - c0):
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece ({r0},{r1},{c0},{c1}) has shape "
-                f"{data.shape}"
-            )
-        stored = int(sf["fps"][k])
-        actual = fingerprint_np(data, r0, c0)
-        if stored != actual:
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece ({r0},{r1},{c0},{c1}) fingerprint "
-                f"{actual:#010x} != stored {stored:#010x}; the shard file "
-                "is corrupt"
-            )
-        out[i0 - lo_r : i1 - lo_r, j0 - lo_c : j1 - lo_c] = data[
-            i0 - r0 : i1 - r0, j0 - c0 : j1 - c0
-        ]
-        filled += (i1 - i0) * (j1 - j0)
-    return filled
-
-
-# -- sharded 3-D checkpoints (the 3-D driver's multi-host persistence) -------
-#
-# Same design as the 2-D sharded format: per-process piece files + a
-# deterministic manifest, position-weighted additive fingerprints under the
-# volume's [D*H, W] flattening (matching ``_vol_fingerprint``), so a global
-# stamp verifies without any host assembling the volume.  Pieces are 3-D
-# boxes ``(d0, d1, r0, r1, c0, c1)``.
-
-SHARD3D_DIR_SUFFIX = ".gol3d.d"
-
-
-def sharded_checkpoint3d_path(directory: str, generation: int) -> str:
-    return os.path.join(
-        directory, f"ckpt3d_{generation:012d}{SHARD3D_DIR_SUFFIX}"
-    )
-
-
-@dataclasses.dataclass(frozen=True)
-class Sharded3DMeta:
-    """The 3-D manifest: everything except the volume data itself."""
-
-    shape: tuple
-    generation: int
-    rule: str
-    boxes: np.ndarray  # [n, 6] (d0, d1, r0, r1, c0, c1) disjoint cover
-    procs: np.ndarray  # [n] writer process per box
-    fingerprint: Optional[int]
-
-
-def _box(idx, shape):
-    """Decode a 3-D shard index (tuple of slices) into a 6-tuple box."""
-    out = []
-    sl = list(idx) + [slice(None)] * (3 - len(idx))
-    for s, dim in zip(sl, shape):
-        out.append(0 if s.start is None else s.start)
-        out.append(dim if s.stop is None else s.stop)
-    return tuple(out)
-
-
-def fingerprint3d_np(
-    piece: np.ndarray, d0: int, r0: int, c0: int, global_h: int
-) -> int:
-    """Additive stamp of a 3-D piece at global offset ``(d0, r0, c0)``.
-
-    Computed under the volume's ``[D*H, W]`` flattening (plane ``d`` row
-    ``r`` lands at flattened row ``d*H + r``), so the stamps of a disjoint
-    box cover sum mod 2^32 to :func:`_vol_fingerprint` of the whole
-    volume.
-    """
-    from gol_tpu.utils.guard import fingerprint_np
-
-    total = np.uint32(0)
-    with np.errstate(over="ignore"):
-        for di in range(piece.shape[0]):
-            total = total + np.uint32(
-                fingerprint_np(piece[di], (d0 + di) * global_h + r0, c0)
-            )
-    return int(total)
-
-
-def _piece_table3d(sharding, shape):
-    """Deterministic (box -> lowest owning process) map, same on all hosts."""
-    owner = {}
-    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
-        b = _box(idx, shape)
-        p = dev.process_index
-        if b not in owner or p < owner[b]:
-            owner[b] = p
-    return owner
-
-
-def save_sharded3d(
-    dirpath: str,
-    arr,
-    generation: int,
-    rule: str,
-    fingerprint: Optional[int] = None,
-) -> list:
-    """Write this process's pieces of a sharded volume (collective call).
-
-    Contract matches :func:`save_sharded`: every process writes exactly
-    the boxes assigned to it, process 0 additionally writes the manifest,
-    no process ever holds more than its own addressable shards, and the
-    caller fences with a barrier before relying on the checkpoint.
-    """
-    import jax
-
-    os.makedirs(dirpath, exist_ok=True)
-    shape = tuple(arr.shape)
-    owner = _piece_table3d(arr.sharding, shape)
-    me = jax.process_index()
-    written = []
-    pieces, seen = [], set()
-    for shard in arr.addressable_shards:
-        b = _box(shard.index, shape)
-        if owner[b] != me or b in seen:
-            continue
-        seen.add(b)
-        pieces.append((b, np.asarray(shard.data, np.uint8)))
-    arrays = dict(
-        boxes=np.asarray([b for b, _ in pieces], np.int64).reshape(-1, 6),
-        fps=np.asarray(
-            [
-                fingerprint3d_np(data, b[0], b[2], b[4], shape[1])
-                for b, data in pieces
-            ],
-            np.uint32,
-        ),
-    )
-    for i, (_, data) in enumerate(pieces):
-        arrays[f"piece_{i}"] = data
-    path = os.path.join(dirpath, f"shards_{me:05d}.npz")
-    tmp = path + ".tmp.npz"
-    np.savez_compressed(tmp, **arrays)
-    os.replace(tmp, path)
-    written.append(path)
-    if me == 0:
-        table = sorted(owner.items())
-        manifest = dict(
-            shape=np.asarray(shape, np.int64),
-            generation=np.int64(generation),
-            rule=np.asarray(rule),
-            boxes=np.asarray(
-                [b for b, _ in table], np.int64
-            ).reshape(-1, 6),
-            procs=np.asarray([p for _, p in table], np.int64),
-        )
-        if fingerprint is not None:
-            manifest["fingerprint"] = np.uint32(fingerprint)
-        mpath = os.path.join(dirpath, _MANIFEST)
-        tmp = mpath + ".tmp.npz"
-        np.savez_compressed(tmp, **manifest)
-        os.replace(tmp, mpath)
-        written.append(mpath)
-    return written
-
-
 def load_sharded3d_meta(dirpath: str) -> Sharded3DMeta:
-    """Read + validate the 3-D manifest: the box cover must tile the
-    volume exactly (bounds, total volume, pairwise disjointness), and a
-    global stamp must equal the sum of the piece stamps — all without
-    assembling any volume data."""
+    """3-D counterpart of :func:`load_sharded_meta` (same validation)."""
     import zipfile
 
     try:
@@ -732,85 +618,35 @@ def load_sharded3d_meta(dirpath: str) -> Sharded3DMeta:
             f"{dirpath}: malformed 3-D manifest geometry "
             f"(shape {meta.shape}, box table {meta.boxes.shape})"
         )
-    d, h, w = meta.shape
-    vol = 0
-    boxes = []
-    for row in meta.boxes:
-        d0, d1, r0, r1, c0, c1 = (int(x) for x in row)
-        if not (
-            0 <= d0 < d1 <= d
-            and 0 <= r0 < r1 <= h
-            and 0 <= c0 < c1 <= w
-        ):
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece box ({d0},{d1},{r0},{r1},{c0},{c1}) "
-                f"falls outside the {d}x{h}x{w} volume; the manifest is "
-                "corrupt"
-            )
-        vol += (d1 - d0) * (r1 - r0) * (c1 - c0)
-        boxes.append((d0, d1, r0, r1, c0, c1))
-    if vol != d * h * w:
-        raise CorruptSnapshotError(
-            f"{dirpath}: piece table covers {vol} cells of {d * h * w}; "
-            "the manifest is corrupt or incomplete"
-        )
-    boxes.sort()
-    for i, a in enumerate(boxes):
-        for b in boxes[i + 1 :]:
-            if b[0] >= a[1]:
-                break  # sorted by d0: no later box can overlap planes
-            if b[2] < a[3] and b[3] > a[2] and b[4] < a[5] and b[5] > a[4]:
-                raise CorruptSnapshotError(
-                    f"{dirpath}: piece boxes {a} and {b} overlap; the "
-                    "manifest is corrupt"
-                )
+    _validate_box_cover(dirpath, meta.shape, meta.boxes)
     if meta.fingerprint is not None:
-        total = np.uint32(0)
-        with np.errstate(over="ignore"):
-            for proc in sorted(set(int(p) for p in meta.procs)):
-                with np.load(
-                    os.path.join(dirpath, f"shards_{proc:05d}.npz")
-                ) as sf:
-                    total = total + np.sum(
-                        sf["fps"].astype(np.uint32), dtype=np.uint32
-                    )
-        if int(total) != meta.fingerprint:
-            raise CorruptSnapshotError(
-                f"{dirpath}: piece fingerprints sum to {int(total):#010x} "
-                f"!= stamped {meta.fingerprint:#010x}; some shard file is "
-                "corrupt"
-            )
+        _verify_global_stamp(dirpath, meta.procs, meta.fingerprint)
     return meta
 
 
-def read_sharded3d_region(
-    dirpath: str, meta: Sharded3DMeta, index
+def _read_region_nd(
+    dirpath: str, shape, boxes, procs, box_key: str, index
 ) -> np.ndarray:
-    """Assemble one box-shaped region from the 3-D piece files.
+    """Assemble one box-shaped region from the piece files (any rank).
 
-    ``index`` is a tuple of slices over the global volume (the
-    ``jax.make_array_from_callback`` contract); each consulted piece is
-    fingerprint-verified once, pieces outside the region never read.
+    ``index`` is a tuple of slices over the global array (the contract of
+    ``jax.make_array_from_callback``, so a resuming host reads *only* the
+    region its devices own).  Each piece consulted is fingerprint-verified
+    once per call; pieces that don't intersect the region are never read.
     """
-    from gol_tpu.utils.guard import fingerprint_np
-
-    d, h, w = meta.shape
-    sl = list(index) + [slice(None)] * (3 - len(index))
-    lo = [s.start or 0 for s in sl]
-    hi = [
-        dim if s.stop is None else s.stop for s, dim in zip(sl, (d, h, w))
-    ]
-    out = np.empty(
-        (hi[0] - lo[0], hi[1] - lo[1], hi[2] - lo[2]), np.uint8
-    )
+    ndim = len(shape)
+    sl = list(index) + [slice(None)] * (ndim - len(index))
+    lo = [0 if s.start is None else s.start for s in sl]
+    hi = [shape[a] if sl[a].stop is None else sl[a].stop for a in range(ndim)]
+    out = np.empty(tuple(hi[a] - lo[a] for a in range(ndim)), np.uint8)
     filled = 0
     by_proc = {}
     try:
-        for row, proc in zip(meta.boxes, meta.procs):
+        for row, proc in zip(boxes, procs):
             box = tuple(int(x) for x in row)
             inter = [
                 (max(box[2 * a], lo[a]), min(box[2 * a + 1], hi[a]))
-                for a in range(3)
+                for a in range(ndim)
             ]
             if any(i0 >= i1 for i0, i1 in inter):
                 continue
@@ -821,7 +657,7 @@ def read_sharded3d_region(
                 )
             sf = by_proc[proc]
             hit = np.nonzero(
-                np.all(sf["boxes"] == np.asarray(box, np.int64), axis=1)
+                np.all(sf[box_key] == np.asarray(box, np.int64), axis=1)
             )[0]
             if hit.size != 1:
                 raise CorruptSnapshotError(
@@ -830,30 +666,32 @@ def read_sharded3d_region(
                 )
             k = int(hit[0])
             data = sf[f"piece_{k}"].astype(np.uint8)
-            want = tuple(box[2 * a + 1] - box[2 * a] for a in range(3))
+            want = tuple(box[2 * a + 1] - box[2 * a] for a in range(ndim))
             if data.shape != want:
                 raise CorruptSnapshotError(
                     f"{dirpath}: piece {box} has shape {data.shape}, "
                     f"expected {want}"
                 )
             stored = int(sf["fps"][k])
-            actual = fingerprint3d_np(data, box[0], box[2], box[4], h)
+            actual = _piece_fp(data, box, shape)
             if stored != actual:
                 raise CorruptSnapshotError(
                     f"{dirpath}: piece {box} fingerprint {actual:#010x} "
                     f"!= stored {stored:#010x}; the shard file is corrupt"
                 )
-            (i0, i1), (j0, j1), (k0, k1) = inter
-            out[
-                i0 - lo[0] : i1 - lo[0],
-                j0 - lo[1] : j1 - lo[1],
-                k0 - lo[2] : k1 - lo[2],
-            ] = data[
-                i0 - box[0] : i1 - box[0],
-                j0 - box[2] : j1 - box[2],
-                k0 - box[4] : k1 - box[4],
-            ]
-            filled += (i1 - i0) * (j1 - j0) * (k1 - k0)
+            dst = tuple(
+                slice(inter[a][0] - lo[a], inter[a][1] - lo[a])
+                for a in range(ndim)
+            )
+            src = tuple(
+                slice(inter[a][0] - box[2 * a], inter[a][1] - box[2 * a])
+                for a in range(ndim)
+            )
+            out[dst] = data[src]
+            m = 1
+            for i0, i1 in inter:
+                m *= i1 - i0
+            filled += m
     finally:
         for sf in by_proc.values():
             sf.close()
@@ -863,3 +701,21 @@ def read_sharded3d_region(
             f"{out.size} cells"
         )
     return out
+
+
+def read_sharded_region(
+    dirpath: str, meta: ShardedMeta, index
+) -> np.ndarray:
+    """Assemble one rectangular region from the 2-D piece files."""
+    return _read_region_nd(
+        dirpath, meta.shape, meta.rects, meta.procs, "rects", index
+    )
+
+
+def read_sharded3d_region(
+    dirpath: str, meta: Sharded3DMeta, index
+) -> np.ndarray:
+    """Assemble one box-shaped region from the 3-D piece files."""
+    return _read_region_nd(
+        dirpath, meta.shape, meta.boxes, meta.procs, "boxes", index
+    )

@@ -180,7 +180,14 @@ def bench_roofline_2d_ring(
     nw = bitlife.packed_width(width)  # 1-D ring: width unsharded
     shard_h = height // num_devices
     fold = pallas_bitlife.fold_factor(nw)
-    folded = fold > 1 and shard_h % (fold * 8) == 0
+    folded = fold > 1
+    if folded and shard_h % (fold * 8):
+        # Mirror the engine's rejection: attributing an unfoldable
+        # geometry would describe a configuration that cannot run.
+        raise ValueError(
+            f"shard height {shard_h} is not divisible by {fold * 8}; the "
+            f"ring engine cannot lane-fold this geometry (nw={nw})"
+        )
     if folded:
         tile = pallas_bitlife.pick_tile(shard_h // fold, fold * nw, hint)
     else:

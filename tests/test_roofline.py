@@ -90,3 +90,8 @@ def test_folded_recompute_factor_isolates_blocking():
     — fold overhead must not masquerade as halo recompute."""
     r = roofline.roofline_2d(1e12, tile=1024, k=1, folded=True)
     assert r.recompute_factor == pytest.approx(1.0, abs=0.01)
+
+def test_ring_attribution_rejects_unfoldable_geometry():
+    """Geometries the engine cannot run must not get an attribution."""
+    with pytest.raises(ValueError, match="lane-fold"):
+        roofline.bench_roofline_2d_ring(1e12, 648, 1024)
