@@ -570,8 +570,12 @@ def multi_step_pallas_packed_bands(
 
 # Benchmarked sweet spot on v5e at 16384² (see module docstring): deeper
 # blocks win until the recomputed halo bands (~2k²/tile extra rows per k
-# steps) eat the launch/HBM savings.
-_BLOCK = 16
+# steps) eat the launch/HBM savings.  Round 3 re-measured at the
+# RPC-amortized x10240 loop length: k=8 at tile 256 runs ~2.5% ahead of
+# k=16 (1.87 vs 1.82e12 same-session sweep) — exactly the roofline's
+# recompute-factor gap (1.035 vs 1.066); the deeper block's saved
+# launches no longer pay once the loop is long enough to amortize them.
+_BLOCK = 8
 _BLOCK_TILE = 256
 
 

@@ -73,9 +73,12 @@ def test_multi_step_depth_validation():
 
 
 def test_pick_block_respects_geometry():
-    assert pallas_bitlife._pick_block(1000, 256) == 16
+    # Default block depth re-tuned to 8 in round 3 (RPC-amortized
+    # x10240 sweep: k=8 beats k=16 by its recompute-factor gap).
+    assert pallas_bitlife._pick_block(1000, 256) == pallas_bitlife._BLOCK
     assert pallas_bitlife._pick_block(5, 256) == 5
     assert pallas_bitlife._pick_block(1000, 8) == 8
+    assert pallas_bitlife._pick_block(1000, 256, block=16) == 16
 
 
 def test_pick_tile():
