@@ -245,6 +245,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument(
         "--guard-redundant-every", type=int, default=1, metavar="N"
     )
+    # jax.profiler trace of the steady-state loop (2-D driver parity).
+    ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -297,6 +299,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 "--guard-redundant-every samples the redundancy audit, "
                 "so it requires --guard-redundant"
+            )
+        if ns.profile and ns.guard_every > 0:
+            raise ValueError(
+                "--profile applies to unguarded runs; drop --guard-every"
             )
         rule = parse_rule3d(ns.rule)
 
@@ -483,15 +489,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     checkpoint_every=ns.checkpoint_every,
                 )
             else:
-                for take in schedule:
-                    compiled, _ = evolvers[take]
-                    with sw.phase("total"):
-                        board = compiled(board)
-                        force_ready(board)
-                    generation += take
-                    if ns.checkpoint_every > 0:
-                        with sw.phase("checkpoint"):
-                            save_snapshot(board, generation)
+                from gol_tpu.utils.timing import maybe_profile
+
+                with maybe_profile(ns.profile):
+                    for take in schedule:
+                        compiled, _ = evolvers[take]
+                        with sw.phase("total"):
+                            board = compiled(board)
+                            force_ready(board)
+                        generation += take
+                        if ns.checkpoint_every > 0:
+                            with sw.phase("checkpoint"):
+                                save_snapshot(board, generation)
             out = board
         else:
             out = placed if placed is not None else vol
