@@ -221,8 +221,15 @@ def compiled_evolve_packed_pallas(
     16384×1024 board runs within 1% of an equal-cell 4096² unfolded board
     (7.56e11 vs 7.60e11 cell-updates/s at ×16384) — the engine's fastest
     kernel now composes with pod-scale 2-D decompositions at any shard
-    width >= 2 words.  Requires shard height divisible by ``8f`` and
-    explicit (non-overlap) mode.
+    width >= 2 words.  Requires shard height divisible by ``8f``.
+    ``overlap=True`` composes with the fold (r4): in the folded layout
+    every interior group seam's band is a lane-shifted slice of the block
+    itself, so the only ppermute-dependent inputs are the two ring ghosts
+    — the interior kernel (folded rows ``[k, h/f - k)``, all lane groups)
+    reads the folded block alone, and only two k-row boundary kernels
+    wait for the exchanged band; folded overlap additionally needs
+    ``h/f >= 2*halo_depth + 8`` (an aligned interior tile clear of both
+    bands, same constraint as unfolded overlap one fold down).
 
     On **2-D block meshes** (BASELINE config 3's decomposition) the
     exchange grows a second phase: the k-row temporal band vertically, then
@@ -434,6 +441,14 @@ def compiled_evolve_packed_pallas(
         cols = fp[:, jnp.asarray(idx)]  # [hg, 4f], column-kind major
         return cols.reshape(hg, 4, f).transpose(2, 0, 1).reshape(hg * f, 4)
 
+    def folded_edges(fp, top_ghost, bottom_ghost, f):
+        """Exact post-chunk edge pairs of a folded shard, in the kernel's
+        folded edges layout ``[hg, 2f]`` — the one strip-repair assembly
+        behind both folded chunk bodies."""
+        return fold_rows(
+            edge_strips(top_ghost, four_folded(fp, f), bottom_ghost), f
+        )
+
     def chunk_folded(fp, tile, f):
         # The kernel's group-local lane rolls (groups=f) make the fold
         # seams exact by construction, so a row-sharded (1-D) narrow shard
@@ -443,9 +458,7 @@ def compiled_evolve_packed_pallas(
         bands, top_ghost, bottom_ghost = bands_folded(fp, f)
         edges_f = None
         if strip_fix:
-            edges_f = fold_rows(
-                edge_strips(top_ghost, four_folded(fp, f), bottom_ghost), f
-            )
+            edges_f = folded_edges(fp, top_ghost, bottom_ghost, f)
         return kernel_bands(fp, bands, tile, halo_depth, edges_f, f)
 
     def folded_band_slices(p_u32, top_ghost, bottom_ghost, f):
@@ -503,6 +516,47 @@ def compiled_evolve_packed_pallas(
         top, interior, bottom, _, _ = _boundary_pieces(p_u32, tile_int)
         return jnp.concatenate([top, interior, bottom], axis=0)
 
+    def chunk_folded_overlap(fp, tile_int, f):
+        # Folded counterpart of chunk_overlap / chunk2d_overlap.  In the
+        # folded layout the interior group seams' bands are lane-shifted
+        # slices of the block itself (see bands_folded), so the ONLY
+        # ppermute-dependent inputs are the two ring ghosts: the interior
+        # kernel (folded rows [k, hg-k), every lane group) reads fp alone
+        # and XLA schedules the ring exchange underneath it; the two
+        # k-row boundary kernels consume the arrived band plus a 2k-row
+        # local margin, exactly as in _boundary_pieces one fold down.
+        k = halo_depth
+        bands, top_ghost, bottom_ghost = bands_folded(fp, f)
+        interior = kernel(fp, tile_int, k, groups=f)  # folded rows [k, hg-k)
+        top = kernel(
+            jnp.concatenate([bands[:k], fp[: 2 * k]]), k, k, groups=f
+        )
+        bottom = kernel(
+            jnp.concatenate([fp[-2 * k :], bands[k:]]), k, k, groups=f
+        )
+        rows_out = jnp.concatenate([top, interior, bottom], axis=0)
+        if strip_fix:
+            # Same strip repair as chunk_folded, spliced by lane concat
+            # (the interior kernel must not take the edges operand — the
+            # strips depend on both exchange phases).  Group g's exact
+            # (left, right) pair sits at edges_f columns 2g, 2g+1; its
+            # words at lanes g*nw and (g+1)*nw - 1.
+            edges_f = folded_edges(fp, top_ghost, bottom_ghost, f)
+            nw = fp.shape[1] // f
+            rows_out = jnp.concatenate(
+                [
+                    piece
+                    for g in range(f)
+                    for piece in (
+                        edges_f[:, 2 * g : 2 * g + 1],
+                        rows_out[:, g * nw + 1 : (g + 1) * nw - 1],
+                        edges_f[:, 2 * g + 1 : 2 * g + 2],
+                    )
+                ],
+                axis=1,
+            )
+        return rows_out
+
     def chunk2d_overlap(p_u32, tile_int):
         top, interior, bottom, top_ghost, bottom_ghost = _boundary_pieces(
             p_u32, tile_int
@@ -549,15 +603,23 @@ def compiled_evolve_packed_pallas(
             # tiles — the fix for BASELINE config 3's 16x16-mesh shard
             # width, where nw = 32.  The kernel's group-local lane rolls
             # keep the fold exact, so the only constraints are geometric.
-            feasible = not overlap and h % (fold * 8) == 0
+            feasible = h % (fold * 8) == 0 and (
+                not overlap or h // fold >= 2 * halo_depth + 8
+            )
             if not feasible:
                 if jax.default_backend() == "tpu":
                     raise ValueError(
                         f"shard width {w} = {nw} packed words does not "
                         f"fill whole 128-lane tiles; lane-folding x{fold} "
-                        "lifts that but needs explicit (non-overlap) "
-                        f"shard_mode and shard height divisible by "
+                        f"lifts that but needs shard height divisible by "
                         f"{fold * 8} (got {h})"
+                        + (
+                            f" and, in overlap mode, folded height h/f >= "
+                            f"2*halo_depth + 8 = {2 * halo_depth + 8} "
+                            f"(got {h // fold})"
+                            if overlap
+                            else ""
+                        )
                     )
                 fold = 1  # interpret mode has no lane-tiling constraint
         if h % 8 or h < halo_depth:
@@ -579,7 +641,22 @@ def compiled_evolve_packed_pallas(
                 "not touch the exchanged band"
             )
         packed = bitlife.pack(board)
-        if fold > 1:
+        if fold > 1 and overlap:
+            # Interior tile lives clear of both exchanged bands, so the
+            # tileable extent is the folded height minus the 2k margin.
+            tile = pallas_bitlife.pick_tile(
+                h // fold - 2 * halo_depth, fold * nw, tile_hint
+            )
+            if full:
+                fp = fold_rows(packed, fold)
+                fp = lax.fori_loop(
+                    0,
+                    full,
+                    lambda _, q: chunk_folded_overlap(q, tile, fold),
+                    fp,
+                )
+                packed = unfold_rows(fp, fold)
+        elif fold > 1:
             tile = pallas_bitlife.pick_tile(h // fold, fold * nw, tile_hint)
             if full:
                 if tile >= halo_depth:

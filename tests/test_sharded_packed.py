@@ -688,18 +688,34 @@ def test_auto_resolves_pallas_for_narrow_shards_on_tpu(monkeypatch):
         geometry=Geometry(size=4096, num_ranks=1), mesh=mesh
     )  # shard 2048x1024: nw=32, fold=4
     assert rt._resolved == "pallas_bitpack"
-    # Overlap mode cannot fold: falls back to the XLA packed ring...
     rt = GolRuntime(
         geometry=Geometry(size=4096, num_ranks=1),
         mesh=mesh_mod.make_mesh_1d(8),
         shard_mode="overlap",
     )  # shard 512x4096: nw=128 fills lanes -> overlap flagship fine
     assert rt._resolved == "pallas_bitpack"
+    # Overlap composes with the fold (r4): folded height 128 >= 24.
     rt = GolRuntime(
         geometry=Geometry(size=2048, num_ranks=1),
         mesh=mesh_mod.make_mesh_1d(8),
         shard_mode="overlap",
-    )  # shard 256x2048: nw=64 -> fold needed but overlap can't fold
+    )  # shard 256x2048: nw=64 -> fold=2, hg=128 -> folded overlap
+    assert rt._resolved == "pallas_bitpack"
+    # ...and the pod geometry itself (16x16 mesh, 32-word shards) gets
+    # the fused kernel WITH latency hiding — the r3 verdict's headline
+    # hole.  2x4 stand-in with the same shard arithmetic:
+    rt = GolRuntime(
+        geometry=Geometry(size=4096, num_ranks=1),
+        mesh=mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8]),
+        shard_mode="overlap",
+    )  # shard 2048x1024: nw=32, fold=4, hg=512 >= 24
+    assert rt._resolved == "pallas_bitpack"
+    # Folded overlap without interior-tile room falls back to bitpack.
+    rt = GolRuntime(
+        geometry=Geometry(size=512, num_ranks=1),
+        mesh=mesh_mod.make_mesh_1d(8),
+        shard_mode="overlap",
+    )  # shard 64x512: nw=16, fold=8, hg=8 < 24
     assert rt._resolved == "bitpack"
     # A band depth beyond the 32-bit edge-repair light cone can't fold.
     rt = GolRuntime(
@@ -721,3 +737,137 @@ def test_sharded_pallas_folded_infeasible_raises_on_tpu(monkeypatch):
         packed.compiled_evolve_packed_pallas(mesh, 8)(
             jnp.asarray(board)
         ).block_until_ready()
+
+
+# -- folded overlap: the fused kernel AND latency hiding at narrow widths ----
+#
+# r3 verdict's top ask: BASELINE config 3 on a 16x16 pod mesh (32-word
+# shards) with --shard-mode overlap used to silently resolve dense.  The
+# folded layout makes every interior group seam's band a lane-shifted slice
+# of the block itself, so the interior kernel stays ppermute-independent
+# exactly as in the unfolded overlap form; only the two k-row boundary
+# kernels wait for the ring.
+
+
+@pytest.mark.parametrize("steps", [8, 19])  # incl. a jnp remainder tail
+def test_sharded_pallas_folded_overlap_1d_matches_oracle(steps):
+    """Narrow 1-D shards in overlap mode: fold=4, hg=32 >= 2*8+8."""
+    board = oracle.random_board(512, 1024, seed=81 + steps)
+    mesh = mesh_mod.make_mesh_1d(4)  # shard 128x1024: nw=32, fold=4
+    got = _folded_evolve(board, steps, mesh, overlap=True)
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+@pytest.mark.parametrize("steps", [8, 19])
+def test_sharded_pallas_folded_overlap_2d_matches_oracle(steps):
+    """The pod decomposition with latency hiding: folded strip repair
+    spliced by per-group lane concat (shard 128x1024: nw=32, fold=4)."""
+    board = oracle.random_board(256, 4096, seed=83 + steps)
+    mesh = mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8])
+    got = _folded_evolve(board, steps, mesh, overlap=True)
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+def test_sharded_pallas_folded_overlap_deep_band():
+    """k=16 band folded: boundary windows span 3k=48 folded rows."""
+    board = oracle.random_board(512, 4096, seed=87)
+    mesh = mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8])
+    got = _folded_evolve(board, 16, mesh, halo_depth=16, overlap=True)
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+
+
+def test_sharded_pallas_folded_overlap_group_seam_glider():
+    """Gliders across fold-group seams and the column wrap under the
+    overlap split's three-piece reassembly."""
+    board = np.zeros((512, 1024), np.uint8)
+    g = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8)
+    board[30:33, 0:3] = g  # near the column wrap
+    board[158:161, 500:503] = g  # will cross shard 1's group seams
+    mesh = mesh_mod.make_mesh_1d(4)  # shard 128x1024, hg=32
+    steps = 40
+    got = _folded_evolve(board, steps, mesh, overlap=True)
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+    assert got.sum() == 10
+
+
+def test_sharded_pallas_folded_overlap_custom_rule():
+    from gol_tpu.ops import rules
+
+    board = oracle.random_board(256, 4096, seed=89)
+    mesh = mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8])
+    got = _folded_evolve(board, 11, mesh, rule=rules.HIGHLIFE, overlap=True)
+    ref = np.asarray(rules.run_rule(jnp.asarray(board), 11, rules.HIGHLIFE))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_folded_overlap_interior_kernel_independent_of_exchange():
+    """The overlap property at the jaxpr level, folded form: per chunk,
+    the interior launch must not be a transitive consumer of any
+    ppermute; the two boundary launches must be (same taint analysis as
+    test_overlap_interior_kernel_independent_of_exchange)."""
+    import jax as jax_mod
+    from jax.extend import core as jex_core
+    from gol_tpu.parallel.mesh import board_sharding
+
+    mesh = mesh_mod.make_mesh_1d(4)  # shard 128x1024: nw=32, fold=4
+    fn = packed.compiled_evolve_packed_pallas(mesh, 8, overlap=True)
+    spec = jax_mod.ShapeDtypeStruct(
+        (512, 1024), jnp.uint8, sharding=board_sharding(mesh)
+    )
+    top = jax_mod.make_jaxpr(lambda b: fn(b))(spec).jaxpr
+
+    def sub_jaxprs(v):
+        if hasattr(v, "eqns"):
+            yield v
+        elif hasattr(v, "jaxpr"):
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from sub_jaxprs(x)
+
+    def collect(jpr, acc):
+        acc.append(jpr)
+        for eqn in jpr.eqns:
+            for v in eqn.params.values():
+                for j in sub_jaxprs(v):
+                    collect(j, acc)
+        return acc
+
+    results = []
+    for jpr in collect(top, []):
+        names = [e.primitive.name for e in jpr.eqns]
+        if "ppermute" not in names or "pallas_call" not in names:
+            continue
+        tainted = set()
+        for eqn in jpr.eqns:
+            hit = any(
+                not isinstance(v, jex_core.Literal) and v in tainted
+                for v in eqn.invars
+            )
+            if eqn.primitive.name == "pallas_call":
+                results.append(hit)
+            if eqn.primitive.name == "ppermute" or hit:
+                tainted.update(eqn.outvars)
+    assert len(results) == 3
+    assert sorted(results) == [False, True, True]
+
+
+def test_runtime_folded_overlap_end_to_end():
+    """auto + overlap at a narrow-shard geometry runs the folded flagship
+    through the runtime (the r3 silent-dense-fallback fix, end to end)."""
+    from gol_tpu.models import patterns as patterns_mod
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    geom = Geometry(size=1024, num_ranks=1)
+    rt = GolRuntime(
+        geometry=geom,
+        engine="pallas_bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),  # shard 256x1024: nw=32, fold=4
+        shard_mode="overlap",
+    )
+    _, state = rt.run(pattern=4, iterations=10)
+    board0 = patterns_mod.init_global(4, 1024, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 10)
+    )
