@@ -1,0 +1,116 @@
+"""A/B the rolling-plane 3-D kernel against the r3 kernels on the chip.
+
+Interleaved best-of-N samples in ONE process (BASELINE.md measurement
+discipline): per contender, jit a steps-long fori_loop over the kernel,
+warm it, then time reps fenced with force_ready.
+
+Usage: python benchmarks/bench_roll3d.py [size] [steps] [reps]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gol_tpu.ops import bitlife3d, pallas_bitlife3d as p3
+from gol_tpu.utils.timing import force_ready
+
+
+def main() -> None:
+    size = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+    # Every contender runs whole k=8 chunks; count the generations that
+    # actually execute or the reported rate is inflated.
+    steps -= steps % 8
+    if steps < 8:
+        raise SystemExit("steps must be >= 8 (one temporal block)")
+    d = h = w = size
+    nw = w // 32
+    rng = np.random.default_rng(0)
+    vol = (rng.random((d, h, w)) < 0.3).astype(np.uint8)
+    packed = bitlife3d.pack3d(jnp.asarray(vol))
+    pt = jax.lax.bitcast_convert_type(packed, jnp.int32).transpose(0, 2, 1)
+    pw = jax.lax.bitcast_convert_type(packed, jnp.int32).transpose(2, 0, 1)
+    cells = float(d) * h * w * steps
+
+    contenders = {}
+
+    wt = p3.pick_tile3d_wt(d, nw, h)
+    if wt is not None:
+        td, tw = wt
+
+        def run_wt(x):
+            return jax.lax.fori_loop(
+                0,
+                steps // 8,
+                lambda _, p: p3.multi_step_pallas_packed3d_wt(p, td, tw, 8),
+                x,
+            )
+
+        contenders[f"wt({td},{tw})k8"] = (jax.jit(run_wt), pw)
+
+    plane = p3.pick_tile3d(d, nw, h)
+    if plane:
+
+        def run_plane(x):
+            return jax.lax.fori_loop(
+                0,
+                steps // 8,
+                lambda _, p: p3.multi_step_pallas_packed3d(p, plane, 8),
+                x,
+            )
+
+        contenders[f"plane({plane})k8"] = (jax.jit(run_plane), pt)
+
+    for tile in (t for t in dict.fromkeys(
+        int(x) for x in (sys.argv[4].split(",") if len(sys.argv) > 4
+                         else ["32", "64", "96", "128", "256"])
+    ) if d % t == 0):
+        window_mb = (tile + 16) * nw * h * 4 / 2**20
+        if window_mb > 15:
+            continue
+
+        def run_roll(x, t=tile):
+            return jax.lax.fori_loop(
+                0,
+                steps // 8,
+                lambda _, p: p3.multi_step_pallas_packed3d_roll(p, t, 8),
+                x,
+            )
+
+        contenders[f"roll({tile})k8"] = (jax.jit(run_roll), pt)
+
+    timed = {}
+    fns = {}
+    for name, (fn, x) in contenders.items():
+        t0 = time.perf_counter()
+        try:
+            force_ready(fn(x))
+        except Exception as e:  # noqa: BLE001 — report compile failures
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}")
+            continue
+        print(f"{name}: warm+compile {time.perf_counter() - t0:.1f}s")
+        timed[name] = []
+        fns[name] = (fn, x)
+
+    for _ in range(reps):
+        for name, (fn, x) in fns.items():
+            t0 = time.perf_counter()
+            force_ready(fn(x))
+            timed[name].append(time.perf_counter() - t0)
+
+    for name, ts in timed.items():
+        best = min(ts)
+        print(
+            f"{name}: best {best:.3f}s -> {cells / best:.3e} cell-updates/s "
+            f"(all: {['%.3f' % t for t in ts]})"
+        )
+
+
+if __name__ == "__main__":
+    main()

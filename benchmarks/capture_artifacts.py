@@ -1,0 +1,124 @@
+"""Capture the per-round north-star metric artifacts (VERDICT r3 #4).
+
+BASELINE.json names three north-star metrics; BENCH_r{N}.json pins only
+the first.  This driver captures the other two into committed artifacts:
+
+- ``HALO_r{N}.json`` — halobench's exchange-vs-compute attribution
+  (seconds/gen for exchange-only, full step, pure stencil, exposed
+  exchange), for the flagship engine's serial AND overlap forms on the
+  chip's 1-ring, plus the 8-device CPU mesh's multi-device attribution
+  (curve *shape* only — absolute CPU numbers are not chip numbers).
+- ``SCALE_r{N}.json`` — scalebench's weak-scaling efficiency curve on
+  the 8-device CPU mesh plus the real-chip 1-device throughput point.
+
+Usage: ``python benchmarks/capture_artifacts.py <round>`` with the TPU
+visible (the CPU-mesh parts run in subprocesses pinned to the virtual
+CPU mesh; the TPU parts run in-process).  Each artifact records the
+command that produced every section so the judge can re-run any line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CPU_ENV = {
+    **os.environ,
+    "PYTHONPATH": str(REPO),
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def _cpu_json(args: list) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-m", *args],
+        env=CPU_ENV,
+        capture_output=True,
+        text=True,
+        check=True,
+        cwd=REPO,
+    ).stdout
+    payload = json.loads(out.strip().splitlines()[-1])
+    payload["command"] = "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m " + " ".join(args)
+    return payload
+
+
+def main() -> None:
+    rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    halo = {"note": (
+        "seconds per generation. exchange_s = ppermute ring alone; "
+        "step_s = full sharded program; stencil_s = single-device "
+        "compute ceiling; exposed_exchange_s = step - stencil (what "
+        "latency hiding can win). TPU sections are real-chip; cpu_mesh "
+        "sections are 8-virtual-device curve shape only."
+    )}
+    scale = {"note": (
+        "weak scaling: fixed size_per_chip^2 cells per device, 1-D "
+        "ring. efficiency = per-chip rate / 1-device per-chip rate. "
+        "cpu_mesh = 8-virtual-device curve shape; tpu_1chip = the real "
+        "per-chip throughput the curve hangs off."
+    )}
+
+    if on_tpu:
+        from gol_tpu.utils import halobench, scalebench
+        from gol_tpu.parallel import mesh as mesh_mod
+
+        ring = mesh_mod.make_mesh_1d(1)
+        for engine in ("pallas", "pallas_overlap"):
+            halo[f"tpu_1ring_{engine}"] = {
+                **halobench.measure(ring, 16384, 1024, engine),
+                "size": 16384,
+                "steps": 1024,
+                "devices": 1,
+                "command": (
+                    f"python -m gol_tpu.utils.halobench 16384 1024 1d {engine}"
+                ),
+            }
+        rows = scalebench.measure_weak_scaling(
+            4096, 16384, "pallas", counts=[1]
+        )
+        scale["tpu_1chip"] = {
+            "size_per_chip": 4096,
+            "steps": 16384,
+            "engine": "pallas",
+            "rows": rows,
+            "command": "scalebench.measure_weak_scaling(4096, 16384, 'pallas', counts=[1])",
+        }
+    else:
+        print("capture_artifacts: no TPU visible; TPU sections skipped",
+              file=sys.stderr)
+
+    halo["cpu_mesh_dense_1d"] = _cpu_json(
+        ["gol_tpu.utils.halobench", "1024", "32", "1d", "dense"]
+    )
+    halo["cpu_mesh_bitpack_1d"] = _cpu_json(
+        ["gol_tpu.utils.halobench", "1024", "32", "1d", "bitpack"]
+    )
+    halo["cpu_mesh_dense_2d"] = _cpu_json(
+        ["gol_tpu.utils.halobench", "1024", "32", "2d", "dense"]
+    )
+    scale["cpu_mesh_dense"] = _cpu_json(
+        ["gol_tpu.utils.scalebench", "512", "32", "dense"]
+    )
+    scale["cpu_mesh_bitpack"] = _cpu_json(
+        ["gol_tpu.utils.scalebench", "512", "32", "bitpack"]
+    )
+
+    for name, payload in (("HALO", halo), ("SCALE", scale)):
+        path = REPO / f"{name}_r{rnd:02d}.json"
+        path.write_text(json.dumps(payload, indent=1) + "\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

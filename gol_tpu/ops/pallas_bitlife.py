@@ -85,6 +85,25 @@ def fold_factor(packed_width: int) -> int:
     return _LANE // math.gcd(packed_width, _LANE)
 
 
+def fold_feasible(
+    shard_h: int, fold: int, overlap: bool, depth: int
+) -> bool:
+    """Geometric feasibility of evolving a fold-``f`` narrow shard.
+
+    The ONE predicate behind the engine's trace-time check
+    (``packed.local``), the runtime's up-front validation
+    (``GolRuntime.__post_init__``), and the auto-resolution gate
+    (``GolRuntime._resolve_auto``) — shared so the three sites cannot
+    drift: the folded layout needs shard height divisible by
+    ``fold * _ALIGN`` (every group an aligned row block), and overlap
+    mode additionally needs the *folded* height to keep one aligned
+    interior tile clear of both exchanged bands.
+    """
+    return shard_h % (fold * _ALIGN) == 0 and (
+        not overlap or shard_h // fold >= 2 * depth + _ALIGN
+    )
+
+
 def _lsr(x: jax.Array, r: int) -> jax.Array:
     """Logical shift right on int32 lanes (mask off the sign extension)."""
     return (x >> r) & jnp.int32((1 << (32 - r)) - 1)

@@ -98,6 +98,222 @@ def _one_generation(
     return (~center & born) | (center & keep)
 
 
+def _count9_plane(plane: jax.Array):
+    """In-plane count-of-9 bit planes for one ``[nw, H]`` word plane.
+
+    The x/h stage of :func:`_one_generation` restricted to a single
+    plane: x wraps on the sublane word ring (wrap concats + carry
+    shifts), h neighbors via lane rolls.  Returns the 4-bit-plane tuple
+    ``_sum3_2bit`` produces.
+    """
+    h = plane.shape[1]
+    prev_w = jnp.concatenate([plane[-1:], plane[:-1]], axis=0)
+    next_w = jnp.concatenate([plane[1:], plane[:1]], axis=0)
+    west = (plane << 1) | _lsr(prev_w, 31)
+    east = _lsr(plane, 1) | (next_w << 31)
+    s0, s1 = bitlife._full_add(west, plane, east)
+    return bitlife._sum3_2bit(
+        (pltpu.roll(s0, 1, axis=1), pltpu.roll(s1, 1, axis=1)),
+        (s0, s1),
+        (pltpu.roll(s0, h - 1, axis=1), pltpu.roll(s1, h - 1, axis=1)),
+    )
+
+
+def _roll_generations(scratch, *, tile, k, pad, birth, survive):
+    """The rolling kernels' shared k-generation loop over one window.
+
+    Each generation is a plane-ascending ``fori_loop`` carrying the
+    count-of-9 bit planes of the two planes below the write cursor,
+    storing each output plane in place as soon as it is complete.
+    In-place safety: storing plane ``p`` clobbers only data whose count9
+    is already carried; ``center`` (plane ``p``) and the count9 of plane
+    ``p+1`` are read before the store.  The valid window shrinks one
+    plane per side per generation.
+    """
+    for j in range(k):
+        lo = pad - (k - j)
+        hi = pad + tile + (k - j)  # window [lo, hi); outputs [lo+1, hi-1)
+
+        def body(p, carry, _birth=birth, _survive=survive):
+            c9_prev, c9_cur = carry[:4], carry[4:]
+            c9_next = _count9_plane(scratch[p + 1])
+            count27 = bitlife3d._sum3_planes(
+                c9_prev, c9_cur, c9_next, width=5
+            )
+            center = scratch[p]
+            count26 = bitlife._sub_bit(count27, center)
+            born = bitlife._match_counts(count26, _birth)
+            keep = bitlife._match_counts(count26, _survive)
+            scratch[p] = (~center & born) | (center & keep)
+            return (*c9_cur, *c9_next)
+
+        carry = (*_count9_plane(scratch[lo]), *_count9_plane(scratch[lo + 1]))
+        jax.lax.fori_loop(lo + 1, hi - 1, body, carry)
+
+
+def _kernel_roll(
+    vol_hbm, out_ref, scratch, sems, *, tile, depth, k, pad, birth, survive
+):
+    """Plane-tiled kernel body, rolling per-plane generation (r4).
+
+    Same windowing/DMA as :func:`_kernel`, but each generation runs as a
+    plane-ascending ``fori_loop`` carrying the count-of-9 bit planes of
+    the two planes below the write cursor, storing each output plane in
+    place as soon as it is complete.  Peak VMEM is therefore ONE window
+    plus ~a dozen plane-sized temporaries — not the ~9 whole-window live
+    arrays the monolithic adder tree holds — so the plane tile can grow
+    several-fold and the halo-recompute factor drops toward
+    ``(tile + k + 1)/tile`` with NO word-ghost term at all (the r3
+    verdict's 3-D ask: the wt kernel's word ghosts taxed 1024³ ×1.5).
+
+    In-place safety: storing plane ``p`` clobbers only data whose count9
+    is already carried; ``center`` (plane ``p``) and ``count9`` of plane
+    ``p+1`` are read before the store.  Op count per useful word is
+    identical to the monolithic kernel — the restructure moves memory,
+    not arithmetic.
+    """
+    load_tile_with_halo(
+        vol_hbm, scratch, sems, pl.program_id(0),
+        tile=tile, height=depth, align=_ALIGN, pad=pad,
+    )
+    _roll_generations(
+        scratch, tile=tile, k=k, pad=pad, birth=birth, survive=survive
+    )
+    # Manual output DMA instead of an out_specs VMEM block: pallas_call
+    # double-buffers out blocks for its store pipeline, which at big
+    # plane tiles costs 2*tile plane-buffers of VMEM — more than the
+    # whole halo window.  The explicit copy keeps peak VMEM at ONE
+    # window; the serial wait stalls only for an HBM write that is tiny
+    # next to the k-generation VPU work.
+    i = pl.program_id(0)
+    store = pltpu.make_async_copy(
+        scratch.at[pl.ds(pad, tile)],
+        out_ref.at[pl.ds(pl.multiple_of(i * tile, _ALIGN), tile)],
+        sems.at[3],
+    )
+    store.start()
+    store.wait()
+
+
+def multi_step_pallas_packed3d_roll(
+    packed_t: jax.Array, tile: int, k: int, rule: Rule3D = BAYS_4555
+) -> jax.Array:
+    """k fused rolling-plane generations on a transposed volume [D, nw, H].
+
+    The big-window plane kernel: identical contract to
+    :func:`multi_step_pallas_packed3d`, peak VMEM ~1 window (see
+    :func:`_kernel_roll`), so it fits plane tiles the monolithic kernel
+    cannot — at 1024³ a whole-(nw,H)-plane window of 64+ planes.
+    """
+    depth, nw, h = packed_t.shape
+    validate_tile(depth, tile, _ALIGN)
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    pad = -(-k // _ALIGN) * _ALIGN
+    if pad > tile:
+        raise ValueError(
+            f"temporal block depth {k} needs halo pad {pad} <= tile {tile}"
+        )
+    return pl.pallas_call(
+        functools.partial(
+            _kernel_roll,
+            tile=tile,
+            depth=depth,
+            k=k,
+            pad=pad,
+            birth=rule.birth,
+            survive=rule.survive,
+        ),
+        grid=(depth // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(packed_t.shape, packed_t.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((tile + 2 * pad, nw, h), packed_t.dtype),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(packed_t)
+
+
+def _kernel_roll_ext(
+    ext_hbm, out_ref, scratch, sems, *, tile, k, pad, birth, survive
+):
+    """Rolling-plane kernel on a band-extended shard (sharded engine form).
+
+    ``ext_hbm[band + 2*pad, nw, lanes]``: ghost planes from the ring band
+    exchange on the leading axis (windows are contiguous aligned slices —
+    one DMA, no mod arithmetic).  The x axis is the shard's FULL width
+    (the sharded engine only takes this kernel on x-unsharded meshes), so
+    x wraps locally exactly as in :func:`_kernel_roll`.  A word-extended
+    variant was a measured dead end: ghost word columns put ``nw + 2``
+    on the sublane axis, whose tiled HBM layout Mosaic cannot slice at
+    unaligned extents (r4, memref_slice failure at 34-of-40 sublanes).
+    """
+    i = pl.program_id(0)
+    cp = pltpu.make_async_copy(
+        ext_hbm.at[pl.ds(pl.multiple_of(i * tile, _ALIGN), tile + 2 * pad)],
+        scratch.at[:],
+        sems.at[0],
+    )
+    cp.start()
+    cp.wait()
+    _roll_generations(
+        scratch, tile=tile, k=k, pad=pad, birth=birth, survive=survive
+    )
+    store = pltpu.make_async_copy(
+        scratch.at[pl.ds(pad, tile)],
+        out_ref.at[pl.ds(pl.multiple_of(i * tile, _ALIGN), tile)],
+        sems.at[1],
+    )
+    store.start()
+    store.wait()
+
+
+def multi_step_pallas_packed3d_roll_ext(
+    ext: jax.Array, tile: int, k: int, rule: Rule3D = BAYS_4555
+) -> jax.Array:
+    """k rolling generations on a band-extended shard volume.
+
+    ``ext[band + 2*pad, nw, lanes]`` carries ring-ghost planes on the
+    leading axis — the sharded 3-D engine's band-exchange product in the
+    plane-leading layout, for meshes whose x axis is unsharded (the
+    shard's local x wrap IS the torus).  Returns ``[band, nw, lanes]``.
+    """
+    pad = -(-k // _ALIGN) * _ALIGN
+    band = ext.shape[0] - 2 * pad
+    validate_tile(band, tile, _ALIGN)
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if pad > tile:
+        raise ValueError(
+            f"temporal block depth {k} needs halo pad {pad} <= tile {tile}"
+        )
+    return pl.pallas_call(
+        functools.partial(
+            _kernel_roll_ext,
+            tile=tile,
+            k=k,
+            pad=pad,
+            birth=rule.birth,
+            survive=rule.survive,
+        ),
+        grid=(band // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(
+            (band, ext.shape[1], ext.shape[2]), ext.dtype
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (tile + 2 * pad, ext.shape[1], ext.shape[2]), ext.dtype
+            ),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(ext)
+
+
 def _kernel(
     vol_hbm, out_ref, scratch, sems, *, tile, depth, k, pad, birth, survive
 ):
@@ -414,6 +630,38 @@ _SCOPED_LIMIT = 16 * 1024 * 1024
 _LIVE_WINDOWS = 9
 
 
+# The rolling kernel's VMEM model: ONE window (the scratch) plus
+# plane-sized temporaries — three count9 sets in flight (12 bit planes),
+# count27/count26/match intermediates, and slack for Mosaic's scheduling.
+# Calibrated on-chip r4: tile 64 at 1024³ (80-plane window, 10 MB + temps)
+# compiles; tile 128 (18 MB window alone) cannot.
+_LIVE_PLANES_ROLL = 24
+
+
+def pick_tile3d_roll(depth: int, nw: int, h: int, pad: int = _ALIGN) -> int:
+    """Largest aligned divisor of ``depth`` whose window fits the rolling
+    kernel's VMEM model (one window + ~24 plane-sized temps).
+
+    Same contract as :func:`pick_tile3d`; returns 0 when nothing fits.
+    The rolling kernel's restructured compute (per-plane ``fori_loop``
+    with a count9 carry) is what shrinks the model from ~9 live windows
+    to ~1 — see :func:`_kernel_roll`.
+    """
+    if depth % _ALIGN:
+        raise ValueError(
+            f"pallas 3-D engine needs volume depth divisible by {_ALIGN}, "
+            f"got {depth}"
+        )
+    budget_planes = _SCOPED_LIMIT // (4 * nw * h) - _LIVE_PLANES_ROLL
+    cap = min(budget_planes - 2 * pad, depth)
+    if cap < _ALIGN:
+        return 0
+    for tile in range(cap - cap % _ALIGN, 0, -_ALIGN):
+        if depth % tile == 0:
+            return tile
+    return 0
+
+
 def pick_tile3d(depth: int, nw: int, h: int, pad: int = _ALIGN) -> int:
     """Largest _ALIGN-multiple divisor of ``depth`` whose halo-extended
     window (tile + 2*pad planes of nw×h words) fits scoped VMEM.
@@ -461,70 +709,82 @@ def evolve3d(
                 "pallas 3-D engine needs the H axis to fill whole "
                 f"{_LANE}-lane tiles on TPU: got H={h}"
             )
+    # Three kernels, one objective: lowest halo-recompute score wins (the
+    # kernels are VPU-bound, so duplicated ghost compute decides).  The
+    # rolling kernel fits windows several times the monolithic plane
+    # kernel's (one live window vs ~9), so it usually scores lowest and
+    # is what retired the wt kernel's ×1.92 recompute at 1024³ — measured
+    # same-session ×256 on v5e (BASELINE.md r4): roll(32/64) 4.8e11
+    # cell-updates/s vs wt(32,4) 3.3e11.  On score ties prefer the
+    # monolithic plane kernel (bigger fused ops, measured slightly ahead
+    # at equal tile); the tie can only happen when both max out at the
+    # full depth.
     tile = pick_tile3d(d, nw, h)
     wt = pick_tile3d_wt(d, nw, h)
-    if tile and wt is not None:
-        # Both kernels fit: pick the lower halo-recompute ratio — the
-        # kernels are VPU-bound, so duplicated ghost compute decides.
-        # Measured (v5e, ×128 steps): 768³ plane tile 8 scores 3.0
-        # against wt (48, 4) at 2.0, and wt runs ~11% faster (1.78e11 vs
-        # 1.61e11); 512³ plane tile 32 scores 1.5 < wt's 1.875 and the
-        # plane kernel keeps the job.
-        if recompute_score(wt[0], wt[1]) < recompute_score(tile, 0):
-            tile = 0
-    if tile == 0:
-        # A single (nw, H) word plane is too large for the scoped-VMEM
-        # window (e.g. 1024³) — or the word-tiled split simply recomputes
-        # less: run the word-tiled kernel, keeping the fused path at
-        # every size whose H axis fills lanes.
-        if wt is not None:
-            tile_d, tile_w = wt
-            packed_w = lax.bitcast_convert_type(
-                bitlife3d.pack3d(vol), jnp.int32
-            ).transpose(2, 0, 1)
-            k = _pick_block(steps, tile_d, _BLOCK, _ALIGN)
-            full, rem = divmod(steps, k)
-            packed_w = lax.fori_loop(
-                0,
-                full,
-                lambda _, p: multi_step_pallas_packed3d_wt(
-                    p, tile_d, tile_w, k, rule
-                ),
-                packed_w,
-            )
-            if rem:
-                packed_w = multi_step_pallas_packed3d_wt(
-                    packed_w, tile_d, tile_w, rem, rule
-                )
-            return bitlife3d.unpack3d(
-                lax.bitcast_convert_type(
-                    packed_w.transpose(1, 2, 0), jnp.uint32
-                )
-            )
+    roll = pick_tile3d_roll(d, nw, h)
+    cands = []
+    if tile:
+        cands.append((recompute_score(tile, 0), 0, "plane"))
+    if roll:
+        cands.append((recompute_score(roll, 0), 1, "roll"))
+    if wt is not None:
+        cands.append((recompute_score(wt[0], wt[1]), 2, "wt"))
+    if not cands:
         # Not even a word-tiled window fits: take the XLA packed path —
         # same bit-exact result, still one compiled program.
         if strict:
             raise ValueError(
                 f"the fused Pallas 3-D kernel cannot fit a volume of shape "
-                f"{(d, h, w)} in scoped VMEM (neither whole nor word-tiled "
-                "plane windows); use engine 'auto' or 'bitpack'"
+                f"{(d, h, w)} in scoped VMEM (neither whole, rolling, nor "
+                "word-tiled plane windows); use engine 'auto' or 'bitpack'"
             )
         return bitlife3d.unpack3d(
             bitlife3d.run3d_packed(bitlife3d.pack3d(vol), steps, rule)
         )
+    choice = min(cands)[2]
+    if choice == "wt":
+        tile_d, tile_w = wt
+        packed_w = lax.bitcast_convert_type(
+            bitlife3d.pack3d(vol), jnp.int32
+        ).transpose(2, 0, 1)
+        k = _pick_block(steps, tile_d, _BLOCK, _ALIGN)
+        full, rem = divmod(steps, k)
+        packed_w = lax.fori_loop(
+            0,
+            full,
+            lambda _, p: multi_step_pallas_packed3d_wt(
+                p, tile_d, tile_w, k, rule
+            ),
+            packed_w,
+        )
+        if rem:
+            packed_w = multi_step_pallas_packed3d_wt(
+                packed_w, tile_d, tile_w, rem, rule
+            )
+        return bitlife3d.unpack3d(
+            lax.bitcast_convert_type(
+                packed_w.transpose(1, 2, 0), jnp.uint32
+            )
+        )
+    step_fn = (
+        multi_step_pallas_packed3d
+        if choice == "plane"
+        else multi_step_pallas_packed3d_roll
+    )
+    t = tile if choice == "plane" else roll
     packed_t = lax.bitcast_convert_type(
         bitlife3d.pack3d(vol), jnp.int32
     ).transpose(0, 2, 1)
-    k = _pick_block(steps, tile, _BLOCK, _ALIGN)
+    k = _pick_block(steps, t, _BLOCK, _ALIGN)
     full, rem = divmod(steps, k)
     packed_t = lax.fori_loop(
         0,
         full,
-        lambda _, p: multi_step_pallas_packed3d(p, tile, k, rule),
+        lambda _, p: step_fn(p, t, k, rule),
         packed_t,
     )
     if rem:
-        packed_t = multi_step_pallas_packed3d(packed_t, tile, rem, rule)
+        packed_t = step_fn(packed_t, t, rem, rule)
     return bitlife3d.unpack3d(
         lax.bitcast_convert_type(packed_t.transpose(0, 2, 1), jnp.uint32)
     )

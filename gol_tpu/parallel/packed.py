@@ -603,8 +603,8 @@ def compiled_evolve_packed_pallas(
             # tiles — the fix for BASELINE config 3's 16x16-mesh shard
             # width, where nw = 32.  The kernel's group-local lane rolls
             # keep the fold exact, so the only constraints are geometric.
-            feasible = h % (fold * 8) == 0 and (
-                not overlap or h // fold >= 2 * halo_depth + 8
+            feasible = pallas_bitlife.fold_feasible(
+                h, fold, overlap, halo_depth
             )
             if not feasible:
                 if jax.default_backend() == "tpu":

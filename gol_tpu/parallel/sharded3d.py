@@ -156,11 +156,15 @@ def compiled_evolve3d_pallas(
     corner handling one dimension up: (1) a ``halo_depth``-deep ghost
     *plane* band rides the PLANES ring; (2) one ghost word *column* per
     side of the already plane-extended volume rides the COLS ring, so the
-    x/d corner words make two hops.  The extended volume feeds
-    :func:`gol_tpu.ops.pallas_bitlife3d.multi_step_pallas_packed3d_wt_ext`
-    — the same kernel the single-device path runs, whose zero-filled
-    outer-ghost light cone already supports exactly this 1-word x halo
-    for k <= 32 generations.
+    x/d corner words make two hops.  The extended volume feeds whichever
+    fused kernel scores the lower halo recompute — the rolling-plane form
+    (:func:`gol_tpu.ops.pallas_bitlife3d.
+    multi_step_pallas_packed3d_roll_ext`, usual winner: its one-window
+    VMEM model fits plane tiles the others cannot, r4) or the word-tiled
+    form (:func:`gol_tpu.ops.pallas_bitlife3d.
+    multi_step_pallas_packed3d_wt_ext`) — both the same kernels the
+    single-device path runs, whose zero-filled outer-ghost light cones
+    already support exactly this 1-word x halo for k <= 32 generations.
 
     **Mesh constraint**: at least one of the PLANES/ROWS axes must have
     size 1.  The kernel's two non-word spatial axes are geometrically
@@ -225,6 +229,21 @@ def compiled_evolve3d_pallas(
             ext, tile_d, tile_w, halo_depth, rule
         )
 
+    def chunk_roll(pp, tile):
+        # Band exchange only, in the rolling kernel's plane-leading
+        # layout [band, nw, lanes]: this path runs exclusively on
+        # x-unsharded meshes (the dispatch below), where the shard's
+        # local x wrap IS the torus — no ghost word columns.  (A
+        # word-extended variant was a measured dead end: nw + 2 on the
+        # sublane axis is an unaligned tiled-HBM extent Mosaic cannot
+        # slice — r4.)
+        top = lax.ppermute(pp[-pad:], band_axis_name, ring(band_ring, 1))
+        bot = lax.ppermute(pp[:pad], band_axis_name, ring(band_ring, -1))
+        ext = jnp.concatenate([top, pp, bot], axis=0)
+        return pallas_bitlife3d.multi_step_pallas_packed3d_roll_ext(
+            ext, tile, halo_depth, rule
+        )
+
     def local(vol):
         d, h, w = vol.shape  # per-shard block (static under shard_map)
         nw = w // bitlife.BITS
@@ -243,32 +262,76 @@ def compiled_evolve3d_pallas(
                 f"exchanged band {pad}: the ghost band would need layers "
                 "from beyond the ring neighbor"
             )
+        # Kernel dispatch by halo-recompute score, exactly like the
+        # single-device evolve3d: on x-unsharded meshes the rolling
+        # kernel carries NO word ghosts at all (the shard's local x wrap
+        # is the torus) and its one-window VMEM model fits plane tiles
+        # the wt kernel cannot — measured r4, it retired the wt kernel's
+        # ×1.5 word-ghost tax at 1024³.  x-sharded meshes keep the wt
+        # kernel: its ghost word columns ride the untiled leading axis,
+        # the only layout whose HBM extents Mosaic can slice.
         wt = pallas_bitlife3d.pick_tile3d_wt(
             band_extent, nw, lane_extent, pad
         )
-        if wt is None:
+        if wt is not None and wt[0] < pad:
+            # The kernels need tile >= pad (the window shrink must stay
+            # inside one tile's halo); the pickers optimize recompute
+            # under the VMEM budget and can return smaller — such a
+            # candidate is infeasible here, not merely worse.
+            wt = None
+        roll_tile = (
+            pallas_bitlife3d.pick_tile3d_roll(
+                band_extent, nw, lane_extent, pad
+            )
+            if num_cols == 1 and band_extent % 8 == 0
+            else 0
+        )
+        if roll_tile < pad:
+            roll_tile = 0
+        if wt is None and not roll_tile:
             raise ValueError(
-                f"no word-tiled kernel window fits scoped VMEM for shard "
+                f"no fused kernel window fits scoped VMEM for shard "
                 f"{(d, h, w)} at band depth {pad}"
             )
-        tile_d, tile_w = wt
+        use_roll = roll_tile and (
+            wt is None
+            or pallas_bitlife3d.recompute_score(roll_tile, 0, pad)
+            < pallas_bitlife3d.recompute_score(wt[0], wt[1], pad)
+        )
         packed3 = lax.bitcast_convert_type(
             bitlife3d.pack3d(vol), jnp.int32
         )  # [d, h, nw]
-        # Natural: [nw, d, h] (band=d, lanes=h); transposed: [nw, h, d].
-        packed = packed3.transpose(
-            (2, 0, 1) if band_over_planes else (2, 1, 0)
-        )
-        if full:
-            packed = lax.fori_loop(
-                0, full, lambda _, p: chunk(p, tile_d, tile_w), packed
+        if use_roll:
+            # Plane-leading: [band, nw, lanes].
+            packed = packed3.transpose(
+                (0, 2, 1) if band_over_planes else (1, 2, 0)
             )
-        p3 = lax.bitcast_convert_type(
-            packed.transpose(
-                (1, 2, 0) if band_over_planes else (2, 1, 0)
-            ),
-            jnp.uint32,
-        )
+            if full:
+                packed = lax.fori_loop(
+                    0, full, lambda _, p: chunk_roll(p, roll_tile), packed
+                )
+            p3 = lax.bitcast_convert_type(
+                packed.transpose(
+                    (0, 2, 1) if band_over_planes else (2, 0, 1)
+                ),
+                jnp.uint32,
+            )
+        else:
+            tile_d, tile_w = wt
+            # Natural: [nw, d, h] (band=d, lanes=h); transposed: [nw, h, d].
+            packed = packed3.transpose(
+                (2, 0, 1) if band_over_planes else (2, 1, 0)
+            )
+            if full:
+                packed = lax.fori_loop(
+                    0, full, lambda _, p: chunk(p, tile_d, tile_w), packed
+                )
+            p3 = lax.bitcast_convert_type(
+                packed.transpose(
+                    (1, 2, 0) if band_over_planes else (2, 1, 0)
+                ),
+                jnp.uint32,
+            )
         if rem:
             # Leftover generations on the XLA packed step, one exchange
             # each: a depth-rem blocked exchange would ship rem ghost

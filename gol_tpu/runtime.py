@@ -219,6 +219,15 @@ class GolRuntime:
                         if jax.default_backend() == "tpu" and words > 0
                         else 1
                     )
+                    if fold > 1 and shard_h % (fold * 8):
+                        raise ValueError(
+                            f"narrow shards lane-fold x{fold} on TPU, "
+                            f"which needs shard height ({shard_h}) "
+                            f"divisible by {fold * 8}"
+                        )
+                    # Height-room clause only — a fold==1 misalignment
+                    # (shard_h % 8) gets the engine's own 'multiple of 8'
+                    # error, not a wrong claim about this bound.
                     if shard_h // fold < 2 * depth + 8:
                         raise ValueError(
                             f"overlap mode needs shard height ({shard_h}"
@@ -333,12 +342,10 @@ class GolRuntime:
                 # clear of both bands at the *folded* height).  Sharded
                 # columns additionally need >= 2 words for edge strips.
                 fold_ok = fold == 1 or (
-                    shard_h % (fold * pallas_bitlife._ALIGN) == 0
-                    and (cols <= 1 or words >= 2)
-                    and (
-                        not overlap
-                        or shard_h // fold >= 2 * depth + 8
+                    pallas_bitlife.fold_feasible(
+                        shard_h, fold, overlap, depth
                     )
+                    and (cols <= 1 or words >= 2)
                 )
                 if (
                     fold_ok

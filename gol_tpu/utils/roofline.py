@@ -147,6 +147,56 @@ def roofline_3d_wt(
     )
 
 
+def ops_3d_roll_per_useful_word(tile_d: int, k: int) -> float:
+    """Mean ops per useful word of the rolling-plane 3-D kernel.
+
+    Plane-axis windows shrink per generation exactly like the 2-D
+    temporal blocking; there is NO word-ghost term — both forms of the
+    kernel (torus and band-extended) run at the shard's full x width
+    with a local word wrap, which is the whole point of the r4
+    restructure (the wt kernel paid ``(tw+2)/tw`` = ×1.5 at 1024³).
+    """
+    total = 0.0
+    for j in range(k):
+        total += (tile_d + 2 * (k - j)) * OPS_3D_WT_PER_WORD
+    return total / (tile_d * k)
+
+
+def roofline_3d_roll(
+    cells_per_sec: float, tile_d: int, k: int
+) -> Roofline:
+    ops_word = ops_3d_roll_per_useful_word(tile_d, k)
+    lane_ops = cells_per_sec / BITS * ops_word
+    return Roofline(
+        ops_per_useful_word=ops_word,
+        recompute_factor=ops_word / OPS_3D_WT_PER_WORD,
+        lane_ops_per_sec=lane_ops,
+        mfu=lane_ops / V5E_VPU_LANE_OPS,
+    )
+
+
+def bench_roofline_3d_sharded(cells_per_sec: float, size: int) -> Roofline:
+    """Attribution for the sharded 3-D flagship at a cubic volume,
+    mirroring the engine's own kernel dispatch and tile derivation
+    (``sharded3d.compiled_evolve3d_pallas``'s ``local``)."""
+    from gol_tpu.ops import pallas_bitlife3d as p3
+
+    nw = size // BITS
+    pad = 8  # the engine's default halo_depth
+    # x-unsharded dispatch (the cubic single-chip/(P,1,1) case): the
+    # rolling kernel with NO word ghosts; x-sharded shards keep wt.
+    roll = p3.pick_tile3d_roll(size, nw, size, pad)
+    if roll >= pad:  # mirror the engine's tile >= pad feasibility gate
+        return roofline_3d_roll(cells_per_sec, roll, pad)
+    wt = p3.pick_tile3d_wt(size, nw, size, pad)
+    if wt is None:
+        raise ValueError(
+            f"no fused 3-D kernel window at size {size} — nothing to "
+            "attribute"
+        )
+    return roofline_3d_wt(cells_per_sec, wt[0], wt[1], pad)
+
+
 def bench_roofline_2d(
     cells_per_sec: float, height: int, width: int, steps: int,
     tile_hint: int = 1024,

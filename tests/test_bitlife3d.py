@@ -142,6 +142,56 @@ def test_sharded3d_pallas_matches_oracle(shape, steps):
     np.testing.assert_array_equal(got, _ref3(vol, steps))
 
 
+def test_sharded3d_pallas_roll_dispatch_and_wt_fallback(monkeypatch):
+    """r4: the sharded engine dispatches between the rolling-plane and
+    word-tiled ext kernels by recompute score.  The rolling kernel wins
+    only when the shard is wider than the wt kernel's 16-word tile cap
+    (narrower shards tie — wt's whole-width tile IS the rolling window),
+    so use a 32-word shard; with roll knocked out the word-tiled path
+    must still be chosen AND stay bit-exact (the oracle suite above
+    otherwise only exercises the winner)."""
+    from gol_tpu.ops import pallas_bitlife3d
+
+    mesh = mesh_mod.make_mesh_3d((2, 1, 1), devices=jax.devices()[:2])
+    vol = _vol3((32, 128, 1024), seed=41)
+    calls = {"roll": 0, "wt": 0}
+    real_roll = pallas_bitlife3d.multi_step_pallas_packed3d_roll_ext
+    real_wt = pallas_bitlife3d.multi_step_pallas_packed3d_wt_ext
+
+    def spy_roll(*a, **k):
+        calls["roll"] += 1
+        return real_roll(*a, **k)
+
+    def spy_wt(*a, **k):
+        calls["wt"] += 1
+        return real_wt(*a, **k)
+
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_roll_ext", spy_roll
+    )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_wt_ext", spy_wt
+    )
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 16, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 16))
+    assert calls["roll"] and not calls["wt"]
+
+    calls["roll"] = calls["wt"] = 0
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
+    )
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 16, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 16))
+    assert calls["wt"] and not calls["roll"]
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+
+
 def test_sharded3d_pallas_deep_band_and_rule():
     from gol_tpu.ops.life3d import BAYS_5766
 

@@ -1,0 +1,53 @@
+"""Docs single-source-of-truth guard (VERDICT r3 #7).
+
+Round 2 and round 3 both re-opened number drift between the narrative
+docs and the measured record: README/DESIGN/PARITY quoted superseded
+rates after a retune.  The fix is structural — raw measured rates live
+ONLY in BASELINE.md (append-only, per-round sections) and in code
+docstrings adjacent to the measurement they motivated; the narrative
+docs cite "BASELINE.md r<N>" instead of embedding values.  This test
+enforces the doc side mechanically so the drift cannot re-open.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# A measured-rate literal: decimal mantissa + two-digit exponent
+# (1.93e12, 2.708e11, 8.6-9.8e11...).  Targets like "1e11" (config 4's
+# pod target, defined in BASELINE.json) deliberately don't match.
+RATE = re.compile(r"\d\.\d+e\d{2}", re.IGNORECASE)
+
+NARRATIVE_DOCS = ("README.md", "docs/DESIGN.md", "docs/PARITY.md")
+
+
+def test_narrative_docs_embed_no_measured_rates():
+    offenders = []
+    for rel in NARRATIVE_DOCS:
+        text = (REPO / rel).read_text()
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in RATE.finditer(line):
+                offenders.append(f"{rel}:{i}: {m.group(0)}")
+    assert not offenders, (
+        "measured rates belong in BASELINE.md (docs cite the round "
+        "instead):\n" + "\n".join(offenders)
+    )
+
+
+def test_narrative_docs_cite_baseline():
+    for rel in NARRATIVE_DOCS:
+        text = (REPO / rel).read_text()
+        assert "BASELINE.md" in text, (
+            f"{rel} should point readers at BASELINE.md"
+        )
+
+
+def test_baseline_has_round_sections():
+    text = (REPO / "BASELINE.md").read_text()
+    assert re.search(r"^## Measured, round \d+", text, re.MULTILINE), (
+        "BASELINE.md must keep its per-round measured sections — they are "
+        "the single source the narrative docs cite"
+    )

@@ -45,6 +45,72 @@ def test_remainder_path():
     np.testing.assert_array_equal(got, ref)
 
 
+@pytest.mark.parametrize("rule", [life3d.BAYS_4555, life3d.BAYS_5766])
+@pytest.mark.parametrize("k", [1, 3, 8])
+def test_roll_kernel_matches_xla_packed(rule, k):
+    """The rolling-plane kernel (r4): per-plane fori_loop with a count9
+    carry, in-place stores, manual output DMA — vs the XLA oracle."""
+    vol = _rand_vol(32, 8, 64, seed=k + len(rule.birth))
+    pt = jax.lax.bitcast_convert_type(
+        bitlife3d.pack3d(jnp.asarray(vol)), jnp.int32
+    ).transpose(0, 2, 1)
+    got = bitlife3d.unpack3d(
+        jax.lax.bitcast_convert_type(
+            pallas_bitlife3d.multi_step_pallas_packed3d_roll(
+                pt, 8, k, rule
+            ).transpose(0, 2, 1),
+            jnp.uint32,
+        )
+    )
+    ref = bitlife3d.evolve3d_dense_io(jnp.asarray(vol), k, rule)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_roll_kernel_matches_monolithic_plane_kernel():
+    """Bit-equality between the rolling and monolithic plane kernels on
+    the same tiling — the restructure moves memory, not arithmetic."""
+    vol = _rand_vol(32, 16, 32, seed=17)
+    pt = jax.lax.bitcast_convert_type(
+        bitlife3d.pack3d(jnp.asarray(vol)), jnp.int32
+    ).transpose(0, 2, 1)
+    a = pallas_bitlife3d.multi_step_pallas_packed3d_roll(pt, 8, 5)
+    b = pallas_bitlife3d.multi_step_pallas_packed3d(pt, 8, 5)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_roll_kernel_single_tile_whole_volume():
+    """tile == depth: grid of one, the window IS the volume (the 512³
+    configuration the big-window picker produces)."""
+    vol = _rand_vol(16, 8, 32, seed=23)
+    pt = jax.lax.bitcast_convert_type(
+        bitlife3d.pack3d(jnp.asarray(vol)), jnp.int32
+    ).transpose(0, 2, 1)
+    got = pallas_bitlife3d.multi_step_pallas_packed3d_roll(pt, 16, 8)
+    ref = pallas_bitlife3d.multi_step_pallas_packed3d(pt, 8, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_roll_kernel_validation():
+    pt = jnp.zeros((16, 2, 32), jnp.int32)
+    with pytest.raises(ValueError, match="tile"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_roll(pt, 12, 1)
+    with pytest.raises(ValueError, match="pad"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_roll(pt, 8, 16)
+    with pytest.raises(ValueError, match=">= 1"):
+        pallas_bitlife3d.multi_step_pallas_packed3d_roll(pt, 8, 0)
+
+
+def test_pick_tile3d_roll_big_windows():
+    """The rolling model fits far larger windows than the monolithic
+    one: whole-volume windows at 512³, 64-plane windows at 1024³ (where
+    the monolithic plane kernel fits nothing at all)."""
+    assert pallas_bitlife3d.pick_tile3d_roll(512, 16, 512) == 256
+    assert pallas_bitlife3d.pick_tile3d_roll(1024, 32, 1024) == 64
+    assert pallas_bitlife3d.pick_tile3d(1024, 32, 1024) == 0
+    # Degenerate: a single plane larger than the whole budget.
+    assert pallas_bitlife3d.pick_tile3d_roll(8, 4096, 4096) == 0
+
+
 def test_tile_and_depth_validation():
     pt = jnp.zeros((16, 2, 32), jnp.int32)
     with pytest.raises(ValueError, match="tile"):
@@ -73,6 +139,9 @@ def test_evolve3d_fallback_when_vmem_infeasible(monkeypatch):
     monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 0)
     monkeypatch.setattr(
         pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: None
+    )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
     )
 
     def _boom(*a, **k):
@@ -167,6 +236,9 @@ def test_evolve3d_strict_raises_instead_of_fallback(monkeypatch):
     monkeypatch.setattr(
         pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: None
     )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
+    )
     vol = jnp.zeros((8, 8, 32), jnp.uint8)
     with pytest.raises(ValueError, match="scoped VMEM"):
         pallas_bitlife3d.evolve3d(vol, 2, life3d.BAYS_4555, True)
@@ -179,6 +251,9 @@ def test_cli3d_explicit_pallas_fails_loud(monkeypatch, capsys):
     monkeypatch.setattr(
         pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: None
     )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
+    )
     rc = cli3d.main(["2", "32", "2", "64", "0", "--engine", "pallas"])
     assert rc == 255
     assert "scoped VMEM" in capsys.readouterr().out
@@ -188,6 +263,9 @@ def test_evolve3d_dispatches_to_wt(monkeypatch):
     """When the plane window is infeasible but the word-tiled one fits,
     evolve3d must take the wt kernel (not the XLA fallback)."""
     monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 0)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
+    )
     calls = []
     real = pallas_bitlife3d.multi_step_pallas_packed3d_wt
 
@@ -205,6 +283,35 @@ def test_evolve3d_dispatches_to_wt(monkeypatch):
     assert calls  # the wt kernel actually ran (incl. the remainder launch)
 
 
+def test_evolve3d_dispatches_to_roll(monkeypatch):
+    """The rolling kernel wins the score dispatch when its (bigger)
+    window recomputes least — the 1024³ situation, shrunk to interpret
+    size: roll(96) scores 1.17 against wt (48,4)'s 2.0 and plane(8)'s
+    3.0."""
+    monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 8)
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: (48, 4)
+    )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 96
+    )
+    calls = []
+    real = pallas_bitlife3d.multi_step_pallas_packed3d_roll
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_roll", spy
+    )
+    vol = _rand_vol(96, 8, 128, seed=37)
+    got = np.asarray(pallas_bitlife3d.evolve3d(jnp.asarray(vol), 11))
+    ref = np.asarray(bitlife3d.evolve3d_dense_io(jnp.asarray(vol), 11))
+    np.testing.assert_array_equal(got, ref)
+    assert calls  # the rolling kernel won the dispatch
+
+
 def test_score_dispatch_prefers_lower_recompute(monkeypatch):
     """When both kernels fit, the halo-recompute score decides: a plane
     tile of 8 (score 3.0) must lose to wt (48, 4) (score 2.0) — the 768³
@@ -212,6 +319,9 @@ def test_score_dispatch_prefers_lower_recompute(monkeypatch):
     monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 8)
     monkeypatch.setattr(
         pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: (48, 4)
+    )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
     )
     calls = []
     real = pallas_bitlife3d.multi_step_pallas_packed3d_wt
