@@ -66,7 +66,12 @@ def main() -> None:
         "weak scaling: fixed size_per_chip^2 cells per device, 1-D "
         "ring. efficiency = per-chip rate / 1-device per-chip rate. "
         "cpu_mesh = 8-virtual-device curve shape; tpu_1chip = the real "
-        "per-chip throughput the curve hangs off."
+        "per-chip throughput the curve hangs off. Virtual CPU devices "
+        "timeshare the host's cores, so aggregate throughput is flat and "
+        "per-chip efficiency falls ~1/n by construction — the CPU curve "
+        "validates the comm structure and regression-tests the programs; "
+        "real efficiency curves need real chips (the harness runs "
+        "unchanged on a pod)."
     )}
 
     if on_tpu:

@@ -274,6 +274,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 255
 
     guard_report = None
+    ckpt_writer = None
     try:
         if size <= 0:
             raise ValueError(f"size must be positive, got {size}")
@@ -399,6 +400,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # redundant checker's counterpart engine.
         resolved = _resolve_engine3d(ns.engine, mesh, size)
 
+        # Async writer for the single-device path (same overlap +
+        # final-flush contract as GolRuntime.run; the sharded save ends
+        # in a device barrier and must stay on the main thread).  The
+        # close() in main's finally drains queued writes even when the
+        # loop raises — e.g. a guard restore-budget exhaustion, the exact
+        # case mid-run snapshots exist for.
+        ckpt_writer = (
+            ckpt_mod.AsyncSnapshotWriter()
+            if ns.checkpoint_every > 0 and mesh is None and iterations > 0
+            else None
+        )
+
         def save_snapshot(b, g, fp=None):
             if mesh is not None:
                 ckpt_mod.save_sharded3d(
@@ -414,13 +427,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
                 multihost_utils.sync_global_devices("gol3d_checkpoint")
             else:
-                ckpt_mod.save3d(
-                    ckpt_mod.checkpoint3d_path(ns.checkpoint_dir, g),
-                    np.asarray(b),
-                    g,
-                    rulestr,
-                    fingerprint=fp,
-                )
+                path = ckpt_mod.checkpoint3d_path(ns.checkpoint_dir, g)
+                # Host fetch on this thread (donation fence — and a
+                # background fetch would contend with the next chunk's
+                # device execution, see GolRuntime._save_snapshot); the
+                # compressed write overlaps.
+                vol_np = np.asarray(b)
+                if ckpt_writer is not None:
+                    ckpt_writer.submit(
+                        lambda p=path, v=vol_np, g=g, fp=fp: (
+                            ckpt_mod.save3d(p, v, g, rulestr, fingerprint=fp)
+                        )
+                    )
+                else:
+                    ckpt_mod.save3d(
+                        path, vol_np, g, rulestr, fingerprint=fp
+                    )
 
         sw = Stopwatch()
         if iterations > 0:
@@ -501,6 +523,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         if ns.checkpoint_every > 0:
                             with sw.phase("checkpoint"):
                                 save_snapshot(board, generation)
+            if ckpt_writer is not None:
+                with sw.phase("checkpoint"):
+                    ckpt_writer.flush()
+                ckpt_writer.close()
             out = board
         else:
             out = placed if placed is not None else vol
@@ -532,6 +558,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # all exit cleanly with the message, not a traceback.
         print(e)
         return 255
+    finally:
+        if ckpt_writer is not None:
+            # Drain queued snapshot writes even when the loop raised
+            # (e.g. a guard restore-budget exhaustion — the exact case
+            # mid-run snapshots exist for); close() never raises.
+            ckpt_writer.close()
 
     report = sw.report(size**3 * iterations)
     if topo.is_coordinator:

@@ -35,6 +35,78 @@ class CorruptSnapshotError(ValueError):
     """The snapshot's stored fingerprint does not match its board."""
 
 
+class AsyncSnapshotWriter:
+    """Background checkpoint writer: overlap file I/O with device compute.
+
+    VERDICT r3 #6: the runtime's synchronous snapshot stalled the device
+    loop for a multi-GB compressed write per checkpoint.  The split that
+    makes async safe under buffer donation: the *device→host fetch*
+    (``np.asarray``) stays on the caller's thread — it completes before
+    the next chunk donates the device buffer — and only the *file write*
+    (compression + atomic tmp+rename, which this module's save functions
+    already implement) moves to the writer thread.
+
+    Single-process only: the multi-host sharded save ends in a global
+    device barrier, and collectives must never be issued from two
+    threads of one process.  A bounded queue (depth 2) backpressures a
+    checkpoint cadence faster than the disk instead of accumulating
+    host copies; a writer failure is sticky and re-raised on the next
+    ``submit``/``flush`` so a run cannot silently finish with missing
+    snapshots.  Crash safety is unchanged from the sync path: the
+    snapshot being written when the process dies is a ``.tmp`` file,
+    never a clobbered previous snapshot.
+    """
+
+    def __init__(self, depth: int = 2):
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name="gol-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                fn, args, kwargs = item
+                if self._err is None:  # don't pile writes onto a failure
+                    fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — surfaced at submit/flush
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err = self._err
+            raise RuntimeError(
+                "async checkpoint writer failed; the run's snapshots are "
+                "incomplete"
+            ) from err
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        """Queue one write (blocks only when ``depth`` writes are pending)."""
+        self._raise_pending()
+        self._q.put((fn, args, kwargs))
+
+    def flush(self) -> None:
+        """Wait for every queued write; re-raise any writer failure."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Drain and stop the thread (does not raise; call flush first
+        when completion must be verified)."""
+        self._q.put(None)
+        self._thread.join()
+
+
 def _halo_plane(top0: np.ndarray, bottom0: np.ndarray) -> np.ndarray:
     """Canonical 2-row plane for fingerprinting the frozen halo pair
     (halos may arrive as (W,) or (1, W))."""

@@ -49,6 +49,7 @@ import numpy as np
 
 from gol_tpu.models.state import GolState
 from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.utils import checkpoint as ckpt_mod
 from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready
 
 # Odd constants -> invertible multiplies mod 2^32; distinct per axis so
@@ -350,20 +351,33 @@ def run_guarded(
             )
 
     generation = int(state.generation)
-    board, generation = guarded_loop(
-        sw,
-        guard,
-        board,
-        generation,
-        schedule,
-        evolvers,
-        checker_evolvers,
-        config,
-        save_snapshot=lambda b, g, fp: rt._save_snapshot(
-            GolState.create(b, g), fingerprint=fp
-        ),
-        checkpoint_every=rt.checkpoint_every,
-    )
+    writer = None
+    if rt.checkpoint_every > 0 and jax.process_count() == 1:
+        # Same async overlap + final-flush contract as GolRuntime.run.
+        writer = ckpt_mod.AsyncSnapshotWriter()
+    rt._ckpt_writer = writer
+    try:
+        board, generation = guarded_loop(
+            sw,
+            guard,
+            board,
+            generation,
+            schedule,
+            evolvers,
+            checker_evolvers,
+            config,
+            save_snapshot=lambda b, g, fp: rt._save_snapshot(
+                GolState.create(b, g), fingerprint=fp
+            ),
+            checkpoint_every=rt.checkpoint_every,
+        )
+        if writer is not None:
+            with sw.phase("checkpoint"):
+                writer.flush()
+    finally:
+        rt._ckpt_writer = None
+        if writer is not None:
+            writer.close()
 
     report = sw.report(rt.geometry.cell_updates(iterations))
     return report, GolState.create(board, generation), guard

@@ -417,3 +417,109 @@ def test_sharded3d_bad_manifest_rejected(tmp_path):
     np.savez_compressed(mpath, **arrays)
     with pytest.raises(ckpt.CorruptSnapshotError, match="overlap"):
         ckpt.load_sharded3d_meta(d)
+
+
+# -- async checkpoint writer (r4): overlap file I/O with device compute ------
+
+
+def test_async_checkpointing_end_to_end(tmp_path):
+    """run() with checkpoint_every uses the background writer; after the
+    final flush every snapshot is durably renamed and loadable, and the
+    run result is unchanged."""
+    from gol_tpu.models import patterns
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        checkpoint_every=4,
+        checkpoint_dir=str(tmp_path),
+    )
+    _, state = rt.run(pattern=4, iterations=12)
+    assert rt._ckpt_writer is None  # lifecycle ended with the run
+    snaps = sorted(tmp_path.glob("ckpt_*" + ckpt.CKPT_SUFFIX))
+    assert len(snaps) == 3
+    assert not list(tmp_path.glob("*.tmp.npz"))  # no torn writes left
+    board0 = patterns.init_global(4, 64, 1)
+    for i, path in enumerate(snaps):
+        snap = ckpt.load(str(path))
+        assert snap.generation == 4 * (i + 1)
+        np.testing.assert_array_equal(
+            snap.board, oracle.run_torus(board0, snap.generation)
+        )
+    np.testing.assert_array_equal(
+        np.asarray(state.board), ckpt.load(str(snaps[-1])).board
+    )
+
+
+def test_async_writer_failure_surfaces_and_keeps_previous(
+    tmp_path, monkeypatch
+):
+    """A background write failure is sticky: the run raises (at the next
+    submit or the final flush) instead of finishing with silently missing
+    snapshots, and the snapshots written before the failure are intact."""
+    real_save = ckpt.save
+    written = []
+
+    def flaky(path, *a, **k):
+        if written:
+            raise OSError("disk full")
+        written.append(path)
+        real_save(path, *a, **k)
+
+    monkeypatch.setattr(ckpt, "save", flaky)
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        checkpoint_every=4,
+        checkpoint_dir=str(tmp_path),
+    )
+    with pytest.raises(RuntimeError, match="async checkpoint writer"):
+        rt.run(pattern=4, iterations=12)
+    snap = ckpt.load(written[0])
+    assert snap.generation == 4  # the pre-failure snapshot survived
+
+
+def test_crash_mid_write_leaves_previous_snapshot(tmp_path, monkeypatch):
+    """A crash between the tmp write and the rename (simulated by a
+    failing os.replace) leaves the previous snapshot loadable and never
+    exposes a torn file at the snapshot path."""
+    import os
+
+    board = oracle.random_board(16, 32, seed=5)
+    p1 = ckpt.checkpoint_path(str(tmp_path), 4)
+    ckpt.save(p1, board, 4, 1)
+
+    def no_replace(src, dst):
+        raise OSError("power cut")
+
+    monkeypatch.setattr(ckpt.os, "replace", no_replace)
+    w = ckpt.AsyncSnapshotWriter()
+    p2 = ckpt.checkpoint_path(str(tmp_path), 8)
+    w.submit(ckpt.save, p2, board, 8, 1)
+    with pytest.raises(RuntimeError, match="async checkpoint writer"):
+        w.flush()
+    w.close()
+    assert not os.path.exists(p2)  # never a torn snapshot at the path
+    monkeypatch.undo()
+    snap = ckpt.load(p1)
+    assert snap.generation == 4
+    np.testing.assert_array_equal(snap.board, board)
+
+
+def test_guarded_run_uses_async_writer(tmp_path):
+    """run_guarded shares the writer lifecycle: snapshots from the
+    audited loop are complete and fingerprint-stamped after the flush."""
+    from gol_tpu.models import patterns
+    from gol_tpu.utils.guard import GuardConfig, run_guarded
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        checkpoint_every=4,
+        checkpoint_dir=str(tmp_path),
+    )
+    _, state, guard = run_guarded(
+        rt, pattern=4, iterations=8, config=GuardConfig(check_every=4)
+    )
+    snaps = sorted(tmp_path.glob("ckpt_*" + ckpt.CKPT_SUFFIX))
+    assert len(snaps) == 2
+    board0 = patterns.init_global(4, 64, 1)
+    last = ckpt.load(str(snaps[-1]))  # load re-verifies the fingerprint
+    np.testing.assert_array_equal(last.board, oracle.run_torus(board0, 8))
