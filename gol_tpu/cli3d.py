@@ -524,9 +524,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             with sw.phase("checkpoint"):
                                 save_snapshot(board, generation)
             if ckpt_writer is not None:
+                # Completion fence only; main's finally owns the close.
                 with sw.phase("checkpoint"):
                     ckpt_writer.flush()
-                ckpt_writer.close()
             out = board
         else:
             out = placed if placed is not None else vol

@@ -85,6 +85,17 @@ class AsyncSnapshotWriter:
     def _raise_pending(self) -> None:
         if self._err is not None:
             err = self._err
+            if isinstance(err, (OSError, ValueError)):
+                # Preserve the type: the CLIs' clean-exit handlers catch
+                # (ValueError, OSError) — an unwritable dir or full disk
+                # must print its message and exit 255 exactly as the
+                # synchronous save path did, not become a traceback.
+                if hasattr(err, "add_note"):
+                    err.add_note(
+                        "(raised by the async checkpoint writer; the "
+                        "run's snapshots are incomplete)"
+                    )
+                raise err
             raise RuntimeError(
                 "async checkpoint writer failed; the run's snapshots are "
                 "incomplete"

@@ -144,12 +144,11 @@ def test_sharded3d_pallas_matches_oracle(shape, steps):
 
 def test_sharded3d_pallas_roll_dispatch_and_wt_fallback(monkeypatch):
     """r4: the sharded engine dispatches between the rolling-plane and
-    word-tiled ext kernels by recompute score.  The rolling kernel wins
-    only when the shard is wider than the wt kernel's 16-word tile cap
-    (narrower shards tie — wt's whole-width tile IS the rolling window),
-    so use a 32-word shard; with roll knocked out the word-tiled path
-    must still be chosen AND stay bit-exact (the oracle suite above
-    otherwise only exercises the winner)."""
+    word-tiled ext kernels by recompute score.  On x-unsharded meshes the
+    rolling kernel carries no word ghosts, so it outscores wt whenever it
+    fits; with roll knocked out the word-tiled path must still be chosen
+    AND stay bit-exact (the oracle suite above otherwise only exercises
+    the per-mesh winner)."""
     from gol_tpu.ops import pallas_bitlife3d
 
     mesh = mesh_mod.make_mesh_3d((2, 1, 1), devices=jax.devices()[:2])
