@@ -222,7 +222,10 @@ def _claims(results, size) -> list:
         from gol_tpu.parallel.mesh import place_private
         from gol_tpu.parallel.sharded3d import volume_sharding
 
-        vsize, vsteps = 1024, 256
+        # x1024: at x256 the ~130 ms tunnel RPC was still ~23% of the
+        # ~0.56 s measured interval (BASELINE.md r4 measurement
+        # discipline); x1024 cuts the dilution under 6%.
+        vsize, vsteps = 1024, 1024
         vol = jnp.asarray(
             (rng.random((vsize, vsize, vsize)) < 0.3).astype(np.uint8)
         )

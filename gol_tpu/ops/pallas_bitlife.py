@@ -594,6 +594,10 @@ def multi_step_pallas_packed_bands(
 # k=16 (1.87 vs 1.82e12 same-session sweep) — exactly the roofline's
 # recompute-factor gap (1.035 vs 1.066); the deeper block's saved
 # launches no longer pay once the loop is long enough to amortize them.
+# Round 4 negative: tile 512 at k=8 (recompute x1.017) measures ~2.5%
+# *behind* tile 256 (1.82 vs 1.87e12, interleaved best-of-5) — the
+# larger window loses more to scheduling/DMA than the halved band
+# recompute saves, so the cap stays.
 _BLOCK = 8
 _BLOCK_TILE = 256
 

@@ -78,6 +78,15 @@ class AsyncSnapshotWriter:
                 if self._err is None:  # don't pile writes onto a failure
                     fn(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — surfaced at submit/flush
+                # Note attached once, here — _raise_pending may re-raise
+                # the same object multiple times (sticky error).
+                if isinstance(e, (OSError, ValueError)) and hasattr(
+                    e, "add_note"
+                ):
+                    e.add_note(
+                        "(raised by the async checkpoint writer; the "
+                        "run's snapshots are incomplete)"
+                    )
                 self._err = e
             finally:
                 self._q.task_done()
@@ -90,11 +99,7 @@ class AsyncSnapshotWriter:
                 # (ValueError, OSError) — an unwritable dir or full disk
                 # must print its message and exit 255 exactly as the
                 # synchronous save path did, not become a traceback.
-                if hasattr(err, "add_note"):
-                    err.add_note(
-                        "(raised by the async checkpoint writer; the "
-                        "run's snapshots are incomplete)"
-                    )
+                # (The writer-thread loop attached the context note.)
                 raise err
             raise RuntimeError(
                 "async checkpoint writer failed; the run's snapshots are "
