@@ -79,16 +79,27 @@ def main() -> None:
         from gol_tpu.parallel import mesh as mesh_mod
 
         ring = mesh_mod.make_mesh_1d(1)
+        # Square headline board, plus the lane-folded pod shard (BASELINE
+        # config 3 on a 16x16 mesh: 16384x1024 cells = 32 words) — the
+        # geometry whose exchange exposure the folded overlap (r4)
+        # exists to hide.
         for engine in ("pallas", "pallas_overlap"):
-            halo[f"tpu_1ring_{engine}"] = {
-                **halobench.measure(ring, 16384, 1024, engine),
-                "size": 16384,
-                "steps": 1024,
-                "devices": 1,
-                "command": (
-                    f"python -m gol_tpu.utils.halobench 16384 1024 1d {engine}"
-                ),
-            }
+            for size, suffix in ((16384, ""), ((16384, 1024),
+                                               "_folded_pod_shard")):
+                size_str = (
+                    str(size) if isinstance(size, int)
+                    else f"{size[0]}x{size[1]}"
+                )
+                halo[f"tpu_1ring_{engine}{suffix}"] = {
+                    **halobench.measure(ring, size, 1024, engine),
+                    "size": size if isinstance(size, int) else list(size),
+                    "steps": 1024,
+                    "devices": 1,
+                    "command": (
+                        f"python -m gol_tpu.utils.halobench {size_str} "
+                        f"1024 1d {engine}"
+                    ),
+                }
         rows = scalebench.measure_weak_scaling(
             4096, 16384, "pallas", counts=[1]
         )
