@@ -98,17 +98,24 @@ def _one_generation(
     return (~center & born) | (center & keep)
 
 
-def _count9_plane(plane: jax.Array):
+def _count9_plane(plane: jax.Array, wrap: bool = True):
     """In-plane count-of-9 bit planes for one ``[nw, H]`` word plane.
 
     The x/h stage of :func:`_one_generation` restricted to a single
-    plane: x wraps on the sublane word ring (wrap concats + carry
-    shifts), h neighbors via lane rolls.  Returns the 4-bit-plane tuple
-    ``_sum3_2bit`` produces.
+    plane: the x word ring on the sublane axis (``wrap=True``: torus
+    concats; ``wrap=False``: zero edge carries — word-extended planes
+    whose outer ghost words accumulate light-cone garbage, the
+    :func:`_one_generation_wt` contract), h neighbors via lane rolls.
+    Returns the 4-bit-plane tuple ``_sum3_2bit`` produces.
     """
     h = plane.shape[1]
-    prev_w = jnp.concatenate([plane[-1:], plane[:-1]], axis=0)
-    next_w = jnp.concatenate([plane[1:], plane[:1]], axis=0)
+    if wrap:
+        prev_w = jnp.concatenate([plane[-1:], plane[:-1]], axis=0)
+        next_w = jnp.concatenate([plane[1:], plane[:1]], axis=0)
+    else:
+        zero = jnp.zeros_like(plane[:1])
+        prev_w = jnp.concatenate([zero, plane[:-1]], axis=0)
+        next_w = jnp.concatenate([plane[1:], zero], axis=0)
     west = (plane << 1) | _lsr(prev_w, 31)
     east = _lsr(plane, 1) | (next_w << 31)
     s0, s1 = bitlife._full_add(west, plane, east)
@@ -119,7 +126,10 @@ def _count9_plane(plane: jax.Array):
     )
 
 
-def _roll_generations(scratch, *, tile, k, pad, birth, survive):
+def _roll_generations(
+    scratch, *, tile, k, pad, birth, survive, read=None, store=None,
+    wrap=True,
+):
     """The rolling kernels' shared k-generation loop over one window.
 
     Each generation is a plane-ascending ``fori_loop`` carrying the
@@ -127,27 +137,40 @@ def _roll_generations(scratch, *, tile, k, pad, birth, survive):
     storing each output plane in place as soon as it is complete.
     In-place safety: storing plane ``p`` clobbers only data whose count9
     is already carried; ``center`` (plane ``p``) and the count9 of plane
-    ``p+1`` are read before the store.  The valid window shrinks one
-    plane per side per generation.
+    ``p+1`` are read through ``read`` BEFORE ``store`` runs.  The valid
+    window shrinks one plane per side per generation.  ``read(p)`` /
+    ``store(p, out)`` default to plain scratch access; the ghost-word
+    kernel passes accessors that assemble ``[ghostL | body | ghostR]``
+    planes and split the store — so the tricky invariants live here
+    once, whatever the plane layout.
     """
+    if read is None:
+        read = lambda p: scratch[p]
+    if store is None:
+        def store(p, out):
+            scratch[p] = out
+
     for j in range(k):
         lo = pad - (k - j)
         hi = pad + tile + (k - j)  # window [lo, hi); outputs [lo+1, hi-1)
 
         def body(p, carry, _birth=birth, _survive=survive):
             c9_prev, c9_cur = carry[:4], carry[4:]
-            c9_next = _count9_plane(scratch[p + 1])
+            c9_next = _count9_plane(read(p + 1), wrap)
             count27 = bitlife3d._sum3_planes(
                 c9_prev, c9_cur, c9_next, width=5
             )
-            center = scratch[p]
+            center = read(p)
             count26 = bitlife._sub_bit(count27, center)
             born = bitlife._match_counts(count26, _birth)
             keep = bitlife._match_counts(count26, _survive)
-            scratch[p] = (~center & born) | (center & keep)
+            store(p, (~center & born) | (center & keep))
             return (*c9_cur, *c9_next)
 
-        carry = (*_count9_plane(scratch[lo]), *_count9_plane(scratch[lo + 1]))
+        carry = (
+            *_count9_plane(read(lo), wrap),
+            *_count9_plane(read(lo + 1), wrap),
+        )
         jax.lax.fori_loop(lo + 1, hi - 1, body, carry)
 
 
@@ -312,6 +335,126 @@ def multi_step_pallas_packed3d_roll_ext(
         ],
         interpret=jax.default_backend() != "tpu",
     )(ext)
+
+
+def _kernel_roll_ext_g(
+    ext_hbm, gh_hbm, out_ref, scratch, gscratch, sems, *, tile, k, pad,
+    birth, survive,
+):
+    """Rolling-plane kernel with ghost word columns — the x-sharded form.
+
+    The r4 fix for Mosaic's tiled-HBM constraint (a ``[*, nw+2, lanes]``
+    array cannot be sliced at 34-of-40 sublanes): the two ghost word
+    columns ride a SEPARATE ``[band+2*pad, 8, lanes]`` operand — slots 0
+    (left) and 1 (right) real, 6 dead sublanes for alignment, costing
+    DMA bytes but no compute.  Each rolling step concatenates
+    ``[ghostL | body | ghostR]`` per plane (``nw+2`` words), evolves it
+    with zero outer carries, and splits the store back — so the compute
+    tax over the body is ``(nw+2)/nw``, replacing the wt kernel's
+    ``(tw+2)/tw`` at tw=4 (×1.06 vs ×1.5 at a 32-word shard).
+    """
+    i = pl.program_id(0)
+    base = pl.multiple_of(i * tile, _ALIGN)
+    cp = pltpu.make_async_copy(
+        ext_hbm.at[pl.ds(base, tile + 2 * pad)], scratch.at[:], sems.at[0]
+    )
+    gcp = pltpu.make_async_copy(
+        gh_hbm.at[pl.ds(base, tile + 2 * pad)], gscratch.at[:], sems.at[1]
+    )
+    cp.start()
+    gcp.start()
+    cp.wait()
+    gcp.wait()
+
+    def read(p):
+        return jnp.concatenate(
+            [gscratch[p, 0:1], scratch[p], gscratch[p, 1:2]], axis=0
+        )
+
+    def split_store(p, out):
+        scratch[p] = out[1:-1]
+        gscratch[p, 0:1] = out[0:1]
+        gscratch[p, 1:2] = out[-1:]
+
+    _roll_generations(
+        scratch, tile=tile, k=k, pad=pad, birth=birth, survive=survive,
+        read=read, store=split_store, wrap=False,
+    )
+    store = pltpu.make_async_copy(
+        scratch.at[pl.ds(pad, tile)],
+        out_ref.at[pl.ds(base, tile)],
+        sems.at[2],
+    )
+    store.start()
+    store.wait()
+
+
+GHOST_SLOTS = 8  # sublane-aligned ghost operand width (2 real + 6 dead)
+
+
+def multi_step_pallas_packed3d_roll_ext_g(
+    ext: jax.Array,
+    ghosts: jax.Array,
+    tile: int,
+    k: int,
+    rule: Rule3D = BAYS_4555,
+) -> jax.Array:
+    """k rolling generations of a band- AND word-extended shard.
+
+    ``ext[band + 2*pad, nw, lanes]`` is the shard's own words behind the
+    ring band exchange; ``ghosts[band + 2*pad, 8, lanes]`` carries the
+    exchanged ghost word columns in sublane slots 0 (left) / 1 (right)
+    (slots 2-7 ignored).  Returns the body ``[band, nw, lanes]`` — the
+    evolved ghosts are NOT returned (the next chunk's exchange rebuilds
+    them from the neighbors' bodies).  ``k <= 32``: one ghost word's bit
+    light cone.
+    """
+    pad = -(-k // _ALIGN) * _ALIGN
+    band = ext.shape[0] - 2 * pad
+    validate_tile(band, tile, _ALIGN)
+    if k < 1 or k > bitlife.BITS:
+        raise ValueError(
+            f"ghost-word rolling kernel supports 1 <= k <= {bitlife.BITS}, "
+            f"got {k}"
+        )
+    if pad > tile:
+        raise ValueError(
+            f"temporal block depth {k} needs halo pad {pad} <= tile {tile}"
+        )
+    if ghosts.shape != (ext.shape[0], GHOST_SLOTS, ext.shape[2]):
+        raise ValueError(
+            f"ghosts must be {(ext.shape[0], GHOST_SLOTS, ext.shape[2])}, "
+            f"got {ghosts.shape}"
+        )
+    return pl.pallas_call(
+        functools.partial(
+            _kernel_roll_ext_g,
+            tile=tile,
+            k=k,
+            pad=pad,
+            birth=rule.birth,
+            survive=rule.survive,
+        ),
+        grid=(band // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct(
+            (band, ext.shape[1], ext.shape[2]), ext.dtype
+        ),
+        scratch_shapes=[
+            pltpu.VMEM(
+                (tile + 2 * pad, ext.shape[1], ext.shape[2]), ext.dtype
+            ),
+            pltpu.VMEM(
+                (tile + 2 * pad, GHOST_SLOTS, ext.shape[2]), ext.dtype
+            ),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(ext, ghosts)
 
 
 def _kernel(

@@ -157,12 +157,14 @@ def compiled_evolve3d_pallas(
     *plane* band rides the PLANES ring; (2) one ghost word *column* per
     side of the already plane-extended volume rides the COLS ring, so the
     x/d corner words make two hops.  The extended volume feeds whichever
-    fused kernel scores the lower halo recompute — the rolling-plane form
-    (:func:`gol_tpu.ops.pallas_bitlife3d.
-    multi_step_pallas_packed3d_roll_ext`, usual winner: its one-window
-    VMEM model fits plane tiles the others cannot, r4) or the word-tiled
-    form (:func:`gol_tpu.ops.pallas_bitlife3d.
-    multi_step_pallas_packed3d_wt_ext`) — both the same kernels the
+    fused kernel scores the lower halo recompute — the rolling-plane
+    forms (:func:`gol_tpu.ops.pallas_bitlife3d.
+    multi_step_pallas_packed3d_roll_ext` on x-unsharded meshes, its
+    ghost-word sibling ``..._roll_ext_g`` on x-sharded ones; usual
+    winners, r4: the one-window VMEM model fits plane tiles the others
+    cannot and the word tax is at most (nw+2)/nw) or the word-tiled
+    fallback (:func:`gol_tpu.ops.pallas_bitlife3d.
+    multi_step_pallas_packed3d_wt_ext`) — the same kernels the
     single-device path runs, whose zero-filled outer-ghost light cones
     already support exactly this 1-word x halo for k <= 32 generations.
 
@@ -250,6 +252,30 @@ def compiled_evolve3d_pallas(
             ext, tile, halo_depth, rule
         )
 
+    def chunk_roll_g(pp, tile):
+        # x-sharded rolling form (r4): same band exchange, plus one ghost
+        # word column per side riding the COLS ring as a separate
+        # 8-sublane-aligned operand (slots 0/1 real; the corner words
+        # ride the second hop because the columns are sliced from the
+        # already band-extended array, exactly like chunk()).
+        top = lax.ppermute(pp[-pad:], band_axis_name, ring(band_ring, 1))
+        bot = lax.ppermute(pp[:pad], band_axis_name, ring(band_ring, -1))
+        ext = jnp.concatenate([top, pp, bot], axis=0)
+        left = lax.ppermute(ext[:, -1:], COLS, ring(num_cols, 1))
+        right = lax.ppermute(ext[:, :1], COLS, ring(num_cols, -1))
+        zeros = jnp.zeros(
+            (
+                ext.shape[0],
+                pallas_bitlife3d.GHOST_SLOTS - 2,
+                ext.shape[2],
+            ),
+            ext.dtype,
+        )
+        ghosts = jnp.concatenate([left, right, zeros], axis=1)
+        return pallas_bitlife3d.multi_step_pallas_packed3d_roll_ext_g(
+            ext, ghosts, tile, halo_depth, rule
+        )
+
     def local(vol):
         d, h, w = vol.shape  # per-shard block (static under shard_map)
         nw = w // bitlife.BITS
@@ -269,13 +295,14 @@ def compiled_evolve3d_pallas(
                 "from beyond the ring neighbor"
             )
         # Kernel dispatch by halo-recompute score, exactly like the
-        # single-device evolve3d: on x-unsharded meshes the rolling
+        # single-device evolve3d.  On x-unsharded meshes the rolling
         # kernel carries NO word ghosts at all (the shard's local x wrap
-        # is the torus) and its one-window VMEM model fits plane tiles
-        # the wt kernel cannot — measured r4, it retired the wt kernel's
-        # ×1.5 word-ghost tax at 1024³.  x-sharded meshes keep the wt
-        # kernel: its ghost word columns ride the untiled leading axis,
-        # the only layout whose HBM extents Mosaic can slice.
+        # is the torus); on x-sharded meshes its ghost-word form pays
+        # only (nw+2)/nw — the two ghost columns ride a separate
+        # 8-sublane-aligned operand, sidestepping Mosaic's tiled-HBM
+        # slicing constraint — vs the wt kernel's (tw+2)/tw at its
+        # VMEM-bound tw.  wt remains the fallback where the rolling
+        # window cannot fit.
         wt = pallas_bitlife3d.pick_tile3d_wt(
             band_extent, nw, lane_extent, pad
         )
@@ -285,11 +312,15 @@ def compiled_evolve3d_pallas(
             # under the VMEM budget and can return smaller — such a
             # candidate is infeasible here, not merely worse.
             wt = None
+        ghosted = num_cols > 1
+        budget_words = (
+            nw + pallas_bitlife3d.GHOST_SLOTS if ghosted else nw
+        )
         roll_tile = (
             pallas_bitlife3d.pick_tile3d_roll(
-                band_extent, nw, lane_extent, pad
+                band_extent, budget_words, lane_extent, pad
             )
-            if num_cols == 1 and band_extent % 8 == 0
+            if band_extent % 8 == 0
             else 0
         )
         if roll_tile < pad:
@@ -299,9 +330,16 @@ def compiled_evolve3d_pallas(
                 f"no fused kernel window fits scoped VMEM for shard "
                 f"{(d, h, w)} at band depth {pad}"
             )
+        roll_score = (
+            pallas_bitlife3d.recompute_score(
+                roll_tile, nw if ghosted else 0, pad
+            )
+            if roll_tile
+            else None
+        )
         use_roll = roll_tile and (
             wt is None
-            or pallas_bitlife3d.recompute_score(roll_tile, 0, pad)
+            or roll_score
             < pallas_bitlife3d.recompute_score(wt[0], wt[1], pad)
         )
         packed3 = lax.bitcast_convert_type(
@@ -312,9 +350,10 @@ def compiled_evolve3d_pallas(
             packed = packed3.transpose(
                 (0, 2, 1) if band_over_planes else (1, 2, 0)
             )
+            roll_body = chunk_roll_g if ghosted else chunk_roll
             if full:
                 packed = lax.fori_loop(
-                    0, full, lambda _, p: chunk_roll(p, roll_tile), packed
+                    0, full, lambda _, p: roll_body(p, roll_tile), packed
                 )
             p3 = lax.bitcast_convert_type(
                 packed.transpose(

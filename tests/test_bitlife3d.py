@@ -191,6 +191,90 @@ def test_sharded3d_pallas_roll_dispatch_and_wt_fallback(monkeypatch):
     sharded3d.compiled_evolve3d_pallas.cache_clear()
 
 
+def test_sharded3d_pallas_ghosted_roll_dispatch(monkeypatch):
+    """r4: on x-SHARDED meshes with wide shards (nw > wt's 16-word tile
+    cap) the ghost-word rolling kernel outscores wt ((nw+2)/nw vs
+    (tw+2)/tw) and must win; narrower shards tie and keep wt (pinned by
+    the oracle suite's small meshes)."""
+    from gol_tpu.ops import pallas_bitlife3d
+
+    mesh = mesh_mod.make_mesh_3d((1, 1, 2), devices=jax.devices()[:2])
+    vol = _vol3((32, 128, 2048), seed=47)  # shard nw=32, band=32, lanes=128
+    calls = {"roll_g": 0, "wt": 0}
+    real_g = pallas_bitlife3d.multi_step_pallas_packed3d_roll_ext_g
+    real_wt = pallas_bitlife3d.multi_step_pallas_packed3d_wt_ext
+
+    def spy_g(*a, **k):
+        calls["roll_g"] += 1
+        return real_g(*a, **k)
+
+    def spy_wt(*a, **k):
+        calls["wt"] += 1
+        return real_wt(*a, **k)
+
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_roll_ext_g", spy_g
+    )
+    monkeypatch.setattr(
+        pallas_bitlife3d, "multi_step_pallas_packed3d_wt_ext", spy_wt
+    )
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 16, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 16))
+    assert calls["roll_g"] and not calls["wt"]
+
+    calls["roll_g"] = calls["wt"] = 0
+    monkeypatch.setattr(
+        pallas_bitlife3d, "pick_tile3d_roll", lambda *a, **k: 0
+    )
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 16, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 16))
+    assert calls["wt"] and not calls["roll_g"]
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+
+
+def test_sharded3d_pallas_ghosted_roll_real_band_ring():
+    """The ghosted rolling form with a REAL band ring ((2,1,2): both the
+    plane band ppermutes and the ghost-column ppermutes move data between
+    devices), 32-word shards so the score dispatch picks roll_g — the
+    band x column corner two-hop runs non-degenerately."""
+    from gol_tpu.ops import pallas_bitlife3d
+
+    mesh = mesh_mod.make_mesh_3d((2, 1, 2), devices=jax.devices()[:4])
+    vol = _vol3((32, 128, 4096), seed=53)  # shard (16, 128, 2048): nw=64
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 16, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 16))
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+
+
+def test_sharded3d_pallas_ghosted_roll_corner_crossing():
+    """A live blob at the band x cols shard corner under the ghosted
+    rolling kernel: the corner words must ride the two-hop exchange."""
+    from gol_tpu.ops import pallas_bitlife3d
+
+    vol = np.zeros((32, 128, 2048), np.uint8)
+    rng = np.random.default_rng(11)
+    # Straddle the (16, :, 1024) shard junction of a (2,1,2)-ish... here
+    # (1,1,2): x junction at 1024, plus the torus x wrap at 0/2047.
+    vol[:, :, 1016:1032] = (rng.random((32, 128, 16)) < 0.5).astype(np.uint8)
+    vol[:, :, :8] = (rng.random((32, 128, 8)) < 0.5).astype(np.uint8)
+    vol[:, :, -8:] = (rng.random((32, 128, 8)) < 0.5).astype(np.uint8)
+    mesh = mesh_mod.make_mesh_3d((1, 1, 2), devices=jax.devices()[:2])
+    sharded3d.compiled_evolve3d_pallas.cache_clear()
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_pallas(jnp.asarray(vol), 19, mesh)
+    )
+    np.testing.assert_array_equal(got, _ref3(vol, 19))
+
+
 def test_sharded3d_pallas_deep_band_and_rule():
     from gol_tpu.ops.life3d import BAYS_5766
 
