@@ -871,3 +871,49 @@ def test_runtime_folded_overlap_end_to_end():
     np.testing.assert_array_equal(
         np.asarray(state.board), oracle.run_torus(board0, 10)
     )
+
+
+def test_auto_2d_overlap_dense_fallback_warns_on_tpu(monkeypatch):
+    """r4: when 2-D overlap has no packed program on TPU, auto must say
+    so (the r3 silent dense fallback hid an order-of-magnitude loss)."""
+    import warnings as warnings_mod
+
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    mesh = mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8])
+    with pytest.warns(UserWarning, match="resolving to the DENSE"):
+        rt = GolRuntime(
+            geometry=Geometry(size=128, num_ranks=1),  # 1-word shards
+            mesh=mesh,
+            shard_mode="overlap",
+        )
+    assert rt._resolved == "dense"
+    # Off-TPU the gate never ran, so no (misleading) warning fires.
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter("error")
+        rt = GolRuntime(
+            geometry=Geometry(size=128, num_ranks=1),
+            mesh=mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8]),
+            shard_mode="overlap",
+        )
+    assert rt._resolved == "dense"
+
+
+def test_fold_feasible_predicate():
+    """The one predicate behind the three fold-gating sites."""
+    from gol_tpu.ops.pallas_bitlife import fold_feasible
+
+    # Alignment clause: shard height must be a multiple of fold*8.
+    assert fold_feasible(128, 4, False, 8)
+    assert not fold_feasible(100, 4, False, 8)
+    # Overlap clause: folded height must keep an aligned interior tile
+    # clear of both bands (hg >= 2k + 8).
+    assert fold_feasible(4 * 24, 4, True, 8)  # hg = 24 == 2*8+8
+    assert not fold_feasible(4 * 16, 4, True, 8)  # hg = 16 < 24
+    assert fold_feasible(4 * 16, 4, False, 8)  # explicit mode: fine
+    # fold == 1 degenerates to plain 8-row alignment (+ overlap room).
+    assert fold_feasible(64, 1, True, 8)
+    assert not fold_feasible(20, 1, True, 8)
