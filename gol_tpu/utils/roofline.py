@@ -183,8 +183,10 @@ def bench_roofline_3d_sharded(cells_per_sec: float, size: int) -> Roofline:
 
     nw = size // BITS
     pad = 8  # the engine's default halo_depth
-    # x-unsharded dispatch (the cubic single-chip/(P,1,1) case): the
-    # rolling kernel with NO word ghosts; x-sharded shards keep wt.
+    # x-unsharded dispatch (the cubic single-chip/(P,1,1) case this
+    # bench claim measures): the rolling kernel with NO word ghosts.
+    # (x-sharded shards run the ghost-word rolling form or wt — their
+    # attribution is per-shard, not this cubic helper's job.)
     roll = p3.pick_tile3d_roll(size, nw, size, pad)
     if roll >= pad:  # mirror the engine's tile >= pad feasibility gate
         return roofline_3d_roll(cells_per_sec, roll, pad)
