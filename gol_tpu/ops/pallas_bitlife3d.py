@@ -722,14 +722,22 @@ _LIVE_WINDOWS_WT = 12
 
 def recompute_score(tile_d: int, tile_w: int, pad: int = _ALIGN) -> float:
     """Halo-recompute ratio of a kernel window: duplicated ghost compute
-    per useful output cell.  ``tile_w`` counts ghost *words* (2 total);
-    the plane axis carries ``2*pad`` ghost planes.  The plane kernel is
-    the ``tile_w -> inf`` special case (no word ghosts).  One definition
-    shared by the wt tile picker and evolve3d's kernel dispatch, so the
-    picker's objective and the dispatcher's comparison cannot drift.
+    per useful output cell.  ``tile_w`` counts ghost *words* (2 total,
+    carried the whole way); the plane-axis factor is the *mean of the
+    shrinking windows* — every kernel form evolves ``tile_d + 2*(pad-j)``
+    planes at generation ``j``, so the per-generation mean is
+    ``(tile_d + pad + 1) / tile_d``, exactly the basis of
+    ``roofline.ops_3d_roll_per_useful_word`` / ``ops_3d_wt_per_useful_
+    word`` — not the full first-window ``(tile_d + 2*pad) / tile_d``,
+    which overweighted deep pads and could keep wt on near-tie shards
+    where roll recomputes less (ADVICE r4).  The plane kernel is the
+    ``tile_w -> inf`` special case (no word ghosts).  One definition
+    shared by the wt tile picker and the dispatch sites (evolve3d,
+    sharded3d), so the picker's objective and the dispatchers'
+    comparisons cannot drift.
     """
     word_factor = (tile_w + 2) / tile_w if tile_w else 1.0
-    return word_factor * ((tile_d + 2 * pad) / tile_d)
+    return word_factor * ((tile_d + pad + 1) / tile_d)
 
 
 def pick_tile3d_wt(depth: int, nw: int, h: int, pad: int = _ALIGN):

@@ -144,6 +144,65 @@ def evolve_sharded3d_packed(
     )
 
 
+def kernel_plan3d(
+    band_extent: int, nw: int, lane_extent: int, pad: int, ghosted: bool
+):
+    """Which fused kernel :func:`compiled_evolve3d_pallas` dispatches for
+    one shard, and at what tile — factored out of the engine so tests can
+    assert the dispatch choice directly (the Hypothesis kernel-matrix
+    sweep uses it to prove it reaches the ghosted rolling regime).
+
+    Dispatch is by halo-recompute score
+    (:func:`gol_tpu.ops.pallas_bitlife3d.recompute_score`, the
+    shrinking-window per-generation mean), exactly like the single-device
+    evolve3d.  On x-unsharded meshes (``ghosted=False``) the rolling
+    kernel carries NO word ghosts at all (the shard's local x wrap is the
+    torus); on x-sharded meshes its ghost-word form pays only
+    ``(nw+2)/nw`` — the two ghost columns ride a separate
+    8-sublane-aligned operand, sidestepping Mosaic's tiled-HBM slicing
+    constraint — vs the wt kernel's ``(tw+2)/tw`` at its VMEM-bound
+    ``tw``.  wt remains the fallback where the rolling window cannot fit.
+
+    Returns ``("roll_g" | "roll", tile)`` or ``("wt", (tile_d, tile_w))``;
+    raises when no fused window fits scoped VMEM.
+    """
+    from gol_tpu.ops import pallas_bitlife3d
+
+    wt = pallas_bitlife3d.pick_tile3d_wt(band_extent, nw, lane_extent, pad)
+    if wt is not None and wt[0] < pad:
+        # The kernels need tile >= pad (the window shrink must stay
+        # inside one tile's halo); the pickers optimize recompute under
+        # the VMEM budget and can return smaller — such a candidate is
+        # infeasible here, not merely worse.
+        wt = None
+    budget_words = nw + pallas_bitlife3d.GHOST_SLOTS if ghosted else nw
+    roll_tile = (
+        pallas_bitlife3d.pick_tile3d_roll(
+            band_extent, budget_words, lane_extent, pad
+        )
+        if band_extent % 8 == 0
+        else 0
+    )
+    if roll_tile < pad:
+        roll_tile = 0
+    if wt is None and not roll_tile:
+        raise ValueError(
+            f"no fused kernel window fits scoped VMEM for a shard with "
+            f"banded extent {band_extent}, {nw} packed words, lane extent "
+            f"{lane_extent} at band depth {pad}"
+        )
+    use_roll = roll_tile and (
+        wt is None
+        or pallas_bitlife3d.recompute_score(
+            roll_tile, nw if ghosted else 0, pad
+        )
+        < pallas_bitlife3d.recompute_score(wt[0], wt[1], pad)
+    )
+    if use_roll:
+        return ("roll_g" if ghosted else "roll", roll_tile)
+    return ("wt", wt)
+
+
 @functools.lru_cache(maxsize=64)
 def compiled_evolve3d_pallas(
     mesh: Mesh, steps: int, rule: Rule3D = BAYS_4555, halo_depth: int = 8
@@ -294,54 +353,15 @@ def compiled_evolve3d_pallas(
                 f"exchanged band {pad}: the ghost band would need layers "
                 "from beyond the ring neighbor"
             )
-        # Kernel dispatch by halo-recompute score, exactly like the
-        # single-device evolve3d.  On x-unsharded meshes the rolling
-        # kernel carries NO word ghosts at all (the shard's local x wrap
-        # is the torus); on x-sharded meshes its ghost-word form pays
-        # only (nw+2)/nw — the two ghost columns ride a separate
-        # 8-sublane-aligned operand, sidestepping Mosaic's tiled-HBM
-        # slicing constraint — vs the wt kernel's (tw+2)/tw at its
-        # VMEM-bound tw.  wt remains the fallback where the rolling
-        # window cannot fit.
-        wt = pallas_bitlife3d.pick_tile3d_wt(
-            band_extent, nw, lane_extent, pad
-        )
-        if wt is not None and wt[0] < pad:
-            # The kernels need tile >= pad (the window shrink must stay
-            # inside one tile's halo); the pickers optimize recompute
-            # under the VMEM budget and can return smaller — such a
-            # candidate is infeasible here, not merely worse.
-            wt = None
+        # Kernel dispatch by halo-recompute score (see kernel_plan3d —
+        # module-level so tests can assert the choice directly).
         ghosted = num_cols > 1
-        budget_words = (
-            nw + pallas_bitlife3d.GHOST_SLOTS if ghosted else nw
+        kind, tile_info = kernel_plan3d(
+            band_extent, nw, lane_extent, pad, ghosted
         )
-        roll_tile = (
-            pallas_bitlife3d.pick_tile3d_roll(
-                band_extent, budget_words, lane_extent, pad
-            )
-            if band_extent % 8 == 0
-            else 0
-        )
-        if roll_tile < pad:
-            roll_tile = 0
-        if wt is None and not roll_tile:
-            raise ValueError(
-                f"no fused kernel window fits scoped VMEM for shard "
-                f"{(d, h, w)} at band depth {pad}"
-            )
-        roll_score = (
-            pallas_bitlife3d.recompute_score(
-                roll_tile, nw if ghosted else 0, pad
-            )
-            if roll_tile
-            else None
-        )
-        use_roll = roll_tile and (
-            wt is None
-            or roll_score
-            < pallas_bitlife3d.recompute_score(wt[0], wt[1], pad)
-        )
+        use_roll = kind != "wt"
+        roll_tile = tile_info if use_roll else 0
+        wt = None if use_roll else tile_info
         packed3 = lax.bitcast_convert_type(
             bitlife3d.pack3d(vol), jnp.int32
         )  # [d, h, nw]

@@ -63,6 +63,7 @@ class AsyncSnapshotWriter:
 
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._err: Optional[BaseException] = None
+        self._err_raised = False  # surfaced via submit/flush already?
         self._thread = threading.Thread(
             target=self._loop, name="gol-ckpt-writer", daemon=True
         )
@@ -94,6 +95,7 @@ class AsyncSnapshotWriter:
     def _raise_pending(self) -> None:
         if self._err is not None:
             err = self._err
+            self._err_raised = True
             if isinstance(err, (OSError, ValueError)):
                 # Preserve the type: the CLIs' clean-exit handlers catch
                 # (ValueError, OSError) — an unwritable dir or full disk
@@ -118,9 +120,27 @@ class AsyncSnapshotWriter:
 
     def close(self) -> None:
         """Drain and stop the thread (does not raise; call flush first
-        when completion must be verified)."""
+        when completion must be verified).
+
+        A sticky writer failure that was never surfaced through
+        ``submit``/``flush`` is *printed* to stderr here: the
+        abnormal-exit paths (cli3d's ``finally``, ``run_guarded`` after a
+        GuardError) call close() without a prior flush, and a failed
+        mid-run snapshot — exactly what a post-crash resume needs — must
+        leave a trace on the failing run's stderr rather than vanish.
+        (Already-raised errors are not re-printed: the normal
+        flush-then-close path reports once, via the raise.)
+        """
         self._q.put(None)
         self._thread.join()
+        if self._err is not None and not self._err_raised:
+            import sys
+
+            print(
+                "gol: async checkpoint writer failed; the run's snapshots "
+                f"are incomplete: {self._err!r}",
+                file=sys.stderr,
+            )
 
 
 def _halo_plane(top0: np.ndarray, bottom0: np.ndarray) -> np.ndarray:

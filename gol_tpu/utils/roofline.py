@@ -179,10 +179,18 @@ def bench_roofline_3d_sharded(cells_per_sec: float, size: int) -> Roofline:
     """Attribution for the sharded 3-D flagship at a cubic volume,
     mirroring the engine's own kernel dispatch and tile derivation
     (``sharded3d.compiled_evolve3d_pallas``'s ``local``)."""
+    import inspect
+
     from gol_tpu.ops import pallas_bitlife3d as p3
+    from gol_tpu.parallel import sharded3d
 
     nw = size // BITS
-    pad = 8  # the engine's default halo_depth
+    # The engine's default halo_depth, read off its signature (like
+    # bench_roofline_2d_ring) so the attribution cannot drift from the
+    # executed configuration if the default changes.
+    pad = inspect.signature(
+        sharded3d.compiled_evolve3d_pallas
+    ).parameters["halo_depth"].default
     # x-unsharded dispatch (the cubic single-chip/(P,1,1) case this
     # bench claim measures): the rolling kernel with NO word ghosts.
     # (x-sharded shards run the ghost-word rolling form or wt — their

@@ -238,6 +238,21 @@ def test_sharded3d_pallas_ghosted_roll_dispatch(monkeypatch):
     sharded3d.compiled_evolve3d_pallas.cache_clear()
 
 
+def test_kernel_plan3d_reaches_ghosted_roll():
+    """The engine's dispatch helper (factored out in r5 so the choice is
+    directly assertable) picks the ghosted rolling kernel both at the
+    dryrun tier (g) shard shape — 34-word x-shards of a (2,1,2) mesh,
+    band extent 8, lanes 128 — and at the Hypothesis sweep's wide draw
+    (17 odd words per shard: wt's only word tiling is tile_w=1)."""
+    kind, tile = sharded3d.kernel_plan3d(8, 34, 128, 8, ghosted=True)
+    assert kind == "roll_g" and tile >= 8
+    kind, tile = sharded3d.kernel_plan3d(16, 17, 16, 8, ghosted=True)
+    assert kind == "roll_g" and tile >= 8
+    # x-unsharded: the plain rolling form, no word ghosts.
+    kind, _ = sharded3d.kernel_plan3d(16, 32, 128, 8, ghosted=False)
+    assert kind == "roll"
+
+
 def test_sharded3d_pallas_ghosted_roll_real_band_ring():
     """The ghosted rolling form with a REAL band ring ((2,1,2): both the
     plane band ppermutes and the ghost-column ppermutes move data between

@@ -286,8 +286,8 @@ def test_evolve3d_dispatches_to_wt(monkeypatch):
 def test_evolve3d_dispatches_to_roll(monkeypatch):
     """The rolling kernel wins the score dispatch when its (bigger)
     window recomputes least — the 1024³ situation, shrunk to interpret
-    size: roll(96) scores 1.17 against wt (48,4)'s 2.0 and plane(8)'s
-    3.0."""
+    size: roll(96) scores 1.09 against wt (48,4)'s 1.78 and plane(8)'s
+    2.13 (shrinking-window mean, pad 8)."""
     monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 8)
     monkeypatch.setattr(
         pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: (48, 4)
@@ -314,8 +314,9 @@ def test_evolve3d_dispatches_to_roll(monkeypatch):
 
 def test_score_dispatch_prefers_lower_recompute(monkeypatch):
     """When both kernels fit, the halo-recompute score decides: a plane
-    tile of 8 (score 3.0) must lose to wt (48, 4) (score 2.0) — the 768³
-    situation, shrunk to interpret-mode size."""
+    tile of 8 (score 2.13) must lose to wt (48, 4) (score 1.78) — the
+    768³ situation, shrunk to interpret-mode size (shrinking-window mean,
+    pad 8)."""
     monkeypatch.setattr(pallas_bitlife3d, "pick_tile3d", lambda *a, **k: 8)
     monkeypatch.setattr(
         pallas_bitlife3d, "pick_tile3d_wt", lambda *a, **k: (48, 4)
