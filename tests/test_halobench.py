@@ -115,3 +115,51 @@ def test_main_rectangular_size(capsys):
     halobench.main(["64x128", "4", "1d"])
     payload = json.loads(capsys.readouterr().out.strip())
     assert payload["size"] == [64, 128]
+
+
+def test_measure3d_attributes_both_orientations():
+    """r5 (VERDICT r4 #4): the 3-D flagship's exchange/step/kernel
+    attribution exists, on both band orientations, with the ghost-word
+    column phase live (x sharded)."""
+    import jax
+
+    for shape, size in (((2, 1, 2), (16, 16, 128)), ((1, 2, 2), (16, 16, 128))):
+        mesh = mesh_mod.make_mesh_3d(shape, devices=jax.devices()[:4])
+        out = halobench.measure3d(mesh, size, steps=8)
+        assert out["step_s"] > 0 and out["stencil_s"] > 0
+        assert out["exchange_s"] > 0 and out["exposed_exchange_s"] >= 0
+
+
+def test_measure3d_one_device_flags_degenerate_ceiling():
+    import jax
+
+    mesh = mesh_mod.make_mesh_3d((1, 1, 1), devices=jax.devices()[:1])
+    out = halobench.measure3d(mesh, (16, 16, 64), steps=8)
+    assert "ceiling_note" in out
+
+
+def test_3d_exchange_program_keeps_all_six_ppermutes():
+    """Each phase's fold must feed the next phase's shipped faces, or XLA
+    dead-code-eliminates later phases and the tool times a 1-axis ring."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh_mod.make_mesh_3d((2, 2, 2))
+    fn = halobench._exchange_only_3d(mesh, 1)
+    spec = jax.ShapeDtypeStruct(
+        (8, 8, 64),
+        "uint8",
+        sharding=jax.sharding.NamedSharding(
+            mesh, P("planes", "rows", "cols")
+        ),
+    )
+    hlo = fn.lower(spec).compile().as_text()
+    assert hlo.count("collective-permute") >= 6
+
+
+def test_main_3d_mode(capsys):
+    halobench.main(["16x16x128", "8", "3d:2,1,2"])
+    payload = json.loads(capsys.readouterr().out.strip())
+    assert payload["size"] == [16, 16, 128]
+    assert payload["mesh"] == {"planes": 2, "rows": 1, "cols": 2}
+    assert payload["engine"] == "pallas3d"
