@@ -19,11 +19,18 @@ structure, not for absolute numbers; on a real pod the same harness runs
 unchanged over ICI.
 
 Run as a module for a JSON report:
-``python -m gol_tpu.utils.scalebench [size_per_chip] [steps] [engine]``
-(engine ``dense`` | ``bitpack`` | ``pallas`` | ``pallas_overlap`` — the
-last two are the flagship fused-kernel-per-shard program in its serial
-and comm/compute-overlap forms; on TPU they need ``size_per_chip`` to be
-a multiple of 4096 so the packed width fills whole 128-lane tiles).
+``python -m gol_tpu.utils.scalebench [size_per_chip] [steps] [engine]
+[mesh {1d,2d}]`` (engine ``dense`` | ``bitpack`` | ``pallas`` |
+``pallas_overlap`` — the last two are the flagship fused-kernel-per-shard
+program in its serial and comm/compute-overlap forms).
+
+``mesh 2d`` sweeps the *pod decomposition* (BASELINE config 3's 16×16
+block mesh, scaled to each device count as the near-square factorization
+with rows <= cols: 8 devices -> 2×4): every device owns a fixed
+``S×S`` block of a ``(rows·S) × (cols·S)`` world, the two-phase
+row+word-column exchange replaces the 1-D ring, and narrow shards take
+the lane-folded kernel — the engine/mesh combination a real pod would
+run, which the 1-D sweep cannot see (VERDICT r4 #3).
 
 **Multi-host sweeps** (the config-4 pod shape): pass the same trio as the
 CLI — ``--coordinator HOST:PORT --num-processes N --process-id I`` — on
@@ -63,11 +70,33 @@ def device_counts(limit: Optional[int] = None) -> List[int]:
     return counts
 
 
+def factor_2d(n: int):
+    """Near-square ``(rows, cols)`` with rows <= cols: the config-3 pod
+    decomposition (16×16 at 256 devices) scaled to ``n`` (8 -> 2×4)."""
+    r = int(np.sqrt(n))
+    while n % r:
+        r -= 1
+    return (min(r, n // r), max(r, n // r))
+
+
+def _mesh_and_shape(n: int, size_per_chip: int, mesh_kind: str):
+    """The row's mesh and world shape: every device owns ``S×S`` cells."""
+    if mesh_kind == "2d":
+        rows, cols = factor_2d(n)
+        mesh = mesh_mod.make_mesh_2d(
+            (rows, cols), devices=jax.devices()[:n]
+        )
+        return mesh, (rows * size_per_chip, cols * size_per_chip)
+    mesh = mesh_mod.make_mesh_1d(num_devices=n)
+    return mesh, (n * size_per_chip, size_per_chip)
+
+
 def measure_weak_scaling(
     size_per_chip: int,
     steps: int,
     engine: str = "dense",
     counts: Optional[List[int]] = None,
+    mesh_kind: str = "1d",
 ) -> List[Dict[str, float]]:
     """One weak-scaling sweep; returns a row per device count.
 
@@ -80,16 +109,24 @@ def measure_weak_scaling(
     """
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}; expected {ENGINES}")
+    if mesh_kind not in ("1d", "2d"):
+        raise ValueError(f"unknown mesh kind {mesh_kind!r}; expected 1d|2d")
     counts = device_counts() if counts is None else list(counts)
     if not counts or counts[0] != 1:
         # Efficiency is defined against the 1-device throughput; a sweep
         # that skips it would silently re-baseline on its first row.
         raise ValueError(f"counts must start at 1, got {counts}")
     pallas_like = engine in ("pallas", "pallas_overlap")
-    if pallas_like and jax.default_backend() == "tpu":
+    if (
+        pallas_like
+        and mesh_kind == "1d"
+        and jax.default_backend() == "tpu"
+    ):
         # Surface the fused kernel's lane constraint early (it otherwise
         # raises deep inside shard_map tracing).  Loop-invariant: the
-        # width axis is unsharded on the 1-D row mesh.
+        # width axis is unsharded on the 1-D row mesh.  (2-D sweeps
+        # lane-fold narrow shards instead; their geometry is validated
+        # per row below.)
         from gol_tpu.ops import bitlife, pallas_bitlife
 
         lane_cells = pallas_bitlife._LANE * bitlife.BITS
@@ -106,8 +143,7 @@ def measure_weak_scaling(
     # everywhere *before* the first row barrier — a participant raising
     # mid-sweep would leave the idle processes deadlocked at theirs.
     for n in counts:
-        mesh = mesh_mod.make_mesh_1d(num_devices=n)
-        shape = (n * size_per_chip, size_per_chip)
+        mesh, shape = _mesh_and_shape(n, size_per_chip, mesh_kind)
         if pallas_like or engine == "bitpack":
             # Packable widths are >= 32, so the square shard also always
             # clears the overlap form's 24-row interior/boundary minimum.
@@ -117,18 +153,15 @@ def measure_weak_scaling(
     rows: List[Dict[str, float]] = []
     base_per_chip: Optional[float] = None
     for n in counts:
-        mesh = mesh_mod.make_mesh_1d(num_devices=n)
+        mesh, world = _mesh_and_shape(n, size_per_chip, mesh_kind)
         participating = {d.process_index for d in mesh.devices.flat}
         try:
             if me in participating:
-                height = n * size_per_chip
                 # Per-row seed: every process that measures row n builds
                 # the identical board with no sequential PRNG coupling, so
                 # idle processes skip at zero cost.
                 rng = np.random.default_rng((0, n))
-                board_np = (
-                    rng.random((height, size_per_chip)) < 0.35
-                ).astype(np.uint8)
+                board_np = (rng.random(world) < 0.35).astype(np.uint8)
                 board = mesh_mod.shard_board(jnp.asarray(board_np), mesh)
                 if pallas_like:
                     # The flagship multi-chip program (fused kernel per
@@ -145,13 +178,14 @@ def measure_weak_scaling(
                         mesh, steps, "explicit", 1
                     )
                 dt = time_best(evolve, lambda b=board: jnp.array(b, copy=True))
-                updates = height * size_per_chip * steps
+                updates = world[0] * world[1] * steps
                 per_chip = updates / dt / n
                 if base_per_chip is None:
                     base_per_chip = per_chip
                 rows.append(
                     {
                         "devices": n,
+                        "mesh": dict(mesh.shape),
                         "seconds": dt,
                         "updates_per_s": updates / dt,
                         "per_chip": per_chip,
@@ -183,13 +217,14 @@ def main(argv=None) -> None:
     size = int(ns.positionals[0]) if len(ns.positionals) > 0 else 1024
     steps = int(ns.positionals[1]) if len(ns.positionals) > 1 else 64
     engine = ns.positionals[2] if len(ns.positionals) > 2 else "dense"
+    mesh_kind = ns.positionals[3] if len(ns.positionals) > 3 else "1d"
 
     from gol_tpu.parallel import multihost
 
     topo = multihost.init_multihost(
         ns.coordinator, ns.num_processes, ns.process_id
     )
-    rows = measure_weak_scaling(size, steps, engine)
+    rows = measure_weak_scaling(size, steps, engine, mesh_kind=mesh_kind)
     if topo.is_coordinator:
         # Process 0 owns the full curve (its devices lead the global list,
         # so it participates in every row, including the 1-device
@@ -200,6 +235,7 @@ def main(argv=None) -> None:
                     "size_per_chip": size,
                     "steps": steps,
                     "engine": engine,
+                    "mesh_kind": mesh_kind,
                     "platform": jax.devices()[0].platform,
                     "processes": topo.process_count,
                     "rows": rows,

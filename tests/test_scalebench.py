@@ -117,6 +117,52 @@ def test_pallas_overlap_engine_sweep():
     assert all(r["updates_per_s"] > 0 for r in rows)
 
 
+def test_factor_2d_near_square():
+    assert scalebench.factor_2d(1) == (1, 1)
+    assert scalebench.factor_2d(2) == (1, 2)
+    assert scalebench.factor_2d(4) == (2, 2)
+    assert scalebench.factor_2d(8) == (2, 4)
+    assert scalebench.factor_2d(256) == (16, 16)  # config 3's pod mesh
+
+
+def test_weak_scaling_2d_mesh_dense():
+    """r5 (VERDICT r4 #3): the sweep can run the pod decomposition —
+    near-square 2-D block meshes with S×S cells per device."""
+    rows = scalebench.measure_weak_scaling(
+        128, steps=4, engine="dense", counts=[1, 2, 4, 8], mesh_kind="2d"
+    )
+    assert [r["mesh"] for r in rows] == [
+        {"rows": 1, "cols": 1},
+        {"rows": 1, "cols": 2},
+        {"rows": 2, "cols": 2},
+        {"rows": 2, "cols": 4},
+    ]
+    assert rows[0]["efficiency"] == 1.0
+    assert all(r["updates_per_s"] > 0 for r in rows)
+
+
+def test_weak_scaling_2d_mesh_pallas():
+    """The flagship engine over the 2-D pod mesh (two-phase exchange +
+    edge-strip repair under the harness; interpret mode)."""
+    rows = scalebench.measure_weak_scaling(
+        64, steps=8, engine="pallas", counts=[1, 2], mesh_kind="2d"
+    )
+    assert [r["devices"] for r in rows] == [1, 2]
+    assert all(r["updates_per_s"] > 0 for r in rows)
+
+
+def test_unknown_mesh_kind_rejected():
+    with pytest.raises(ValueError, match="mesh kind"):
+        scalebench.measure_weak_scaling(64, 2, mesh_kind="3d")
+
+
+def test_main_mesh_kind_positional(capsys):
+    scalebench.main(["128", "2", "dense", "2d"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["mesh_kind"] == "2d"
+    assert out["rows"][-1]["mesh"] == {"rows": 2, "cols": 4}
+
+
 def test_pallas_overlap_engine_unpackable_width_rejected():
     import pytest as _pytest
 
