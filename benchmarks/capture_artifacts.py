@@ -49,6 +49,58 @@ def _cpu_json(args: list) -> dict:
     return payload
 
 
+def _cpu_json_2proc(args: list, devices_per_proc: int = 4) -> dict:
+    """Run a module across two real coordinator-connected OS processes
+    (Gloo over localhost, 2×4 = 8 global CPU devices); process 0 prints
+    the report."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        coord = f"localhost:{s.getsockname()[1]}"
+    env = {
+        **CPU_ENV,
+        "XLA_FLAGS": (
+            f"--xla_force_host_platform_device_count={devices_per_proc}"
+        ),
+    }
+    trio = ["--coordinator", coord, "--num-processes", "2"]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", *args, *trio, "--process-id", str(i)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=900)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        if rc != 0:
+            raise RuntimeError(
+                f"2-process worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+            )
+    payload = json.loads(outs[0][1].strip().splitlines()[-1])
+    payload["command"] = (
+        "2 processes x "
+        f"{devices_per_proc} CPU devices: JAX_PLATFORMS=cpu "
+        f"XLA_FLAGS=--xla_force_host_platform_device_count={devices_per_proc} "
+        "python -m " + " ".join(args)
+        + " --coordinator HOST:PORT --num-processes 2 --process-id {0,1}"
+    )
+    return payload
+
+
 def main() -> None:
     rnd = int(sys.argv[1]) if len(sys.argv) > 1 else 0
     import jax
@@ -56,17 +108,24 @@ def main() -> None:
     on_tpu = jax.devices()[0].platform == "tpu"
 
     halo = {"note": (
-        "seconds per generation. exchange_s = ppermute ring alone; "
-        "step_s = full sharded program; stencil_s = single-device "
-        "compute ceiling; exposed_exchange_s = step - stencil (what "
-        "latency hiding can win). TPU sections are real-chip; cpu_mesh "
-        "sections are 8-virtual-device curve shape only."
+        "seconds per generation. exchange_s = the ppermute exchange loop "
+        "alone (received halos folded into the boundary rows/faces only "
+        "— O(boundary) anti-DCE, r5; 3-D sections ship a dense one-cell "
+        "shell per generation, an upper bound on the packed band ring's "
+        "wire time); step_s = full sharded program; stencil_s = "
+        "single-device compute ceiling; exposed_exchange_s = step - "
+        "stencil (what latency hiding can win). TPU sections are "
+        "real-chip; cpu_mesh sections are 8-virtual-device curve shape "
+        "only."
     )}
     scale = {"note": (
-        "weak scaling: fixed size_per_chip^2 cells per device, 1-D "
-        "ring. efficiency = per-chip rate / 1-device per-chip rate. "
-        "cpu_mesh = 8-virtual-device curve shape; tpu_1chip = the real "
-        "per-chip throughput the curve hangs off. Virtual CPU devices "
+        "weak scaling: fixed size_per_chip^2 cells per device; 1-D ring "
+        "or the 2-D pod decomposition (near-square mesh, the config-3 "
+        "16x16 shape scaled to n devices). efficiency = per-chip rate / "
+        "1-device per-chip rate. cpu_mesh = 8-virtual-device curve "
+        "shape; tpu_1chip = the real per-chip throughput the curve "
+        "hangs off; 2proc sections run two real coordinator-connected "
+        "OS processes (Gloo, 2x4 devices). Virtual CPU devices "
         "timeshare the host's cores, so aggregate throughput is flat and "
         "per-chip efficiency falls ~1/n by construction — the CPU curve "
         "validates the comm structure and regression-tests the programs; "
@@ -110,6 +169,18 @@ def main() -> None:
             "rows": rows,
             "command": "scalebench.measure_weak_scaling(4096, 16384, 'pallas', counts=[1])",
         }
+        # 3-D flagship attribution on the real chip's one-device ring
+        # (VERDICT r4 #4); the non-degenerate rings are the cpu_mesh 3-D
+        # sections below.
+        halo["tpu_1ring_pallas3d"] = {
+            **halobench.measure3d(
+                mesh_mod.make_mesh_3d((1, 1, 1), devices=None), 512, 512
+            ),
+            "size": 512,
+            "steps": 512,
+            "devices": 1,
+            "command": "python -m gol_tpu.utils.halobench 512x512x512 512 3d",
+        }
     else:
         print("capture_artifacts: no TPU visible; TPU sections skipped",
               file=sys.stderr)
@@ -123,11 +194,42 @@ def main() -> None:
     halo["cpu_mesh_dense_2d"] = _cpu_json(
         ["gol_tpu.utils.halobench", "1024", "32", "2d", "dense"]
     )
+    # 3-D flagship attribution over real (virtual-device) rings, both
+    # band orientations, x sharded so the ghost-word-column second phase
+    # runs (wide 17-word shards keep the ghosted rolling kernel in
+    # dispatch, matching the Hypothesis sweep's wide draw).
+    halo["cpu_mesh_pallas3d_planes_banded"] = _cpu_json(
+        ["gol_tpu.utils.halobench", "32x16x1088", "16", "3d:4,1,2"]
+    )
+    halo["cpu_mesh_pallas3d_rows_banded"] = _cpu_json(
+        ["gol_tpu.utils.halobench", "16x32x1088", "16", "3d:1,4,2"]
+    )
     scale["cpu_mesh_dense"] = _cpu_json(
         ["gol_tpu.utils.scalebench", "512", "32", "dense"]
     )
     scale["cpu_mesh_bitpack"] = _cpu_json(
         ["gol_tpu.utils.scalebench", "512", "32", "bitpack"]
+    )
+    # The pod decomposition (VERDICT r4 #3): 2-D near-square meshes, all
+    # four engines including the flagship fused-kernel forms (interpret
+    # mode on CPU — curve shape and program validation, not chip rates).
+    scale["cpu_mesh_dense_2d"] = _cpu_json(
+        ["gol_tpu.utils.scalebench", "512", "32", "dense", "2d"]
+    )
+    scale["cpu_mesh_bitpack_2d"] = _cpu_json(
+        ["gol_tpu.utils.scalebench", "512", "32", "bitpack", "2d"]
+    )
+    scale["cpu_mesh_pallas_2d"] = _cpu_json(
+        ["gol_tpu.utils.scalebench", "256", "16", "pallas", "2d"]
+    )
+    scale["cpu_mesh_pallas_overlap_2d"] = _cpu_json(
+        ["gol_tpu.utils.scalebench", "256", "16", "pallas_overlap", "2d"]
+    )
+    # One real multi-process curve: two coordinator-connected OS
+    # processes (Gloo), rows 1-4 measured by process 0 alone, row 8
+    # spanning the process boundary — the config-4 pod shape in miniature.
+    scale["cpu_mesh_dense_2proc"] = _cpu_json_2proc(
+        ["gol_tpu.utils.scalebench", "512", "32", "dense"]
     )
 
     for name, payload in (("HALO", halo), ("SCALE", scale)):
