@@ -1,0 +1,79 @@
+"""Which axis of "small board" costs the rate: row width or row count?
+
+exp_folded_gap found the bare torus kernel at the fold=4 layout's
+[4096, 128-word] geometry runs at ~1.27e12 cell-updates/s (43.5% MFU)
+vs the 16384^2 flagship's ~1.98e12 — so most of the folded pod-shard
+gap is the *geometry*, not the ring.  A 16384x1024 shard can be folded
+deeper than the minimal fold=4: fold=8 gives [2048, 256w], fold=16
+gives [1024, 512w] — the flagship's exact row width.  This script
+measures the bare torus kernel (no ring, no groups — pure geometry)
+at each equivalent board shape, same-session with the 16384^2
+reference, to find whether deeper folding can recover the issue rate.
+
+Usage: ``python benchmarks/exp_fold_width.py [steps] [reps]`` on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+CELLS = 16384 * 1024  # the pod shard's cell count
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import pallas_bitlife
+    from gol_tpu.utils.timing import force_ready
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    rng = np.random.default_rng(2)
+    shapes = {
+        "fold4_equiv_4096x4096": (4096, 4096),
+        "fold8_equiv_2048x8192": (2048, 8192),
+        "fold16_equiv_1024x16384": (1024, 16384),
+        "fold32_equiv_512x32768": (512, 32768),
+        "flagship_16384sq_ref": (16384, 16384),
+    }
+    boards, best = {}, {}
+    for name, shape in shapes.items():
+        esteps = steps if shape[0] * shape[1] == CELLS else steps // 16
+        fn = lambda b, n=esteps: pallas_bitlife.evolve(b, n)
+        b = jnp.asarray((rng.random(shape) < 0.35).astype(np.uint8))
+        t0 = time.perf_counter()
+        b = fn(b)
+        force_ready(b)
+        print(f"# warm {name}: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        boards[name] = (b, fn, esteps, shape)
+        best[name] = float("inf")
+
+    for _ in range(reps):
+        for name in shapes:
+            b, fn, esteps, shape = boards[name]
+            t0 = time.perf_counter()
+            b = fn(b)
+            force_ready(b)
+            best[name] = min(best[name], time.perf_counter() - t0)
+            boards[name] = (b, fn, esteps, shape)
+
+    for name in shapes:
+        _, _, esteps, shape = boards[name]
+        rate = shape[0] * shape[1] * esteps / best[name]
+        print(json.dumps({
+            "config": name,
+            "shape": list(shape),
+            "cells_per_s": float(f"{rate:.4g}"),
+            "best_s": round(best[name], 4),
+            "steps": esteps,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

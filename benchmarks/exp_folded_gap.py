@@ -1,0 +1,136 @@
+"""Attribute the folded pod-shard throughput gap (VERDICT r4 #1).
+
+The folded 16384x1024 config-3 shard (lane-folded [4096, 128] layout,
+fold=4) measured 1.02e12 cell-updates/s at 39.4% MFU in r4, against the
+16384^2 flagship's 1.98e12 at 67.9% — the folded engine *issued* at ~58%
+of the flagship's rate at near-equal ops/word, and nothing attributed the
+loss.  This script decomposes it, same-session and interleaved (the only
+comparison discipline that survives the tunnel's +-10-20% noise):
+
+- ``bare``: :func:`gol_tpu.ops.pallas_bitlife.evolve` on a 4096^2 board —
+  the plain torus kernel at the folded layout's exact [4096, 128] packed
+  geometry with NO ring, NO band assembly, NO group rolls (the geometry
+  ceiling: if this is already slow, the loss is the small-board launch
+  regime, not the fold or the ring).
+- ``ring k=K t=T``: the sharded engine
+  (:func:`gol_tpu.parallel.packed.compiled_evolve_packed_pallas`) on this
+  chip's 1-ring at halo_depth K and tile_hint T, serial chunks.  The r4
+  claim ran the defaults (k=8, t=128 -> folded tile 128, 32 chunk
+  launches per 8 generations of 4096 folded rows).  Chunk-fixed costs
+  (launch + band assembly + 2 ppermutes) amortize over k*h rows, so if
+  they dominate, deeper k and larger tiles claw the rate back — and the
+  recompute tax *shrinks* as tiles grow ((tile + k + 1)/tile).
+- ``overlap k=K t=T``: the comm/compute-overlap chunk form at the same
+  points (three launches per chunk instead of one; measures what the
+  pod's latency-hiding form costs in the launch-bound regime).
+
+k <= 32 keeps the configuration valid for the real pod decomposition
+(config 3's 16x16 mesh is 2-D, whose column-band light cone caps
+halo_depth at 32); the k=64 point is attribution-only.
+
+Usage: ``python benchmarks/exp_folded_gap.py [steps] [reps]`` on the TPU.
+Prints one JSON line per configuration plus a summary ranking.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+FH, FW = 16384, 1024  # BASELINE config 3's shard on the 16x16 pod mesh
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import bitlife, pallas_bitlife
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import packed as packed_mod
+    from gol_tpu.utils import roofline
+    from gol_tpu.utils.timing import force_ready
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    rng = np.random.default_rng(1)
+    ring = mesh_mod.make_mesh_1d(1)
+    fold = pallas_bitlife.fold_factor(bitlife.packed_width(FW))  # 4
+
+    def ring_fn(k, t, overlap):
+        fn = packed_mod.compiled_evolve_packed_pallas(
+            ring, steps, halo_depth=k, tile_hint=t, overlap=overlap
+        )
+        return fn, (FH, FW)
+
+    def bare_fn():
+        side = int(np.sqrt(FH * FW))  # 4096: same cells, same packed rows
+        return (lambda b: pallas_bitlife.evolve(b, steps)), (side, side)
+
+    configs = {"bare_4096sq_torus": bare_fn()}
+    for k, t in ((8, 128), (8, 256), (8, 512), (16, 256), (16, 512),
+                 (32, 512), (32, 1024), (64, 1024)):
+        configs[f"ring k={k} t={t}"] = ring_fn(k, t, False)
+    for k, t in ((8, 128), (32, 512)):
+        configs[f"overlap k={k} t={t}"] = ring_fn(k, t, True)
+
+    # Warm (compile) everything first, then interleave measurements so
+    # drift hits every config equally.  Boards stay device-resident:
+    # donation chains each config's output back in as its next input.
+    boards, best = {}, {}
+    for name, (fn, shape) in configs.items():
+        b = jnp.asarray((rng.random(shape) < 0.35).astype(np.uint8))
+        t0 = time.perf_counter()
+        b = fn(b)
+        force_ready(b)
+        print(
+            f"# warm {name}: compile+run {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+        boards[name] = b
+        best[name] = float("inf")
+
+    for _ in range(reps):
+        for name, (fn, shape) in configs.items():
+            t0 = time.perf_counter()
+            boards[name] = fn(boards[name])
+            force_ready(boards[name])
+            best[name] = min(best[name], time.perf_counter() - t0)
+
+    cells = FH * FW
+    out = []
+    for name, (fn, shape) in configs.items():
+        rate = cells * steps / best[name]
+        rec = {"config": name, "cells_per_s": float(f"{rate:.4g}"),
+               "best_s": round(best[name], 4), "steps": steps}
+        if name.startswith(("ring", "overlap")):
+            k = int(name.split("k=")[1].split()[0])
+            t = int(name.split("t=")[1])
+            folded_h = FH // fold
+            interior = folded_h - (2 * k if name.startswith("overlap") else 0)
+            tile = pallas_bitlife.pick_tile(
+                interior, fold * bitlife.packed_width(FW), t
+            )
+            rl = roofline.roofline_2d(rate, tile, k, folded=True)
+            rec["tile"] = tile
+            rec["mfu_vpu"] = rl.as_dict()
+        else:
+            tile, kk = pallas_bitlife.blocking_plan(
+                4096, 4096 // bitlife.BITS, steps, 1024
+            )
+            rl = roofline.roofline_2d(rate, tile, kk)
+            rec["tile"], rec["k"] = tile, kk
+            rec["mfu_vpu"] = rl.as_dict()
+        out.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    ranked = sorted(out, key=lambda r: -r["cells_per_s"])
+    print(json.dumps({"ranking": [r["config"] for r in ranked]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Separate per-invocation tunnel overhead from true device rate.
+
+Wall time of one invocation of an n-generation loop through the axon
+tunnel is ``T(n) = a + b*n``: ``a`` is the per-invocation overhead (RPC,
+dispatch, readback fence) and ``b`` the device's per-generation time.
+Single-interval wall rates conflate the two — r4's headline intervals
+(0.4-1.4 s) carry *different* overhead fractions per config, and the
+overhead itself drifts session to session, so cross-config ratios read
+off walls are biased toward long-interval configs.
+
+This script times each config at two loop lengths (n, 8n), best-of-N
+interleaved, and reports the fitted overhead and the *device* rate
+``cells/b`` — the number a pod chip would actually deliver inside one
+program, and the honest basis for the folded-shard gap attribution.
+
+Usage: ``python benchmarks/exp_overhead_fit.py [reps]`` on the TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+FH, FW = 16384, 1024
+
+
+def main() -> None:
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import pallas_bitlife
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import packed as packed_mod
+    from gol_tpu.utils.timing import force_ready
+
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+
+    rng = np.random.default_rng(3)
+    ring = mesh_mod.make_mesh_1d(1)
+
+    # (name, shape, short_n, builder(steps) -> evolve)
+    def bare(shape):
+        return lambda n: (lambda b: pallas_bitlife.evolve(b, n))
+
+    def ring_eng(k, t):
+        return lambda n: packed_mod.compiled_evolve_packed_pallas(
+            ring, n, halo_depth=k, tile_hint=t
+        )
+
+    configs = [
+        ("bare_4096sq", (4096, 4096), 8192, bare((4096, 4096))),
+        ("bare_1024x16384", (1024, 16384), 8192, bare((1024, 16384))),
+        ("flagship_16384sq", (16384, 16384), 2048, bare((16384, 16384))),
+        ("ring_k8_t128", (FH, FW), 8192, ring_eng(8, 128)),
+        ("ring_k8_t512", (FH, FW), 8192, ring_eng(8, 512)),
+        ("ring_k32_t512", (FH, FW), 8192, ring_eng(32, 512)),
+    ]
+
+    points = []  # (name, shape, n, fn, board, [times])
+    for name, shape, n_short, build in configs:
+        for n in (n_short, 8 * n_short):
+            fn = build(n)
+            b = jnp.asarray((rng.random(shape) < 0.35).astype(np.uint8))
+            t0 = time.perf_counter()
+            b = fn(b)
+            force_ready(b)
+            print(f"# warm {name} n={n}: {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+            points.append([name, shape, n, fn, b, []])
+
+    for _ in range(reps):
+        for p in points:
+            t0 = time.perf_counter()
+            p[4] = p[3](p[4])
+            force_ready(p[4])
+            p[5].append(time.perf_counter() - t0)
+
+    from gol_tpu.utils.timing import fit_overhead
+
+    by_name = {}
+    for name, shape, n, _, _, ts in points:
+        by_name.setdefault(name, {"shape": shape})[n] = min(ts)
+    for name, d in by_name.items():
+        shape = d.pop("shape")
+        a, b = fit_overhead(d)
+        cells = shape[0] * shape[1]
+        print(json.dumps({
+            "config": name,
+            "shape": list(shape),
+            "walls_s": {str(n): round(t, 4) for n, t in sorted(d.items())},
+            "overhead_s_per_invocation": round(a, 4),
+            "device_cells_per_s": float(f"{cells / b:.4g}"),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

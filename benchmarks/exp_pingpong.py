@@ -1,0 +1,176 @@
+"""One targeted experiment at the 2-D kernel's MFU residue (VERDICT r4 #7).
+
+The roofline attributes ~32% of the VPU issue peak to "Mosaic
+scheduling/roll-port effects".  One concrete candidate: the temporal
+blocking's shrinking in-place window.  Generation ``j`` of
+:func:`gol_tpu.ops.pallas_bitlife._kernel_ext` reads
+``scratch[j : tile+2k-j]`` and writes ``scratch[j+1 : tile+2k-j-1]`` —
+both at *odd sublane offsets* for most ``j``, which Mosaic must realign
+(the (8,128) tile rule) with shift/copy traffic around every generation.
+
+The variant here ping-pongs between two VMEM buffers instead: generation
+``j`` reads buffer ``j%2`` rows ``[0, w)`` and writes buffer ``(j+1)%2``
+rows ``[0, w-2)`` — every load AND store starts at sublane 0, the
+aligned case, at the cost of one extra window-sized VMEM buffer per slot
+(the double-buffered DMA protocol is unchanged).  After ``k``
+generations the surviving rows ``[0, tile)`` of the final buffer are
+exactly the body tile.
+
+If the aligned form wins >= 3% same-session it graduates into
+``pallas_bitlife``; either way the number is recorded in BASELINE.md r5.
+
+Usage: ``python benchmarks/exp_pingpong.py [steps] [reps]`` on the TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+SIZE = 16384
+
+
+def _build_pingpong(ext_i32, tile: int, k: int):
+    """multi_step_pallas_packed_ext with aligned ping-pong generation
+    buffers (experiment-only copy; contract identical, rule=None,
+    groups=1)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from gol_tpu.ops import pallas_bitlife as pb
+
+    height = ext_i32.shape[0] - 2 * k
+    nw = ext_i32.shape[1]
+
+    def kernel(ext_hbm, out_ref, scratch, sems):
+        i = pl.program_id(0)
+        nt = pl.num_programs(0)
+        slot = jax.lax.rem(i, 2)
+
+        def copies(j, s):
+            start = pl.multiple_of(j * tile, 8)
+            return (
+                pltpu.make_async_copy(
+                    ext_hbm.at[pl.ds(start, tile + 2 * k)],
+                    # Window lands in ping buffer 0 of slot s.
+                    scratch.at[s, 0],
+                    sems.at[s],
+                ),
+            )
+
+        from gol_tpu.ops.pallas_common import load_window_double_buffered
+
+        load_window_double_buffered(
+            copies, i, i + 1, slot, i == 0, i + 1 < nt
+        )
+        for j in range(k):
+            w = tile + 2 * k - 2 * j
+            src = j % 2
+            dst = 1 - src
+            scratch[slot, dst, 0 : w - 2] = pb._one_generation(
+                scratch[slot, src, 0:w]
+            )
+        out_ref[:] = scratch[slot, k % 2, 0:tile]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(height // tile,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (tile, nw), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((height, nw), ext_i32.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2, tile + 2 * k, nw), ext_i32.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(ext_i32)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gol_tpu.ops import bitlife, pallas_bitlife
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import packed as packed_mod
+    from gol_tpu.utils.timing import force_ready
+
+    steps = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    k, tile = 8, 256  # the flagship blocking plan at 16384^2
+
+    rng = np.random.default_rng(0)
+    board = jnp.asarray(
+        (rng.random((SIZE, SIZE)) < 0.35).astype(np.uint8)
+    )
+
+    # Both contenders run the identical ring-engine chunk structure: one
+    # band exchange per k generations feeding a k-ext window; only the
+    # kernel body differs.  Build via the ext form directly so the
+    # ping-pong variant slots in.
+    nw = bitlife.packed_width(SIZE)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run_inplace(b):
+        p = lax.bitcast_convert_type(bitlife.pack(b), jnp.int32)
+        def chunk(_, p):
+            ext = jnp.concatenate([p[-k:], p, p[:k]])
+            return pallas_bitlife.multi_step_pallas_packed_ext(
+                ext, tile, k
+            )
+        p = lax.fori_loop(0, steps // k, chunk, p)
+        return bitlife.unpack(lax.bitcast_convert_type(p, jnp.uint32))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run_pingpong(b):
+        p = lax.bitcast_convert_type(bitlife.pack(b), jnp.int32)
+        def chunk(_, p):
+            ext = jnp.concatenate([p[-k:], p, p[:k]])
+            return _build_pingpong(ext, tile, k)
+        p = lax.fori_loop(0, steps // k, chunk, p)
+        return bitlife.unpack(lax.bitcast_convert_type(p, jnp.uint32))
+
+    contenders = {"inplace_shrink": run_inplace, "pingpong_aligned": run_pingpong}
+    boards, best = {}, {}
+    for name, fn in contenders.items():
+        b = jnp.array(board, copy=True)
+        t0 = time.perf_counter()
+        b = fn(b)
+        force_ready(b)
+        print(f"# warm {name}: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr, flush=True)
+        boards[name] = b
+        best[name] = []
+
+    for _ in range(reps):
+        for name, fn in contenders.items():
+            t0 = time.perf_counter()
+            boards[name] = fn(boards[name])
+            force_ready(boards[name])
+            best[name].append(time.perf_counter() - t0)
+
+    # Equality check: both must compute the same board.
+    bye = {n: np.asarray(b) for n, b in boards.items()}
+    same = bool(
+        (bye["inplace_shrink"] == bye["pingpong_aligned"]).all()
+    )
+    for name, ts in best.items():
+        rate = SIZE * SIZE * steps / min(ts)
+        print(json.dumps({
+            "config": name,
+            "cells_per_s": float(f"{rate:.4g}"),
+            "samples_s": [round(t, 4) for t in sorted(ts)],
+            "steps": steps,
+            "boards_equal": same,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Device-rate (overhead-fitted) tile sweep for the ring engines + 3-D.
+
+exp_overhead_fit showed the session's per-invocation tunnel overhead is
+0.19-0.26 s — large enough that r4's wall-based tile conclusions ("tiles
+64-128 measure ~2-5% above 256") are suspect, and that the folded pod
+shard at tile 512 actually runs at 1.98e12 device-side (88% of the
+flagship).  This script fits T(n) = a + b*n per config and reports
+device rates for:
+
+- the full 16384^2 board on the 1-ring at tile hints 128/256/512
+  (does the tile-512 win generalize, i.e. should the engine default
+  change?),
+- the folded pod shard at hints 512 vs 1024 (is there more), and the
+  folded overlap form at 512,
+- the sharded 3-D flagship at 1024^3 (is the r4 6.93e11 wall also
+  overhead-diluted).
+
+Usage: ``python benchmarks/exp_tile_fit.py [reps]`` on the TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import packed as packed_mod
+    from gol_tpu.parallel import sharded3d
+    from gol_tpu.parallel.mesh import place_private
+    from gol_tpu.parallel.sharded3d import volume_sharding
+    from gol_tpu.utils.timing import force_ready
+
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+
+    rng = np.random.default_rng(4)
+    ring = mesh_mod.make_mesh_1d(1)
+
+    def ring_eng(shape, k, t, overlap=False):
+        def build(n):
+            fn = packed_mod.compiled_evolve_packed_pallas(
+                ring, n, halo_depth=k, tile_hint=t, overlap=overlap
+            )
+            return fn
+        return shape, build
+
+    mesh3 = mesh_mod.make_mesh_3d((1, 1, 1), devices=jax.devices()[:1])
+
+    def vol3(shape):
+        def build(n):
+            # Donating compiled fn; the caller places the volume once and
+            # chains outputs (re-placing per repeat would re-ship data).
+            return sharded3d.compiled_evolve3d_pallas(mesh3, n)
+        return shape, build
+
+    configs = {
+        "ring16384sq_k8_t128": (*ring_eng((16384, 16384), 8, 128), 2048),
+        "ring16384sq_k8_t256": (*ring_eng((16384, 16384), 8, 256), 2048),
+        "ring16384sq_k8_t512": (*ring_eng((16384, 16384), 8, 512), 2048),
+        "foldshard_k8_t512": (*ring_eng((16384, 1024), 8, 512), 8192),
+        "foldshard_k8_t1024": (*ring_eng((16384, 1024), 8, 1024), 8192),
+        "foldshard_overlap_k8_t512": (
+            *ring_eng((16384, 1024), 8, 512, overlap=True), 8192
+        ),
+        "sharded3d_1024cube": (*vol3((1024, 1024, 1024)), 256),
+    }
+
+    points = []
+    for name, (shape, build, n_short) in configs.items():
+        for n in (n_short, 8 * n_short):
+            fn = build(n)
+            arr_np = (rng.random(shape) < 0.33).astype(np.uint8)
+            if name.startswith("sharded3d"):
+                b = place_private(
+                    jnp.asarray(arr_np), volume_sharding(mesh3)
+                )
+            else:
+                b = jnp.asarray(arr_np)
+            t0 = time.perf_counter()
+            b = fn(b)
+            force_ready(b)
+            print(f"# warm {name} n={n}: {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr, flush=True)
+            points.append([name, shape, n, fn, b, []])
+
+    for _ in range(reps):
+        for p in points:
+            t0 = time.perf_counter()
+            p[4] = p[3](p[4])
+            force_ready(p[4])
+            p[5].append(time.perf_counter() - t0)
+
+    from gol_tpu.utils.timing import fit_overhead
+
+    by_name = {}
+    for name, shape, n, _, _, ts in points:
+        by_name.setdefault(name, {"shape": shape})[n] = min(ts)
+    for name, d in by_name.items():
+        shape = d.pop("shape")
+        a, b = fit_overhead(d)
+        cells = int(np.prod(shape))
+        print(json.dumps({
+            "config": name,
+            "shape": list(shape),
+            "walls_s": {str(n): round(t, 4) for n, t in sorted(d.items())},
+            "overhead_s_per_invocation": round(a, 4),
+            "device_cells_per_s": float(f"{cells / b:.4g}"),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

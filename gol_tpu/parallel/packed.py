@@ -170,7 +170,7 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
 
 @functools.lru_cache(maxsize=64)
 def compiled_evolve_packed_pallas(
-    mesh: Mesh, steps: int, halo_depth: int = 8, tile_hint: int = 128,
+    mesh: Mesh, steps: int, halo_depth: int = 8, tile_hint: int = 1024,
     rule=None, overlap: bool = False,
 ):
     """Sharded evolve running the fused Pallas kernel per shard.
@@ -189,11 +189,20 @@ def compiled_evolve_packed_pallas(
     whose windows may span several neighbor tiles.
     ``halo_depth`` must be a multiple of 8 (DMA row alignment).  A
     non-multiple remainder of ``steps`` runs on the jnp packed step.
-    Defaults are the measured single-chip sweet spot at 16384²×1024
-    (v5e, same-session sweeps): band depth 8 (8.75e11 vs 7.7e11 at 16
-    and 6.9-7.4e11 at 24/32 — the k² recomputed band rows eat deeper
-    blocking) and row tile 128 (tiles 64-128 measure ~2-5% above 256
-    across repeats; smaller tiles also cut VMEM pressure).
+    Defaults: band depth 8 (deeper bands measured at parity or slightly
+    behind in r5 overhead-fitted sweeps — k=32 within noise of k=8 —
+    and k=8 stays inside the 2-D column-band light cone) and row tile
+    hint 1024, which lets :func:`~gol_tpu.ops.pallas_bitlife.pick_tile`'s
+    VMEM budget set the real cap per geometry: wide boards cap at 256
+    (nw=512's budget), narrow lane-folded shards reach 1024.  Earlier
+    rounds defaulted the hint to 128 off wall-clock sweeps; r5's
+    two-point overhead fits (benchmarks/exp_tile_fit.py, BASELINE.md r5)
+    showed those walls were tunnel-overhead artifacts — device-side, the
+    folded 16384×1024 pod shard runs 2.01e12 cell-updates/s at tile 1024
+    vs 1.49e12 at tile 128 (+35%), and the full 16384² board gains ~4%
+    at its 256 cap.  Taller tiles amortize per-tile fixed costs over
+    more rows AND shrink the temporal blocking's recompute factor
+    ((tile + k + 1)/tile); the VMEM budget is the only true ceiling.
     Optional ``rule`` switches the kernel tail to the generic plane
     matcher.
 

@@ -118,6 +118,28 @@ def time_best(fn, arg_factory, repeats: int = 3) -> float:
     return best
 
 
+def fit_overhead(walls: Dict[int, float]):
+    """Two-point per-invocation-overhead fit (r5 measurement discipline).
+
+    Wall time of one invocation of an n-step device loop through the
+    tunnel is ``T(n) = a + b*n``: ``a`` the per-invocation overhead
+    (RPC, dispatch, readback fence — 0.13-0.26 s depending on session)
+    and ``b`` the device's per-step time.  Given best-wall samples at
+    two (or more — the fit uses the extremes) loop lengths, returns
+    ``(overhead_s, per_step_s)``.  Single-interval wall rates conflate
+    the two and under-report the chip *differently per config*, so every
+    cross-config conclusion must come from ``b``, never from walls
+    (BASELINE.md r5).  One definition shared by ``bench.py`` and the
+    ``benchmarks/exp_*_fit.py`` scripts so the artifacts cannot
+    disagree on the arithmetic.
+    """
+    if len(walls) < 2:
+        raise ValueError(f"need >= 2 loop lengths to fit, got {walls}")
+    (n1, t1), *_, (n2, t2) = sorted(walls.items())
+    b = (t2 - t1) / (n2 - n1)
+    return t1 - b * n1, b
+
+
 @contextlib.contextmanager
 def maybe_profile(trace_dir: Optional[str]) -> Iterator[None]:
     """Capture a jax.profiler trace when a directory is given (else no-op).

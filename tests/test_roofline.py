@@ -64,17 +64,18 @@ def test_ring_attribution_matches_engine_tiling():
     derivation — pinned against hand-derived expected configurations, not
     by re-running the attribution's implementation."""
     # Wide single-device ring at the bench geometry: nw=512 fills lanes,
-    # no fold; engine defaults tile_hint=128, halo_depth=8 -> tile 128.
+    # no fold; engine defaults tile_hint=1024 (r5), halo_depth=8 — the
+    # VMEM budget at nw=512 caps the tile at 256.
     r = roofline.bench_roofline_2d_ring(1.8e12, 16384, 16384)
     assert r.ops_per_useful_word == pytest.approx(
-        roofline.ops_2d_per_useful_word(128, 8)
+        roofline.ops_2d_per_useful_word(256, 8)
     )
     # Folded narrow board: nw=32 -> fold=4; the engine tiles the FOLDED
-    # height 640/4=160 (largest dividing 8-multiple <= 128 is 80), not
-    # the unfolded pick(640, 32).
+    # height 640/4=160 (capped by the height itself under the 1024
+    # hint), not the unfolded pick(640, 32).
     r = roofline.bench_roofline_2d_ring(1e12, 640, 1024)
     assert r.ops_per_useful_word == pytest.approx(
-        roofline.ops_2d_per_useful_word(80, 8, folded=True)
+        roofline.ops_2d_per_useful_word(160, 8, folded=True)
     )
     # Multi-device ring tiles the shard height, not the global height:
     # 4 devices over 512 rows -> shard 128 -> tile 128 even though the
@@ -95,3 +96,18 @@ def test_ring_attribution_rejects_unfoldable_geometry():
     """Geometries the engine cannot run must not get an attribution."""
     with pytest.raises(ValueError, match="lane-fold"):
         roofline.bench_roofline_2d_ring(1e12, 648, 1024)
+
+
+def test_fit_overhead_two_point():
+    """The r5 tunnel-overhead fit: T(n) = a + b*n recovered exactly from
+    two points (shared by bench.py and the exp_*_fit scripts)."""
+    from gol_tpu.utils.timing import fit_overhead
+
+    a, b = fit_overhead({1024: 0.25 + 1024 * 1e-4, 8192: 0.25 + 8192 * 1e-4})
+    assert a == pytest.approx(0.25)
+    assert b == pytest.approx(1e-4)
+    # More than two lengths: the fit uses the extremes.
+    a, b = fit_overhead({10: 1.1, 20: 1.2, 110: 2.1})
+    assert a == pytest.approx(1.0)
+    with pytest.raises(ValueError, match="loop lengths"):
+        fit_overhead({100: 1.0})
