@@ -43,7 +43,8 @@ def _measure(evolve, board, steps: int, repeats: int = 3) -> float:
     return best
 
 
-def _device_fit(build, board, long_n: int, repeats: int = 2):
+def _device_fit(build, board, long_n: int, repeats: int = 2,
+                long_wall=None):
     """Two-point overhead fit (r5): wall time of one invocation through
     the tunnel is T(n) = a + b*n, with ``a`` the per-invocation overhead
     (0.13-0.26 s depending on session) and ``b`` the device's
@@ -52,12 +53,15 @@ def _device_fit(build, board, long_n: int, repeats: int = 2):
     under-report by the overhead fraction, *differently per config*
     (see BASELINE.md r5).  ``build(n)`` returns an evolve closure for an
     n-step loop; boards chain device-resident through donation.
+    ``long_wall`` reuses a wall the caller already measured at
+    ``long_n`` (same compiled program), so only the short point costs
+    new tunnel invocations.
     """
     import jax.numpy as jnp
 
     short_n = max(8, long_n // 8)
-    walls = {}
-    for n in (short_n, long_n):
+    walls = {} if long_wall is None else {long_n: long_wall}
+    for n in (short_n,) if long_wall is not None else (short_n, long_n):
         fn = build(n)
         b = fn(jnp.array(board, copy=True))
         _force(b)  # warm (compile) outside timing
@@ -242,7 +246,13 @@ def _claims(results, size, board) -> list:
                     build = lambda n: packed_mod.compiled_evolve_packed_pallas(
                         ring1, n
                     )
-                fit = _device_fit(build, board, esteps)
+                # The long point is the wall _measure already produced
+                # for this exact lru-cached program — only the short
+                # point costs new tunnel invocations.
+                fit = _device_fit(
+                    build, board, esteps,
+                    long_wall=size * size * esteps / value,
+                )
             except Exception as e:  # noqa: BLE001 — report, never hide
                 print(f"bench: {name} fit failed: {e!r}", file=sys.stderr)
                 fit = None
@@ -257,13 +267,19 @@ def _claims(results, size, board) -> list:
     # 0.2-0.26 s per-invocation tunnel overhead (r5 fits) stays under
     # ~20% of the ~1.3 s measured interval; the device_fit field removes
     # the rest.
-    from gol_tpu.parallel import mesh as mesh_mod
-    from gol_tpu.parallel import packed as packed_mod
+    try:
+        from gol_tpu.parallel import mesh as mesh_mod
+        from gol_tpu.parallel import packed as packed_mod
 
-    fh, fw, fsteps = 16384, 1024, 131072
-    fboard = jnp.asarray((rng.random((fh, fw)) < 0.35).astype(np.uint8))
-    ring = mesh_mod.make_mesh_1d(1)
-    for cname, overlap in (
+        fh, fw, fsteps = 16384, 1024, 131072
+        fboard = jnp.asarray((rng.random((fh, fw)) < 0.35).astype(np.uint8))
+        ring = mesh_mod.make_mesh_1d(1)
+    except Exception as e:  # noqa: BLE001 — degrade to missing claims,
+        # never crash main after its measurements (the headline line
+        # must still print).
+        print(f"bench: folded claims unavailable: {e!r}", file=sys.stderr)
+        ring = None
+    for cname, overlap in () if ring is None else (
         ("folded_32word_shard", False),
         ("folded_32word_shard_overlap", True),
     ):
@@ -284,7 +300,7 @@ def _claims(results, size, board) -> list:
                         ring, n, overlap=o
                     )
                 )
-                fit = _device_fit(build, fboard, fsteps)
+                fit = _device_fit(build, fboard, fsteps, long_wall=dt)
             except Exception as e:  # noqa: BLE001
                 print(f"bench: {cname} fit failed: {e!r}", file=sys.stderr)
             add(
@@ -329,7 +345,10 @@ def _claims(results, size, board) -> list:
             # without an explicit re-place.
             build3 = lambda n: sharded3d.compiled_evolve3d_pallas(mesh3, n)
             fit3 = _device_fit(
-                build3, place_private(vol, volume_sharding(mesh3)), vsteps
+                build3,
+                place_private(vol, volume_sharding(mesh3)),
+                vsteps,
+                long_wall=dt,
             )
         except Exception as e:  # noqa: BLE001
             print(f"bench: 3-D fit failed: {e!r}", file=sys.stderr)

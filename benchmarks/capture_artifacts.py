@@ -109,14 +109,17 @@ def main() -> None:
 
     halo = {"note": (
         "seconds per generation. exchange_s = the ppermute exchange loop "
-        "alone (received halos folded into the boundary rows/faces only "
-        "— O(boundary) anti-DCE, r5; 3-D sections ship a dense one-cell "
-        "shell per generation, an upper bound on the packed band ring's "
-        "wire time); step_s = full sharded program; stencil_s = "
-        "single-device compute ceiling; exposed_exchange_s = step - "
-        "stencil (what latency hiding can win). TPU sections are "
-        "real-chip; cpu_mesh sections are 8-virtual-device curve shape "
-        "only."
+        "alone (received halos folded into boundary faces/accumulators "
+        "only — O(boundary) anti-DCE, r5; 3-D sections ship the fused "
+        "engine's own quanta: one packed band plane + one packed ghost "
+        "word column per side per generation, a tight upper bound on "
+        "its per-generation wire); step_s = full sharded program; "
+        "stencil_s = single-device compute ceiling; exposed_exchange_s "
+        "= step - stencil (what latency hiding can win). TPU sections "
+        "are real-chip; every per-generation column still carries "
+        "~overhead/steps of tunnel cost (common-mode across columns, "
+        "cancelling in the subtraction; see BASELINE.md r5 fits). "
+        "cpu_mesh sections are 8-virtual-device curve shape only."
     )}
     scale = {"note": (
         "weak scaling: fixed size_per_chip^2 cells per device; 1-D ring "
@@ -142,21 +145,28 @@ def main() -> None:
         # config 3 on a 16x16 mesh: 16384x1024 cells = 32 words) — the
         # geometry whose exchange exposure the folded overlap (r4)
         # exists to hide.
+        # Loop lengths sized so the ~0.2-0.26 s/invocation tunnel
+        # overhead (BASELINE.md r5 fits) stays a small fraction of every
+        # per-generation column: at x1024 the ~0.2 ms/gen overhead floor
+        # swamped the folded shard's ~8 us/gen device cost and let
+        # exchange_s/step_s orderings flip on noise.
         for engine in ("pallas", "pallas_overlap"):
-            for size, suffix in ((16384, ""), ((16384, 1024),
-                                               "_folded_pod_shard")):
+            for size, steps, suffix in (
+                (16384, 8192, ""),
+                ((16384, 1024), 65536, "_folded_pod_shard"),
+            ):
                 size_str = (
                     str(size) if isinstance(size, int)
                     else f"{size[0]}x{size[1]}"
                 )
                 halo[f"tpu_1ring_{engine}{suffix}"] = {
-                    **halobench.measure(ring, size, 1024, engine),
+                    **halobench.measure(ring, size, steps, engine),
                     "size": size if isinstance(size, int) else list(size),
-                    "steps": 1024,
+                    "steps": steps,
                     "devices": 1,
                     "command": (
                         f"python -m gol_tpu.utils.halobench {size_str} "
-                        f"1024 1d {engine}"
+                        f"{steps} 1d {engine}"
                     ),
                 }
         rows = scalebench.measure_weak_scaling(
@@ -174,12 +184,12 @@ def main() -> None:
         # sections below.
         halo["tpu_1ring_pallas3d"] = {
             **halobench.measure3d(
-                mesh_mod.make_mesh_3d((1, 1, 1), devices=None), 512, 512
+                mesh_mod.make_mesh_3d((1, 1, 1), devices=None), 512, 2048
             ),
             "size": 512,
-            "steps": 512,
+            "steps": 2048,
             "devices": 1,
-            "command": "python -m gol_tpu.utils.halobench 512x512x512 512 3d",
+            "command": "python -m gol_tpu.utils.halobench 512x512x512 2048 3d",
         }
     else:
         print("capture_artifacts: no TPU visible; TPU sections skipped",

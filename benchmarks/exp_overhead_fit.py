@@ -27,6 +27,32 @@ import numpy as np
 FH, FW = 16384, 1024
 
 
+def report_fits(points) -> None:
+    """Shared fit-and-print tail of the two-point experiment scripts
+    (``exp_tile_fit`` imports this; the fit arithmetic itself lives in
+    :func:`gol_tpu.utils.timing.fit_overhead` so the committed artifacts
+    cannot disagree with ``bench.py``'s device_fit field).
+
+    ``points`` rows: ``[name, shape, n, fn, board, wall_samples]``.
+    """
+    from gol_tpu.utils.timing import fit_overhead
+
+    by_name = {}
+    for name, shape, n, _, _, ts in points:
+        by_name.setdefault(name, {"shape": shape})[n] = min(ts)
+    for name, d in by_name.items():
+        shape = d.pop("shape")
+        a, b = fit_overhead(d)
+        cells = int(np.prod(shape))
+        print(json.dumps({
+            "config": name,
+            "shape": list(shape),
+            "walls_s": {str(n): round(t, 4) for n, t in sorted(d.items())},
+            "overhead_s_per_invocation": round(a, 4),
+            "device_cells_per_s": float(f"{cells / b:.4g}"),
+        }), flush=True)
+
+
 def main() -> None:
     import jax.numpy as jnp
 
@@ -77,22 +103,7 @@ def main() -> None:
             force_ready(p[4])
             p[5].append(time.perf_counter() - t0)
 
-    from gol_tpu.utils.timing import fit_overhead
-
-    by_name = {}
-    for name, shape, n, _, _, ts in points:
-        by_name.setdefault(name, {"shape": shape})[n] = min(ts)
-    for name, d in by_name.items():
-        shape = d.pop("shape")
-        a, b = fit_overhead(d)
-        cells = shape[0] * shape[1]
-        print(json.dumps({
-            "config": name,
-            "shape": list(shape),
-            "walls_s": {str(n): round(t, 4) for n, t in sorted(d.items())},
-            "overhead_s_per_invocation": round(a, 4),
-            "device_cells_per_s": float(f"{cells / b:.4g}"),
-        }), flush=True)
+    report_fits(points)
 
 
 if __name__ == "__main__":

@@ -20,7 +20,6 @@ Usage: ``python benchmarks/exp_tile_fit.py [reps]`` on the TPU.
 
 from __future__ import annotations
 
-import json
 import sys
 import time
 
@@ -97,22 +96,11 @@ def main() -> None:
             force_ready(p[4])
             p[5].append(time.perf_counter() - t0)
 
-    from gol_tpu.utils.timing import fit_overhead
+    # Shared fit-and-print tail (sys.path[0] is benchmarks/ when run as
+    # a script, so the sibling module imports directly).
+    from exp_overhead_fit import report_fits
 
-    by_name = {}
-    for name, shape, n, _, _, ts in points:
-        by_name.setdefault(name, {"shape": shape})[n] = min(ts)
-    for name, d in by_name.items():
-        shape = d.pop("shape")
-        a, b = fit_overhead(d)
-        cells = int(np.prod(shape))
-        print(json.dumps({
-            "config": name,
-            "shape": list(shape),
-            "walls_s": {str(n): round(t, 4) for n, t in sorted(d.items())},
-            "overhead_s_per_invocation": round(a, 4),
-            "device_cells_per_s": float(f"{cells / b:.4g}"),
-        }), flush=True)
+    report_fits(points)
 
 
 if __name__ == "__main__":

@@ -228,45 +228,79 @@ def measure(
 
 @functools.lru_cache(maxsize=32)
 def _exchange_only_3d(mesh: Mesh, steps: int):
-    """jit: ``steps`` chained 3-D shell exchanges (6 ppermutes over three
-    phases), no stencil, with the same O(boundary) anti-DCE folds as
-    :func:`_exchange_only`: each received face is added into its adjacent
-    shard face only, and each later phase ships the *already-folded*
-    faces (the edge/corner multi-hop chain stays live), so XLA cannot
-    elide any phase and the loop does no full-volume HBM pass
-    (``halo_extend`` would concatenate a whole extended volume per
-    iteration — a copy the real engines amortize over a k-deep chunk).
+    """jit: ``steps`` chained exchanges of the 3-D flagship's own wire
+    quanta, no stencil, O(face) per iteration.
 
-    Ships dense one-cell faces per generation — an upper bound on the
-    fused engine's wire time, which moves *packed* ``halo_depth``-deep
-    bands once per ``halo_depth`` generations (8× fewer bytes on the
-    band faces, word-quantum ghost columns along x).
+    Mirrors :func:`gol_tpu.parallel.sharded3d.compiled_evolve3d_pallas`'s
+    two-ring structure in its packed plane-leading ``[band, nw, lanes]``
+    layout: per iteration, one packed *band plane* rides the banded
+    spatial ring and one packed *ghost word column* per side rides the
+    COLS ring (4 ppermutes; the third volume axis is the kernel's lane
+    axis, which the engine's mesh constraint leaves unsharded — there is
+    nothing to exchange on it).  This is a tight upper bound on the
+    engine's per-generation wire: the engine ships ``pad``-deep bands
+    once per ``pad`` generations (same band bytes/generation) and its
+    ghost columns only once per chunk.
+
+    Anti-DCE state is four *face accumulators* — the packed volume stays
+    loop-invariant, each shipped face mixes in the previously received
+    one (and the column phase mixes a sliver of the just-received band
+    plane, sequencing the phases like the real corner two-hop), and the
+    accumulators fold into the output's boundary once after the loop.
+    Two measured dead ends this loop must not repeat (r5, real chip at
+    512³): in-loop ``vol.at[...].add`` chains — XLA copies the volume,
+    2.1 ms/gen — and *dense* uint8 faces, whose minor-axis slicing
+    relayouts at ~0.94 ms/gen; the packed-layout faces cost ~34 µs/gen.
     """
+    from gol_tpu.ops import bitlife3d
     from gol_tpu.parallel.halo import ring
 
     np_ = mesh.shape.get(PLANES, 1)
     nr = mesh.shape.get(ROWS, 1)
     nc = mesh.shape.get(COLS, 1)
+    if np_ != 1 and nr != 1:
+        raise ValueError(
+            "the 3-D exchange harness mirrors the fused engine's mesh "
+            "constraint: planes or rows axis must be size 1, got "
+            f"{dict(mesh.shape)}"
+        )
+    band_over_planes = nr == 1
+    band_axis_name = PLANES if band_over_planes else ROWS
+    band_ring = np_ if band_over_planes else nr
 
-    def body(_, vol):
-        top = lax.ppermute(vol[-1:], PLANES, ring(np_, 1))
-        bot = lax.ppermute(vol[:1], PLANES, ring(np_, -1))
-        vol = vol.at[:1].add(top).at[-1:].add(bot)
-        north = lax.ppermute(vol[:, -1:], ROWS, ring(nr, 1))
-        south = lax.ppermute(vol[:, :1], ROWS, ring(nr, -1))
-        vol = vol.at[:, :1].add(north).at[:, -1:].add(south)
-        west = lax.ppermute(vol[:, :, -1:], COLS, ring(nc, 1))
-        east = lax.ppermute(vol[:, :, :1], COLS, ring(nc, -1))
-        return vol.at[:, :, :1].add(west).at[:, :, -1:].add(east)
+    def local(vol):
+        p3 = bitlife3d.pack3d(vol)  # [d, h, nw]
+        p = p3.transpose((0, 2, 1) if band_over_planes else (1, 2, 0))
+
+        def body(_, c):
+            ctop, cbot, cw, ce = c
+            top = lax.ppermute(
+                p[-1] + ctop, band_axis_name, ring(band_ring, 1)
+            )
+            bot = lax.ppermute(
+                p[0] + cbot, band_axis_name, ring(band_ring, -1)
+            )
+            west = lax.ppermute(
+                p[:, -1] + cw + top[-1:, :], COLS, ring(nc, 1)
+            )
+            east = lax.ppermute(
+                p[:, 0] + ce + bot[:1, :], COLS, ring(nc, -1)
+            )
+            return (top, bot, west, east)
+
+        c0 = (p[-1] * 0, p[0] * 0, p[:, -1] * 0, p[:, 0] * 0)
+        ctop, cbot, cw, ce = lax.fori_loop(0, steps, body, c0)
+        # One post-loop boundary fold keeps every accumulator live.
+        p = p.at[0].add(ctop).at[-1].add(cbot)
+        p = p.at[:, 0].add(cw).at[:, -1].add(ce)
+        p3 = p.transpose((0, 2, 1) if band_over_planes else (2, 0, 1))
+        return bitlife3d.unpack3d(p3)
 
     spec = P(PLANES, ROWS, COLS)
-    local = jax.shard_map(
-        lambda v: lax.fori_loop(0, steps, body, v),
-        mesh=mesh,
-        in_specs=spec,
-        out_specs=spec,
+    local_sharded = jax.shard_map(
+        local, mesh=mesh, in_specs=spec, out_specs=spec
     )
-    return jax.jit(local)
+    return jax.jit(local_sharded)
 
 
 def measure3d(mesh: Mesh, size, steps: int = 64) -> Dict[str, float]:
@@ -276,13 +310,15 @@ def measure3d(mesh: Mesh, size, steps: int = 64) -> Dict[str, float]:
     see (VERDICT r4 #4).
 
     ``size`` is a cube side or a ``(d, h, w)`` triple.  Columns mirror
-    :func:`measure`: ``exchange_s`` times the dense one-shell exchange
-    (6 ppermutes, O(boundary) folds — an upper bound on the packed band
-    ring's per-generation wire time); ``step_s`` the full fused sharded
-    program; ``stencil_s`` the single-device fused-kernel evolve at one
-    shard's dimensions (pure compute ceiling, no exchange, whatever
-    kernel form the dispatch picks there); ``exposed_exchange_s`` their
-    difference.  ``steps`` should be a multiple of 8 (the band depth) so
+    :func:`measure`: ``exchange_s`` times the engine's own exchange
+    quanta — one packed band plane on the banded ring + one packed ghost
+    word column per side on the COLS ring, per generation (4 ppermutes,
+    O(face) accumulator folds; a tight upper bound on the fused engine's
+    per-generation wire, see :func:`_exchange_only_3d`); ``step_s`` the
+    full fused sharded program; ``stencil_s`` the single-device
+    fused-kernel evolve at one shard's dimensions (pure compute ceiling,
+    no exchange, whatever kernel form the dispatch picks there);
+    ``exposed_exchange_s`` their difference.  ``steps`` should be a multiple of 8 (the band depth) so
     no per-step jnp remainder tail pollutes the attribution.  On a
     one-device mesh the subtraction reads the chunk/ring machinery's
     overhead, not exchange exposure — flagged in ``ceiling_note``.
