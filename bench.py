@@ -33,7 +33,12 @@ SLOW_STEPS = 1024
 PER_CHIP_TARGET = 1e11 / 256.0
 
 
-def _measure(evolve, board, steps: int, repeats: int = 3) -> float:
+def _measure(evolve, board, repeats: int = 3) -> float:
+    """Best-of-N wall of one chained invocation: the board stays
+    device-resident through donation, so each repeat times exactly one
+    program execution + readback fence.  The ONE timing discipline in
+    this file — the wall claims and the overhead fits both go through
+    it, so the methodology cannot drift between them."""
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -65,13 +70,7 @@ def _device_fit(build, board, long_n: int, repeats: int = 2,
         fn = build(n)
         b = fn(jnp.array(board, copy=True))
         _force(b)  # warm (compile) outside timing
-        best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            b = fn(b)
-            _force(b)
-            best = min(best, time.perf_counter() - t0)
-        walls[n] = best
+        walls[n] = _measure(fn, b, repeats)
     overhead, slope = fit_overhead(walls)
     return {
         "overhead_s_per_invocation": round(overhead, 4),
@@ -156,7 +155,7 @@ def main() -> None:
         # minutes on losers once a fast engine has set the bar.
         repeats = 3 if not results or name.startswith("pallas") else 2
         work = jnp.array(board, copy=True)
-        dt = _measure(evolve, work, esteps, repeats)
+        dt = _measure(evolve, work, repeats)
         results[name] = ((size * size * esteps) / dt, esteps)
 
     if not results:
@@ -288,7 +287,7 @@ def _claims(results, size, board) -> list:
                 ring, fsteps, overlap=overlap
             )
             _force(fn(jnp.array(fboard, copy=True)))
-            dt = _measure(fn, jnp.array(fboard, copy=True), fsteps)
+            dt = _measure(fn, jnp.array(fboard, copy=True))
             value = fh * fw * fsteps / dt
             # The fit gets its own guard: a transient tunnel error in its
             # extra invocations must not discard the measured wall claim.
@@ -336,7 +335,7 @@ def _claims(results, size, board) -> list:
             return fn3(place_private(v, volume_sharding(mesh3)))
 
         _force(run3(vol))
-        dt = _measure(run3, vol, vsteps)
+        dt = _measure(run3, vol)
         value = float(vsize) ** 3 * vsteps / dt
         fit3 = None
         try:

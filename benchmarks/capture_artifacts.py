@@ -76,20 +76,37 @@ def _cpu_json_2proc(args: list, devices_per_proc: int = 4) -> dict:
         )
         for i in range(2)
     ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=900)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rc, out, err in outs:
-        if rc != 0:
-            raise RuntimeError(
-                f"2-process worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
-            )
+    # Drain both processes concurrently (a thread per pipe pair, so
+    # neither can deadlock on a full pipe) and fail FAST on the first
+    # nonzero exit: if one worker dies during coordinator startup the
+    # other blocks in jax.distributed forever, and a sequential
+    # communicate() would time out 900 s later with the dead worker's
+    # stderr (the actual root cause) never surfaced.
+    import concurrent.futures as cf
+
+    def _drain(p):
+        out, err = p.communicate()
+        return p.returncode, out, err
+
+    outs = [None, None]
+    with cf.ThreadPoolExecutor(max_workers=2) as ex:
+        futs = {ex.submit(_drain, p): i for i, p in enumerate(procs)}
+        try:
+            for fut in cf.as_completed(futs, timeout=900):
+                i = futs[fut]
+                rc, out, err = fut.result()
+                outs[i] = (rc, out, err)
+                if rc != 0:
+                    raise RuntimeError(
+                        f"2-process worker {i} failed rc={rc}\n"
+                        f"stdout:{out}\nstderr:{err}"
+                    )
+        finally:
+            # Killing the survivors EOFs their pipes, so the remaining
+            # _drain threads (and the executor shutdown) return promptly.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
     payload = json.loads(outs[0][1].strip().splitlines()[-1])
     payload["command"] = (
         "2 processes x "
@@ -184,7 +201,12 @@ def main() -> None:
         # sections below.
         halo["tpu_1ring_pallas3d"] = {
             **halobench.measure3d(
-                mesh_mod.make_mesh_3d((1, 1, 1), devices=None), 512, 2048
+                # Explicit one-device list: devices=None means ALL
+                # visible devices, which on a multi-chip host fails
+                # make_mesh_3d's shape==count validation and would abort
+                # the capture after the expensive sections above ran.
+                mesh_mod.make_mesh_3d((1, 1, 1), devices=jax.devices()[:1]),
+                512, 2048
             ),
             "size": 512,
             "steps": 2048,
