@@ -1,0 +1,18 @@
+# Entry points for builders and reviewers.  `make check` is the one
+# gate: lint + static verifier + tier-1 tests (see scripts/check.sh).
+
+.PHONY: lint verify test check
+
+lint:
+	bash scripts/lint.sh
+
+verify:
+	JAX_PLATFORMS=cpu python -m gol_tpu.analysis
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    --continue-on-collection-errors -p no:cacheprovider \
+	    -p no:xdist -p no:randomly
+
+check:
+	bash scripts/check.sh

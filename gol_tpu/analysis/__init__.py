@@ -1,0 +1,40 @@
+"""Static verification of engine invariants from traced programs.
+
+The framework's correctness story is dynamic — equivalence tests and the
+runtime guard audit boards as they evolve.  This package adds the static
+half: trace every engine's evolve program with abstract inputs
+(``jax.make_jaxpr`` / AOT ``.lower()``), walk the jaxpr/HLO, and *prove*
+the invariants the dynamic checks can only sample — on CPU, at zero pod
+cost, before anything runs:
+
+- ``walker``  — recursive jaxpr traversal with loop context;
+- ``configs`` — the engine×mesh matrix, built through the real
+  :class:`~gol_tpu.runtime.GolRuntime` dispatch;
+- ``checks``  — comm rings + halo depth, dtype, purity, donation +
+  cost-model drift, retrace detection;
+- ``report``  — findings and the per-engine report tree;
+- ``__main__`` — the ``python -m gol_tpu.analysis`` gate (also reachable
+  as ``python -m gol_tpu verify``).
+
+See ``docs/ANALYSIS.md`` for the invariant each check pins.
+"""
+
+from gol_tpu.analysis.configs import EngineConfig, default_matrix, select
+from gol_tpu.analysis.checks import run_config
+from gol_tpu.analysis.report import (
+    AnalysisReport,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+__all__ = [
+    "AnalysisReport",
+    "CheckResult",
+    "EngineConfig",
+    "EngineReport",
+    "Finding",
+    "default_matrix",
+    "run_config",
+    "select",
+]

@@ -1,0 +1,101 @@
+"""``python -m gol_tpu.analysis`` — the static verification pass.
+
+Traces every engine×mesh configuration in the matrix on abstract inputs
+(CPU is enough; no board is ever evolved) and verifies the framework
+invariants: ring-permutation comm contracts, integer-only dtypes, no
+host callbacks, live buffer donation, cost-model drift, and
+trace-cache stability across chunk schedules.  Exits non-zero on any
+violated invariant — the correctness gate for perf PRs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+
+def _ensure_cpu_devices(min_devices: int) -> None:
+    """Give the verifier a virtual device ring when run on a bare host.
+
+    Mesh configs need ``min_devices`` devices; on CPU the standard
+    ``--xla_force_host_platform_device_count`` flag provides them.  Must
+    run before the first backend touch (the flag is read at backend
+    init); the site may have pre-imported jax, which is fine as long as
+    no computation has happened yet.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={min_devices}"
+        ).strip()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m gol_tpu.analysis",
+        description="statically verify engine invariants (no TPU needed)",
+    )
+    parser.add_argument(
+        "--engine",
+        action="append",
+        choices=["dense", "bitpack", "pallas", "pallas_bitpack"],
+        help="restrict to these engines (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--mesh",
+        action="append",
+        choices=["none", "1d", "2d"],
+        help="restrict to these mesh modes (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the report as JSON"
+    )
+    parser.add_argument(
+        "--verbose", "-v", action="store_true",
+        help="show info findings, not just violations",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list matrix entries and exit"
+    )
+    parser.add_argument(
+        "--native-devices",
+        action="store_true",
+        help="use the ambient backend/devices as-is (default: force the "
+        "CPU backend with a virtual 4-device ring)",
+    )
+    ns = parser.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    if not ns.native_devices:
+        from gol_tpu.analysis.configs import MESH_DEVICE_COUNTS
+
+        _ensure_cpu_devices(max(MESH_DEVICE_COUNTS.values()))
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from gol_tpu.analysis.configs import default_matrix, select
+    from gol_tpu.analysis.report import AnalysisReport
+
+    matrix = select(default_matrix(), ns.engine, ns.mesh)
+    if ns.list:
+        for cfg in matrix:
+            print(cfg.name)
+        return 0
+
+    from gol_tpu.analysis.checks import run_config
+
+    report = AnalysisReport()
+    for cfg in matrix:
+        report.engines.append(run_config(cfg))
+
+    if ns.json:
+        print(report.to_json())
+    else:
+        print(report.render_text(verbose=ns.verbose))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

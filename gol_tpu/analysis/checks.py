@@ -1,0 +1,708 @@
+"""The five static checks over a traced engine program.
+
+Each check consumes the jaxpr (or AOT-compiled executable) of one engine
+configuration and returns findings; none of them needs a TPU.  What they
+pin:
+
+- **comm** — every ``lax.ppermute`` is a valid ±1 ring over the right
+  mesh axis, both directions are exchanged at every site, the shipped
+  halo slab is deep enough for the temporal-blocking contract
+  (slab depth × axis quantum ≥ stencil radius × generations per
+  exchange, the :func:`gol_tpu.parallel.halo.halo_extend` contract), and
+  single-device programs contain no collectives at all.
+- **dtype** — the engines are integer programs end to end: any float
+  aval is an upcast leak (8× the HBM bytes for the packed tiers); the
+  packed tiers additionally stay inside {uint8, uint32, int32, bool}.
+- **purity** — no host callbacks / infeed inside compiled generation
+  loops: one ``debug_callback`` would serialize every loop iteration on
+  a host round-trip (the per-step ``cudaDeviceSynchronize`` this
+  framework exists to delete).
+- **donation + cost** — the donated input buffer is actually reused
+  (XLA input/output aliasing — the double buffer; a dropped alias
+  doubles peak HBM), and the compiled FLOP count matches the audited
+  per-cell/per-word op model in :mod:`gol_tpu.utils.roofline` within
+  2×.  The strict gate applies where the model is exact (depth-1 XLA
+  engines): XLA's HLO cost analysis counts loop *bodies* once (trip
+  counts are dynamic) and counts fusion recompute, so deep-unrolled
+  chunks and interpret-mode Pallas get attribution findings, not gates.
+- **retrace** — a chunk schedule must compile once per distinct chunk
+  size, never per chunk: engine builders must return cached programs
+  for repeated (mesh, steps) keys, and dispatching the jitted engine
+  twice on identical buffers must hit the trace cache.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from gol_tpu.analysis import walker
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    WARN,
+    CheckResult,
+    Finding,
+)
+
+STENCIL_RADIUS = 1  # Moore neighborhood: one ghost layer per generation
+
+# Host-interaction primitives that must never appear inside a compiled
+# generation loop.
+IMPURE_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "debug_print",
+        "host_callback",
+        "outside_call",
+        "infeed",
+        "outfeed",
+    }
+)
+
+# Any collective: single-device programs must have none.
+COLLECTIVE_PRIMITIVES = frozenset(
+    {"ppermute", "psum", "pmax", "pmin", "all_gather", "all_to_all",
+     "reduce_scatter"}
+)
+
+ALLOWED_DTYPES_PACKED = ("uint8", "uint32", "int32", "bool")
+
+
+def ring_perm(n: int, shift: int) -> frozenset:
+    """The ±1 ring permutation pairs (mirrors parallel.halo.ring)."""
+    return frozenset((i, (i + shift) % n) for i in range(n))
+
+
+# ---------------------------------------------------------------------------
+# comm
+# ---------------------------------------------------------------------------
+
+
+def expected_exchange_plan(
+    engine: str, shard_mode: str, halo_depth: int, steps: int
+) -> Tuple[int, int]:
+    """(generations per full exchange, remainder generations).
+
+    Mirrors the engines' documented chunking: explicit-mode engines ship
+    one ``halo_depth``-deep band per ``halo_depth`` generations (plus one
+    remainder chunk); overlap dense/bitpack exchange every generation;
+    the sharded Pallas engine always runs 8-aligned bands.
+    """
+    if engine == "pallas_bitpack":
+        depth = 8 if halo_depth == 1 else halo_depth
+        return depth, steps % depth
+    if shard_mode == "overlap":
+        return 1, 0
+    return halo_depth, steps % halo_depth
+
+
+def slab_depth(engine: str, axis_name: str, shape: Sequence[int]) -> int:
+    """Exchanged ghost-band depth of one ppermute operand.
+
+    Engine slab conventions (pinned by the engines' own layouts): row and
+    plane bands carry their depth on array axis 0 — ``(k, words)`` /
+    ``(k, W)`` slices; column bands on axis 1 — the ``(h+2k, k)`` edge
+    columns of the row-extended block — except the sharded Pallas
+    engine's 1-word column band, which rides transposed ``(words, rows)``
+    for the kernel's lane layout.
+    """
+    if axis_name == "cols":
+        return shape[0] if engine == "pallas_bitpack" else shape[1]
+    return shape[0]
+
+
+def axis_quantum_cells(engine: str, axis_name: str) -> int:
+    """Cells of halo covered per unit of exchanged slab depth.
+
+    The packed engines' horizontal ghost quantum is the 32-cell word
+    (one word column serves 32 generations of column light cone); every
+    other axis exchanges at cell/row granularity.
+    """
+    if engine in ("bitpack", "pallas_bitpack") and axis_name == "cols":
+        from gol_tpu.ops import bitlife
+
+        return bitlife.BITS
+    return 1
+
+
+def check_comm(jaxpr, cfg, mesh) -> CheckResult:
+    """Verify ring permutations and halo-depth sufficiency."""
+    findings: List[Finding] = []
+    pp = walker.find_eqns(jaxpr, ["ppermute"])
+
+    if mesh is None:
+        extra = [
+            i.name
+            for i in walker.iter_eqns(jaxpr)
+            if i.name in COLLECTIVE_PRIMITIVES
+        ]
+        if extra:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "comm",
+                    f"single-device program contains collectives: {extra}",
+                )
+            )
+        else:
+            findings.append(
+                Finding(INFO, "comm", "single-device: no collectives, as required")
+            )
+        return CheckResult.from_findings("comm", findings)
+
+    if cfg.shard_mode == "auto":
+        # XLA SPMD inserts collective-permutes at partition time; the
+        # jaxpr legitimately has none.  The compiled-HLO side is covered
+        # by check_donation_cost's lowering (see run_config).
+        if pp:
+            findings.append(
+                Finding(
+                    WARN,
+                    "comm",
+                    "auto-SPMD program unexpectedly contains explicit "
+                    f"ppermutes ({len(pp)})",
+                )
+            )
+        return CheckResult.from_findings("comm", findings)
+
+    if not pp:
+        findings.append(
+            Finding(
+                ERROR,
+                "comm",
+                "sharded explicit/overlap program contains no ppermute — "
+                "shards would evolve independently (the reference's bug "
+                "B1, permanently)",
+            )
+        )
+        return CheckResult.from_findings("comm", findings)
+
+    g_full, g_rem = expected_exchange_plan(
+        cfg.engine, cfg.shard_mode, cfg.halo_depth, max(cfg.schedule)
+    )
+
+    # Group sites by (mesh axis, in generation loop or remainder tail).
+    sites = {}
+    for info in pp:
+        axis_name = info.eqn.params["axis_name"]
+        axis = axis_name[0] if isinstance(axis_name, tuple) else axis_name
+        sites.setdefault((axis, info.in_loop), []).append(info)
+
+    for (axis, in_loop), infos in sorted(sites.items(), key=str):
+        n = mesh.shape.get(axis)
+        if n is None:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "comm",
+                    f"ppermute over axis {axis!r} which is not a mesh "
+                    f"axis of {dict(mesh.shape)}",
+                )
+            )
+            continue
+        fwd, bwd = ring_perm(n, 1), ring_perm(n, -1)
+        dirs = set()
+        for info in infos:
+            perm = frozenset(tuple(p) for p in info.eqn.params["perm"])
+            if perm == fwd:
+                dirs.add(+1)
+            elif perm == bwd:
+                dirs.add(-1)
+            else:
+                findings.append(
+                    Finding(
+                        ERROR,
+                        "comm",
+                        f"axis {axis!r}: ppermute permutation "
+                        f"{sorted(perm)} is not a ±1 ring over {n} "
+                        "devices — halos would come from the wrong "
+                        "neighbor",
+                    )
+                )
+        if fwd == bwd:
+            # n <= 2: the ±1 rings coincide (each shard's neighbor is
+            # the same device both ways); direction balance is vacuous.
+            dirs = {+1, -1} if dirs else dirs
+        if len(infos) >= 2 and dirs and dirs != {+1, -1}:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "comm",
+                    f"axis {axis!r}: both ring directions must be "
+                    f"exchanged per site, saw shifts {sorted(dirs)} only",
+                )
+            )
+
+        # Halo-depth sufficiency.  The slab rides the smallest dimension
+        # of the ppermute operand (boards are sized so shard extents
+        # strictly exceed band depths).
+        need = g_full if in_loop else g_rem
+        if need == 0:
+            continue
+        quantum = axis_quantum_cells(cfg.engine, axis)
+        depth = min(
+            slab_depth(cfg.engine, axis, i.eqn.invars[0].aval.shape)
+            for i in infos
+        )
+        supplied = depth * quantum
+        if supplied < STENCIL_RADIUS * need:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "comm",
+                    f"axis {axis!r} ({'loop' if in_loop else 'tail'}): "
+                    f"exchanged halo depth {depth} (×{quantum} cells) < "
+                    f"stencil radius {STENCIL_RADIUS} × {need} "
+                    "generations per exchange — the outermost "
+                    "generations would read stale or uninitialized ghost "
+                    "cells",
+                )
+            )
+        elif supplied > 4 * STENCIL_RADIUS * max(need, 8):
+            findings.append(
+                Finding(
+                    WARN,
+                    "comm",
+                    f"axis {axis!r}: exchanged depth {supplied} cells is "
+                    f">4× the {need} generations it serves — wasted "
+                    "ring bandwidth",
+                )
+            )
+        else:
+            findings.append(
+                Finding(
+                    INFO,
+                    "comm",
+                    f"axis {axis!r} ({'loop' if in_loop else 'tail'}): "
+                    f"{len(infos)} ppermutes, slab depth {depth} "
+                    f"(quantum {quantum}) serves {need} gens",
+                )
+            )
+    return CheckResult.from_findings("comm", findings)
+
+
+# ---------------------------------------------------------------------------
+# dtype
+# ---------------------------------------------------------------------------
+
+
+def check_dtype(jaxpr, cfg) -> CheckResult:
+    """No float avals anywhere; packed tiers stay in the word dtypes."""
+    findings: List[Finding] = []
+    packed = cfg.engine in ("bitpack", "pallas_bitpack")
+    float_hits = {}
+    alien_hits = {}
+    for info, aval in walker.all_avals(jaxpr):
+        dtype = getattr(aval, "dtype", None)
+        if dtype is None:
+            continue
+        # Pallas DMA semaphores and scratch refs are bookkeeping, not
+        # cell state; only value avals can leak board dtypes.  (A float
+        # VMEM scratch would still surface through the values computed
+        # from it.)
+        if "Ref" in type(aval).__name__ or "Semaphore" in type(aval).__name__:
+            continue
+        try:
+            name = np.dtype(dtype).name
+        except TypeError:  # extended/opaque dtype (pallas internals)
+            continue
+        if np.issubdtype(dtype, np.floating) or np.issubdtype(
+            dtype, np.complexfloating
+        ):
+            float_hits.setdefault((name, info.name), 0)
+            float_hits[(name, info.name)] += 1
+        elif packed and name not in ALLOWED_DTYPES_PACKED:
+            alien_hits.setdefault((name, info.name), 0)
+            alien_hits[(name, info.name)] += 1
+    for (name, prim), count in sorted(float_hits.items()):
+        findings.append(
+            Finding(
+                ERROR,
+                "dtype",
+                f"float leak: {count}× {name} aval(s) at primitive "
+                f"{prim!r} — the engines are integer programs; a float "
+                "upcast multiplies HBM traffic and breaks bit-exactness",
+            )
+        )
+    for (name, prim), count in sorted(alien_hits.items()):
+        findings.append(
+            Finding(
+                ERROR,
+                "dtype",
+                f"packed-tier dtype leak: {count}× {name} aval(s) at "
+                f"primitive {prim!r}; allowed: {ALLOWED_DTYPES_PACKED}",
+            )
+        )
+    if not findings:
+        findings.append(
+            Finding(INFO, "dtype", "all avals integer/bool, as required")
+        )
+    return CheckResult.from_findings("dtype", findings)
+
+
+# ---------------------------------------------------------------------------
+# purity
+# ---------------------------------------------------------------------------
+
+
+def check_purity(jaxpr, cfg) -> CheckResult:
+    """No host callbacks / infeed anywhere in the compiled program."""
+    findings: List[Finding] = []
+    for info in walker.iter_eqns(jaxpr):
+        if info.name in IMPURE_PRIMITIVES:
+            where = "inside the generation loop" if info.in_loop else (
+                "in the compiled program"
+            )
+            findings.append(
+                Finding(
+                    ERROR,
+                    "purity",
+                    f"host-interaction primitive {info.name!r} {where} "
+                    f"(path {'/'.join(info.path) or 'top'}) — every "
+                    "iteration would pay a host round-trip",
+                )
+            )
+    if not findings:
+        findings.append(
+            Finding(INFO, "purity", "no host callbacks in the traced program")
+        )
+    return CheckResult.from_findings("purity", findings)
+
+
+# ---------------------------------------------------------------------------
+# donation + cost
+# ---------------------------------------------------------------------------
+
+
+def _cost_dict(compiled) -> dict:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # backend without cost analysis
+        return {}
+    if isinstance(ca, list):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def check_donation(compiled, cfg, shard_bytes: int, compile_warnings=())\
+        -> CheckResult:
+    """The donated input buffer must be reused by the executable."""
+    findings: List[Finding] = []
+    for w in compile_warnings:
+        if "donat" in str(w.message).lower():
+            findings.append(
+                Finding(ERROR, "donation", f"XLA: {w.message}")
+            )
+    alias = None
+    try:
+        alias = compiled.memory_analysis().alias_size_in_bytes
+    except Exception:
+        pass
+    if alias is not None:
+        if alias >= shard_bytes:
+            findings.append(
+                Finding(
+                    INFO,
+                    "donation",
+                    f"{alias} bytes aliased (≥ shard {shard_bytes}) — "
+                    "double buffer in place",
+                )
+            )
+        else:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "donation",
+                    f"only {alias} bytes aliased but the donated shard "
+                    f"is {shard_bytes} bytes — the double buffer is "
+                    "broken and peak HBM doubles",
+                )
+            )
+    elif "input_output_alias" in compiled.as_text():
+        findings.append(
+            Finding(INFO, "donation", "input_output_alias present in HLO")
+        )
+    else:
+        findings.append(
+            Finding(
+                ERROR,
+                "donation",
+                "no input/output aliasing in the compiled executable",
+            )
+        )
+    return CheckResult.from_findings("donation", findings)
+
+
+def check_cost(compiled, cfg, mesh, num_devices: int) -> CheckResult:
+    """Cross-check compiled FLOPs against the roofline op model."""
+    from gol_tpu.utils import roofline
+
+    findings: List[Finding] = []
+    ca = _cost_dict(compiled)
+    flops = ca.get("flops")
+    bytes_accessed = ca.get("bytes accessed")
+    if not flops:
+        return CheckResult.skipped(
+            "cost", "backend reported no FLOP count for this executable"
+        )
+
+    h, w = cfg.board_shape
+    shard_cells = (h * w) // max(num_devices, 1)
+    take = max(cfg.schedule)
+    model = roofline.xla_flops_model(
+        cfg.engine,
+        shard_cells,
+        take,
+        cfg.halo_depth,
+        sharded=mesh is not None,
+    )
+    ratio = flops / model if model else float("nan")
+    attribution = (
+        f"compiled flops {flops:.0f} vs model {model:.0f} "
+        f"(ratio {ratio:.2f}; XLA counts loop bodies once)"
+    )
+    if cfg.cost_gate and model:
+        if ratio > roofline.XLA_COST_DRIFT or ratio < 1 / roofline.XLA_COST_DRIFT:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "cost",
+                    f"{attribution} — drift exceeds "
+                    f"{roofline.XLA_COST_DRIFT}×; the engine is doing "
+                    "work the op model does not predict",
+                )
+            )
+        else:
+            findings.append(Finding(INFO, "cost", attribution))
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "cost",
+                attribution
+                + " [attribution only: fusion recompute / interpret-mode "
+                "Pallas make deep-unrolled counts non-gateable]",
+            )
+        )
+    if bytes_accessed:
+        findings.append(
+            Finding(
+                INFO,
+                "cost",
+                f"bytes accessed {bytes_accessed:.0f} "
+                f"({bytes_accessed / max(shard_cells, 1):.1f}/cell of one "
+                "loop body)",
+            )
+        )
+    return CheckResult.from_findings("cost", findings)
+
+
+# ---------------------------------------------------------------------------
+# retrace
+# ---------------------------------------------------------------------------
+
+
+def check_retrace(
+    rt,
+    cfg,
+    make_board,
+    execute: bool = True,
+) -> CheckResult:
+    """A chunk schedule compiles once per distinct size, never per chunk.
+
+    ``make_board`` builds a fresh donated-safe concrete board (called per
+    execution because the engines consume their input).
+    """
+    findings: List[Finding] = []
+    schedule = list(cfg.schedule)
+
+    # 1. Builder stability: repeated takes must yield the identical
+    # program object (the lru_cache contract of the engine builders).
+    seen = {}
+    for take in schedule + schedule:
+        fn, _, _ = rt._evolve_fn(take)
+        seen.setdefault(take, set()).add(id(fn))
+    unstable = {t: ids for t, ids in seen.items() if len(ids) > 1}
+    if unstable:
+        findings.append(
+            Finding(
+                ERROR,
+                "retrace",
+                f"engine builder returned a fresh program object for "
+                f"repeated chunk sizes {sorted(unstable)} — every chunk "
+                "would retrace and recompile",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "retrace",
+                f"{len(seen)} distinct programs for "
+                f"{len(schedule)}-chunk schedule {schedule}",
+            )
+        )
+
+    # 2. Dispatch stability: a second call on identical buffers must hit
+    # the trace cache.
+    if execute and not unstable:
+        take = min(schedule)
+        fn, dynamic, static = rt._evolve_fn(take)
+        size = getattr(fn, "_cache_size", None)
+        if size is None:
+            findings.append(
+                Finding(
+                    WARN,
+                    "retrace",
+                    "jit cache size introspection unavailable; dispatch "
+                    "check skipped",
+                )
+            )
+        else:
+            fn(make_board(), *dynamic, *static)
+            warm = size()
+            fn(make_board(), *dynamic, *static)
+            if size() > warm:
+                findings.append(
+                    Finding(
+                        ERROR,
+                        "retrace",
+                        "identical dispatch added a trace-cache entry — "
+                        "the engine retraces per call (unstable static "
+                        "argument or unhashable key)",
+                    )
+                )
+    return CheckResult.from_findings("retrace", findings)
+
+
+# ---------------------------------------------------------------------------
+# driver: one config end to end
+# ---------------------------------------------------------------------------
+
+
+def run_config(cfg, execute_retrace: bool = True):
+    """All checks over one :class:`EngineConfig`; returns EngineReport."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.analysis.report import EngineReport, FAIL, PASS
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    report = EngineReport(config_name=cfg.name)
+
+    if cfg.reject_reason is not None:
+        try:
+            cfg.build_runtime()
+        except ValueError as e:
+            report.rejected = str(e).splitlines()[0]
+            report.checks.append(
+                CheckResult("config", PASS, [
+                    Finding(INFO, "config", f"rejected: {e}")
+                ])
+            )
+        else:
+            report.checks.append(
+                CheckResult("config", FAIL, [
+                    Finding(
+                        ERROR,
+                        "config",
+                        "runtime accepted a combination it must reject "
+                        f"({cfg.reject_reason})",
+                    )
+                ])
+            )
+        return report
+
+    try:
+        rt = cfg.build_runtime()
+    except Exception as e:  # config must build
+        report.checks.append(
+            CheckResult("config", FAIL, [
+                Finding(ERROR, "config", f"runtime failed to build: {e}")
+            ])
+        )
+        return report
+
+    mesh = rt.mesh
+    h, w = cfg.board_shape
+    if mesh is not None:
+        spec = jax.ShapeDtypeStruct(
+            (h, w), jnp.uint8, sharding=mesh_mod.board_sharding(mesh)
+        )
+    else:
+        spec = jax.ShapeDtypeStruct((h, w), jnp.uint8)
+
+    if cfg.halo_mode == "stale_t0":
+        # Frozen t=0 halos are dynamic inputs; abstract stand-ins trace
+        # and lower identically.
+        halo = jax.ShapeDtypeStruct((cfg.num_ranks, w), jnp.uint8)
+        rt._halos = (halo, halo)
+
+    take = max(cfg.schedule)
+    fn, dynamic, static = rt._evolve_fn(take)
+    jaxpr = walker.trace_jaxpr(fn, spec, *dynamic, *static)
+
+    report.checks.append(check_comm(jaxpr, cfg, mesh))
+    report.checks.append(check_dtype(jaxpr, cfg))
+    report.checks.append(check_purity(jaxpr, cfg))
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        compiled = fn.lower(spec, *dynamic, *static).compile()
+    num_devices = 1 if mesh is None else mesh.devices.size
+    shard_bytes = (h * w) // max(num_devices, 1)  # uint8: 1 byte/cell
+    report.checks.append(
+        check_donation(compiled, cfg, shard_bytes, caught)
+    )
+    report.checks.append(check_cost(compiled, cfg, mesh, num_devices))
+
+    if cfg.shard_mode == "auto" and mesh is not None:
+        # The comm invariant for auto-SPMD lives in the partitioned HLO.
+        txt = compiled.as_text()
+        ok = "collective-permute" in txt or "all-to-all" in txt
+        report.checks.append(
+            CheckResult.from_findings("comm-hlo", [
+                Finding(
+                    INFO if ok else ERROR,
+                    "comm-hlo",
+                    "partitioned HLO contains collective-permute"
+                    if ok
+                    else "auto-SPMD compiled program has no collective — "
+                    "XLA failed to derive the halo exchange and shards "
+                    "evolve independently",
+                )
+            ])
+        )
+
+    def make_board():
+        rng = np.random.default_rng(2026)
+        board = jnp.asarray(
+            (rng.random((h, w)) < 0.33).astype(np.uint8)
+        )
+        if mesh is not None:
+            return mesh_mod.place_private(
+                board, mesh_mod.board_sharding(mesh)
+            )
+        return board
+
+    if cfg.halo_mode == "stale_t0":
+        # Execution would need concrete halos; builder stability is the
+        # meaningful half here.
+        from gol_tpu.parallel import engine as engine_mod
+
+        board0 = make_board()
+        rt._halos = engine_mod.frozen_halos(board0, cfg.num_ranks)
+        execute_retrace = False
+    exec_ok = execute_retrace and cfg.engine not in (
+        "pallas",
+        "pallas_bitpack",
+    )
+    report.checks.append(
+        check_retrace(rt, cfg, make_board, execute=exec_ok)
+    )
+    return report

@@ -1,0 +1,225 @@
+"""The engine×mesh verification matrix.
+
+Every entry describes one *runtime configuration* exactly as
+:class:`gol_tpu.runtime.GolRuntime` would build it — same engine
+dispatch, same chunk schedule, same abstract input — so what the verifier
+traces is what a pod run executes.  Geometries are sized for CPU tracing
+(small boards, virtual-device meshes) but respect every engine's real
+constraints (packed widths, Pallas alignment, band depth limits); the
+*invariants* checked are size-independent.
+
+Unsupported engine×mesh combinations are first-class entries too: the
+runtime must *reject* them with a clean ``ValueError`` (that validation
+is itself an invariant — a config silently accepted and mis-executed is
+exactly the bug class this subsystem exists to catch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from gol_tpu.models.state import Geometry
+
+MESH_DEVICE_COUNTS = {"none": 0, "1d": 4, "2d": 4}
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """One engine×mesh cell of the verification matrix."""
+
+    name: str
+    engine: str  # dense / bitpack / pallas / pallas_bitpack
+    mesh: str  # none / 1d / 2d
+    size: int = 64  # per-rank square edge; board is (size*num_ranks, size)
+    # Chunk schedule driving the verifier: repeated takes exercise the
+    # retrace detector; the largest take is the one traced/compiled.
+    schedule: Tuple[int, ...] = (8, 8, 4)
+    shard_mode: str = "explicit"
+    halo_depth: int = 1
+    rule: Optional[str] = None
+    halo_mode: str = "fresh"
+    num_ranks: int = 1
+    tile_hint: int = 512
+    # None: combination must build; otherwise a substring the runtime's
+    # rejection message must contain (negative check).
+    reject_reason: Optional[str] = None
+    # Strict 2x cost gate only where the XLA flop model is exact (depth-1
+    # XLA engines; fusion recompute and interpret-mode Pallas are
+    # attribution-only — see checks.check_cost).
+    cost_gate: bool = False
+
+    @property
+    def steps(self) -> int:
+        return sum(self.schedule)
+
+    @property
+    def geometry(self) -> Geometry:
+        return Geometry(size=self.size, num_ranks=self.num_ranks)
+
+    @property
+    def board_shape(self) -> Tuple[int, int]:
+        g = self.geometry
+        return (g.global_height, g.global_width)
+
+    def build_mesh(self):
+        """The (virtual-)device mesh this config runs on, or None."""
+        import jax
+
+        from gol_tpu.parallel import mesh as mesh_mod
+
+        n = MESH_DEVICE_COUNTS[self.mesh]
+        if n == 0:
+            return None
+        devices = jax.devices()
+        if len(devices) < n:
+            raise RuntimeError(
+                f"config {self.name!r} needs {n} devices, have "
+                f"{len(devices)}; run under "
+                f"--xla_force_host_platform_device_count={n} or more"
+            )
+        if self.mesh == "1d":
+            return mesh_mod.make_mesh_1d(n, devices=devices[:n])
+        return mesh_mod.make_mesh_2d((2, 2), devices=devices[:n])
+
+    def build_runtime(self):
+        """The GolRuntime for this config (raises for reject entries)."""
+        from gol_tpu.runtime import GolRuntime
+
+        return GolRuntime(
+            geometry=self.geometry,
+            engine=self.engine,
+            halo_mode=self.halo_mode,
+            tile_hint=self.tile_hint,
+            mesh=self.build_mesh(),
+            shard_mode=self.shard_mode,
+            halo_depth=self.halo_depth,
+            rule=self.rule,
+        )
+
+
+def default_matrix() -> List[EngineConfig]:
+    """All four engines × mesh modes none/1d (+2d where supported)."""
+    cfgs: List[EngineConfig] = []
+
+    # -- mesh none: every single-device tier -------------------------------
+    cfgs += [
+        EngineConfig(
+            name="dense/none", engine="dense", mesh="none", cost_gate=True,
+        ),
+        EngineConfig(
+            name="dense/none/stale_t0", engine="dense", mesh="none",
+            size=16, halo_mode="stale_t0", num_ranks=4,
+        ),
+        EngineConfig(
+            name="bitpack/none", engine="bitpack", mesh="none",
+            cost_gate=True,
+        ),
+        EngineConfig(
+            name="bitpack/none/rule=B36S23", engine="bitpack", mesh="none",
+            rule="B36/S23",
+        ),
+        EngineConfig(
+            name="pallas/none", engine="pallas", mesh="none", tile_hint=32,
+        ),
+        EngineConfig(
+            name="pallas_bitpack/none", engine="pallas_bitpack",
+            mesh="none", tile_hint=1024,
+        ),
+    ]
+
+    # -- mesh 1d (4-device ring) -------------------------------------------
+    cfgs += [
+        EngineConfig(
+            name="dense/1d/explicit", engine="dense", mesh="1d",
+            cost_gate=True,
+        ),
+        EngineConfig(
+            name="dense/1d/explicit/k=4", engine="dense", mesh="1d",
+            halo_depth=4,
+        ),
+        EngineConfig(
+            name="dense/1d/overlap", engine="dense", mesh="1d",
+            shard_mode="overlap",
+        ),
+        EngineConfig(
+            name="dense/1d/auto", engine="dense", mesh="1d",
+            shard_mode="auto",
+        ),
+        EngineConfig(
+            name="bitpack/1d/explicit/k=2", engine="bitpack", mesh="1d",
+            halo_depth=2,
+        ),
+        EngineConfig(
+            name="bitpack/1d/overlap", engine="bitpack", mesh="1d",
+            shard_mode="overlap",
+        ),
+        EngineConfig(
+            name="bitpack/1d/rule=B36S23", engine="bitpack", mesh="1d",
+            rule="B36/S23",
+        ),
+        # The flagship: fused Pallas kernel per shard over the packed ring.
+        # Band depth 8; the schedule's 8-multiple takes trace the banded
+        # chunk loop and the non-multiple tail traces the jnp remainder.
+        EngineConfig(
+            name="pallas_bitpack/1d/explicit/k=8", engine="pallas_bitpack",
+            mesh="1d", halo_depth=8, schedule=(16, 16, 11),
+            tile_hint=1024,
+        ),
+        # The overlap form: interior kernel independent of the band ring
+        # (needs shard height >= 2*depth + 8, hence the larger board).
+        EngineConfig(
+            name="pallas_bitpack/1d/overlap/k=8", engine="pallas_bitpack",
+            mesh="1d", size=128, halo_depth=8, shard_mode="overlap",
+            schedule=(16, 16), tile_hint=1024,
+        ),
+        # Negative entries: the runtime must refuse these cleanly.
+        EngineConfig(
+            name="pallas/1d (must reject)", engine="pallas", mesh="1d",
+            reject_reason="no sharded path",
+        ),
+        EngineConfig(
+            name="bitpack/1d/auto (must reject)", engine="bitpack",
+            mesh="1d", shard_mode="auto",
+            reject_reason="no auto-SPMD",
+        ),
+    ]
+
+    # -- mesh 2d (2x2 grid) --------------------------------------------------
+    cfgs += [
+        EngineConfig(
+            name="dense/2d/explicit", engine="dense", mesh="2d",
+            cost_gate=True,
+        ),
+        EngineConfig(
+            name="dense/2d/explicit/k=2", engine="dense", mesh="2d",
+            halo_depth=2,
+        ),
+        EngineConfig(
+            name="bitpack/2d/explicit", engine="bitpack", mesh="2d",
+        ),
+        EngineConfig(
+            name="pallas_bitpack/2d/explicit/k=8", engine="pallas_bitpack",
+            mesh="2d", size=128, halo_depth=8, schedule=(8, 8),
+            tile_hint=1024,
+        ),
+        EngineConfig(
+            name="bitpack/2d/overlap (must reject)", engine="bitpack",
+            mesh="2d", shard_mode="overlap",
+            reject_reason="1-D (row-ring) only",
+        ),
+    ]
+    return cfgs
+
+
+def select(
+    matrix: List[EngineConfig],
+    engines: Optional[List[str]] = None,
+    meshes: Optional[List[str]] = None,
+) -> List[EngineConfig]:
+    out = matrix
+    if engines:
+        out = [c for c in out if c.engine in engines]
+    if meshes:
+        out = [c for c in out if c.mesh in meshes]
+    return out

@@ -1,0 +1,150 @@
+"""Findings, per-check results, and the engine×mesh report tree.
+
+The verifier's output contract: every check over every engine
+configuration produces one :class:`CheckResult` holding zero or more
+:class:`Finding`s.  A finding at severity ``error`` means a framework
+invariant is violated in the *traced program itself* — the run would be
+wrong (or wasteful) on a pod, and the CLI exits non-zero.  ``warn`` marks
+suspicious-but-not-disqualifying facts (e.g. a deeper halo band than the
+blocking needs); ``info`` is attribution the other checks computed along
+the way (op counts, alias bytes) kept for the report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+ERROR = "error"
+WARN = "warn"
+INFO = "info"
+
+PASS = "PASS"
+FAIL = "FAIL"
+SKIP = "SKIP"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One fact a check established about a traced program."""
+
+    severity: str  # ERROR / WARN / INFO
+    check: str  # which check produced it (comm, dtype, purity, ...)
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """Outcome of one check over one engine configuration."""
+
+    check: str
+    status: str  # PASS / FAIL / SKIP
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    skip_reason: Optional[str] = None
+
+    @classmethod
+    def from_findings(
+        cls, check: str, findings: List[Finding]
+    ) -> "CheckResult":
+        status = (
+            FAIL if any(f.severity == ERROR for f in findings) else PASS
+        )
+        return cls(check=check, status=status, findings=list(findings))
+
+    @classmethod
+    def skipped(cls, check: str, reason: str) -> "CheckResult":
+        return cls(check=check, status=SKIP, skip_reason=reason)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def as_dict(self) -> dict:
+        d = {
+            "check": self.check,
+            "status": self.status,
+            "findings": [f.as_dict() for f in self.findings],
+        }
+        if self.skip_reason:
+            d["skip_reason"] = self.skip_reason
+        return d
+
+
+@dataclasses.dataclass
+class EngineReport:
+    """All check results for one engine×mesh configuration."""
+
+    config_name: str
+    checks: List[CheckResult] = dataclasses.field(default_factory=list)
+    # A config the runtime must *reject* (negative check): set when the
+    # expected ValueError fired; a config that unexpectedly built instead
+    # records a FAIL under the "config" pseudo-check.
+    rejected: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return all(c.status != FAIL for c in self.checks)
+
+    def as_dict(self) -> dict:
+        d = {
+            "config": self.config_name,
+            "ok": self.ok,
+            "checks": [c.as_dict() for c in self.checks],
+        }
+        if self.rejected is not None:
+            d["rejected"] = self.rejected
+        return d
+
+
+@dataclasses.dataclass
+class AnalysisReport:
+    """The whole verification pass."""
+
+    engines: List[EngineReport] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(e.ok for e in self.engines)
+
+    @property
+    def exit_code(self) -> int:
+        return 0 if self.ok else 1
+
+    def as_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "engines": [e.as_dict() for e in self.engines],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.as_dict(), indent=2)
+
+    def render_text(self, verbose: bool = False) -> str:
+        """Human report: one block per config, one line per check."""
+        lines = []
+        n_fail = 0
+        for er in self.engines:
+            mark = "ok " if er.ok else "FAIL"
+            lines.append(f"[{mark}] {er.config_name}")
+            if er.rejected is not None:
+                lines.append(f"      rejected as expected: {er.rejected}")
+            for c in er.checks:
+                if c.status == SKIP:
+                    lines.append(f"      - {c.check}: skip ({c.skip_reason})")
+                    continue
+                lines.append(f"      - {c.check}: {c.status}")
+                for f in c.findings:
+                    if f.severity == ERROR or verbose:
+                        lines.append(f"          {f.severity}: {f.message}")
+                n_fail += len(c.errors)
+        total = len(self.engines)
+        bad = sum(1 for e in self.engines if not e.ok)
+        lines.append(
+            f"{total} configs verified: {total - bad} ok, {bad} failing, "
+            f"{n_fail} invariant violations"
+        )
+        return "\n".join(lines)

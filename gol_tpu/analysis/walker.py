@@ -1,0 +1,118 @@
+"""Recursive jaxpr traversal with loop/transform context.
+
+Engine programs nest jaxprs several levels deep — ``pjit`` → ``shard_map``
+→ ``while``/``scan`` bodies → more ``pjit`` — and every static check needs
+the same two facts about an equation: *what primitive is it* and *is it
+inside the generation loop*.  This module owns that traversal so the
+checks stay declarative: :func:`iter_eqns` yields every equation in the
+tree tagged with its enclosing-loop depth and the path of higher-order
+primitives above it, descending into any equation parameter that holds a
+``Jaxpr``/``ClosedJaxpr`` (robust to jaxpr parameter naming across JAX
+versions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Sequence, Tuple, Union
+
+import jax
+from jax import core as jax_core
+
+# Primitives whose body executes a data-dependent number of times: an
+# equation inside one runs "per loop trip" for invariant purposes.
+LOOP_PRIMITIVES = frozenset({"while", "scan"})
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnInfo:
+    """One equation plus where in the program tree it sits."""
+
+    eqn: jax_core.JaxprEqn
+    path: Tuple[str, ...]  # names of enclosing higher-order primitives
+    loop_depth: int  # number of enclosing while/scan bodies
+
+    @property
+    def name(self) -> str:
+        return self.eqn.primitive.name
+
+    @property
+    def in_loop(self) -> bool:
+        return self.loop_depth > 0
+
+
+def _as_jaxpr(value) -> Union[jax_core.Jaxpr, None]:
+    if isinstance(value, jax_core.Jaxpr):
+        return value
+    if isinstance(value, jax_core.ClosedJaxpr):
+        return value.jaxpr
+    return None
+
+
+def _sub_jaxprs(eqn: jax_core.JaxprEqn) -> List[jax_core.Jaxpr]:
+    subs = []
+    for value in eqn.params.values():
+        j = _as_jaxpr(value)
+        if j is not None:
+            subs.append(j)
+        elif isinstance(value, (tuple, list)):
+            for item in value:
+                j = _as_jaxpr(item)
+                if j is not None:
+                    subs.append(j)
+    return subs
+
+
+def iter_eqns(
+    jaxpr: Union[jax_core.Jaxpr, jax_core.ClosedJaxpr],
+    _path: Tuple[str, ...] = (),
+    _loop_depth: int = 0,
+) -> Iterator[EqnInfo]:
+    """Depth-first walk of every equation in the jaxpr tree."""
+    j = _as_jaxpr(jaxpr)
+    if j is None:
+        raise TypeError(f"not a jaxpr: {type(jaxpr)!r}")
+    for eqn in j.eqns:
+        name = eqn.primitive.name
+        yield EqnInfo(eqn=eqn, path=_path, loop_depth=_loop_depth)
+        inner_depth = _loop_depth + (1 if name in LOOP_PRIMITIVES else 0)
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, _path + (name,), inner_depth)
+
+
+def primitive_names(jaxpr) -> List[str]:
+    """All primitive names in the tree (with duplicates)."""
+    return [info.name for info in iter_eqns(jaxpr)]
+
+
+def find_eqns(jaxpr, names: Sequence[str]) -> List[EqnInfo]:
+    """Every equation whose primitive name is in ``names``."""
+    wanted = frozenset(names)
+    return [info for info in iter_eqns(jaxpr) if info.name in wanted]
+
+
+def all_avals(jaxpr) -> List[Tuple[EqnInfo, jax_core.AbstractValue]]:
+    """(equation, aval) for every input/output of every equation."""
+    out = []
+    for info in iter_eqns(jaxpr):
+        for var in list(info.eqn.invars) + list(info.eqn.outvars):
+            aval = getattr(var, "aval", None)
+            if aval is not None:
+                out.append((info, aval))
+    return out
+
+
+def trace_jaxpr(fn, *args, static_argnums=()):
+    """Jaxpr of ``fn`` on abstract ``args`` (ShapeDtypeStructs welcome).
+
+    Prefers the AOT ``.trace`` path for jitted functions (statics already
+    bound by ``jax.jit``); falls back to ``jax.make_jaxpr`` with explicit
+    ``static_argnums`` for plain callables.
+    """
+    trace = getattr(fn, "trace", None)
+    if trace is not None:
+        try:
+            return trace(*args).jaxpr
+        except (TypeError, AttributeError):
+            pass
+    return jax.make_jaxpr(fn, static_argnums=static_argnums)(*args)

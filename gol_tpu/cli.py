@@ -22,6 +22,11 @@ the five positionals:
 - ``--outdir DIR``, ``--profile DIR``, ``--compat-banner``,
   ``--checkpoint-every K`` / ``--resume PATH`` (capability additions).
 
+One subcommand rides in front of the reference surface: ``python -m
+gol_tpu verify`` runs the static invariant verifier
+(:mod:`gol_tpu.analysis`) over the engine×mesh matrix and exits non-zero
+on any violation — see ``docs/ANALYSIS.md``.
+
 ``threadsPerBlock`` configured the CUDA launch (gol-main.c:52,
 gol-with-cuda.cu:272-275); XLA owns tiling here, so the value is validated
 (fixing bug B5's silent 0-block no-op) and forwarded as the Pallas tile-size
@@ -116,6 +121,12 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "verify":
+        # Static verification pass (gol_tpu.analysis): prove engine
+        # invariants from traced programs before anything runs on a pod.
+        from gol_tpu.analysis.__main__ import main as verify_main
+
+        return verify_main(argv[1:])
     ns = parse_args(argv)
     if ns is None:
         return 255  # exit(-1) in the reference (gol-main.c:46)

@@ -1,0 +1,76 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern public surface (``jax.shard_map``); older
+jaxlibs in the image expose the same function as
+``jax.experimental.shard_map.shard_map`` with an identical keyword
+signature (``f, mesh, in_specs, out_specs``).  Every engine imports the
+symbol from here so a version bump is a one-line change and no engine can
+drift onto a private path.
+"""
+
+from __future__ import annotations
+
+import jax
+
+def set_cpu_device_count(n: int):
+    """Request an ``n``-device virtual CPU backend.
+
+    Must run before the backend initializes (or between
+    ``clear_backends`` calls).  Modern jax has the ``jax_num_cpu_devices``
+    config; older jaxlibs only honor the
+    ``--xla_force_host_platform_device_count`` XLA flag, which is read at
+    backend init — so the fallback rewrites ``XLA_FLAGS``.  Returns a
+    zero-arg callable restoring the previous setting (pair it with a
+    backend rebuild, as ``__graft_entry__`` does).
+    """
+    try:
+        prev = jax.config.jax_num_cpu_devices
+        jax.config.update("jax_num_cpu_devices", n)
+        return lambda: jax.config.update("jax_num_cpu_devices", prev)
+    except AttributeError:
+        import os
+        import re
+
+        prev_flags = os.environ.get("XLA_FLAGS")
+        stripped = re.sub(
+            r"--xla_force_host_platform_device_count=\S+", "", prev_flags or ""
+        )
+        os.environ["XLA_FLAGS"] = (
+            stripped + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+        def restore():
+            if prev_flags is None:
+                os.environ.pop("XLA_FLAGS", None)
+            else:
+                os.environ["XLA_FLAGS"] = prev_flags
+
+        return restore
+
+
+def enable_cpu_cross_process_collectives() -> None:
+    """Let the CPU backend run cross-process collectives (via gloo).
+
+    On jaxlibs where the CPU client defaults to single-process-only,
+    ``jax_cpu_collectives_implementation`` selects the gloo transport;
+    must be set before ``jax.distributed.initialize``.  A no-op where the
+    option is gone (newer jax enables CPU collectives by default) or the
+    backend is not CPU-bound at init time.
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax < 0.5: the pre-graduation home of the same API
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f=None, /, **kwargs):
+        # The modern surface renamed check_rep -> check_vma; translate so
+        # engines can be written against the current keyword only.
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from gol_tpu import compat
+
 
 def ring(n: int, shift: int):
     """Permutation delivering each shard the slice from its ring ±1 neighbor.
@@ -167,5 +169,5 @@ def build_ring_engine(
     local = blocked_local_loop(
         step, phases, steps, halo_depth, pack=pack, unpack=unpack
     )
-    shmapped = jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+    shmapped = compat.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
     return jax.jit(shmapped, donate_argnums=0)

@@ -107,6 +107,10 @@ def init_multihost(
             f"num_processes={num_processes!r}, process_id={process_id!r}, "
             f"local_device_ids={local_device_ids!r}"
         )
+    if num_processes and num_processes > 1:
+        from gol_tpu import compat
+
+        compat.enable_cpu_cross_process_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gol_tpu import compat
 from gol_tpu.ops import bitlife
 from gol_tpu.parallel.halo import build_ring_engine, ring
 from gol_tpu.parallel.mesh import COLS, ROWS, validate_geometry
@@ -133,7 +134,7 @@ def compiled_evolve_packed_overlap(mesh: Mesh, steps: int):
         packed = lax.fori_loop(0, steps, body, packed)
         return bitlife.unpack(packed)
 
-    shmapped = jax.shard_map(
+    shmapped = compat.shard_map(
         local, mesh=mesh, in_specs=P(ROWS, None), out_specs=P(ROWS, None)
     )
     return jax.jit(shmapped, donate_argnums=0)
@@ -707,7 +708,7 @@ def compiled_evolve_packed_pallas(
     # check_vma=False: pallas_call's out ShapeDtypeStruct carries no
     # varying-mesh-axes annotation, and the kernel is already per-shard.
     spec = P(ROWS, COLS) if two_d else P(ROWS, None)
-    shmapped = jax.shard_map(
+    shmapped = compat.shard_map(
         local,
         mesh=mesh,
         in_specs=spec,

@@ -45,10 +45,10 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gol_tpu import compat
 from gol_tpu.ops import stencil
 from gol_tpu.parallel.halo import halo_extend, ring
 from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding, validate_geometry
@@ -167,7 +167,7 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str, halo_depth: int = 1):
                 b = chunk(b, rem)
             return b
 
-    local = jax.shard_map(
+    local = compat.shard_map(
         local_loop,
         mesh=mesh,
         in_specs=spec,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from gol_tpu import compat
 from gol_tpu.ops.life3d import BAYS_4555, Rule3D, step3d_halo_full
 from gol_tpu.parallel.halo import blocked_local_loop, halo_extend
 from gol_tpu.parallel.mesh import COLS, PLANES, ROWS, place_private
@@ -64,7 +65,7 @@ def compiled_evolve3d(mesh: Mesh, steps: int, rule: Rule3D):
         return step3d_halo_full(halo_extend(vol, phases), rule)
 
     spec = P(PLANES, ROWS, COLS)
-    local = jax.shard_map(
+    local = compat.shard_map(
         lambda v: lax.fori_loop(0, steps, body, v),
         mesh=mesh,
         in_specs=spec,
@@ -124,7 +125,7 @@ def compiled_evolve3d_packed(
         unpack=bitlife3d.unpack3d,
     )
     spec = P(PLANES, ROWS, COLS)
-    local_sharded = jax.shard_map(
+    local_sharded = compat.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec
     )
     return jax.jit(local_sharded, donate_argnums=0)
@@ -412,7 +413,7 @@ def compiled_evolve3d_pallas(
     spec = P(PLANES, ROWS, COLS)
     # check_vma=False: pallas_call's out ShapeDtypeStruct carries no
     # varying-mesh-axes annotation (same note as the 2-D flagship).
-    local_sharded = jax.shard_map(
+    local_sharded = compat.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )
     return jax.jit(local_sharded, donate_argnums=0)

@@ -34,6 +34,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gol_tpu import compat
 from gol_tpu.ops import stencil
 from gol_tpu.parallel import sharded
 from gol_tpu.parallel.mesh import COLS, PLANES, ROWS, board_sharding
@@ -83,7 +84,7 @@ def _exchange_only(mesh: Mesh, steps: int):
 
         spec = P(ROWS, None)
 
-    local = jax.shard_map(
+    local = compat.shard_map(
         lambda b: lax.fori_loop(0, steps, body, b),
         mesh=mesh,
         in_specs=spec,
@@ -297,7 +298,7 @@ def _exchange_only_3d(mesh: Mesh, steps: int):
         return bitlife3d.unpack3d(p3)
 
     spec = P(PLANES, ROWS, COLS)
-    local_sharded = jax.shard_map(
+    local_sharded = compat.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec
     )
     return jax.jit(local_sharded)

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The verify gate: everything a builder or reviewer must see green
+# before trusting a change, in dependency order —
+#
+#   1. lint            (scripts/lint.sh: ruff, or compile-only fallback)
+#   2. static verifier (python -m gol_tpu.analysis: engine invariants
+#                       proven from traced programs, CPU-only)
+#   3. tier-1 tests    (the exact ROADMAP.md command)
+#
+# Any stage failing fails the gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== [1/3] lint =="
+bash scripts/lint.sh
+
+echo "== [2/3] static verifier (gol_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m gol_tpu.analysis
+
+echo "== [3/3] tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

@@ -1,0 +1,404 @@
+"""gol_tpu.analysis: each lint must fire on a seeded-broken engine.
+
+A verifier that has never caught a bug is a verifier that does not work
+(the same doctrine as the guard's fault-injection hook).  Every check
+gets a deliberately-broken fixture program carrying exactly the bug
+class it pins — a shallow halo band, a wrong-neighbor ring, a float
+upcast, a host callback in the loop, dropped donation, unmodeled extra
+work, a builder that retraces per chunk — plus the all-green integration
+pass over the full engine×mesh matrix.  All CPU-only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gol_tpu import compat
+from gol_tpu.analysis import checks, configs, walker
+from gol_tpu.analysis.report import AnalysisReport, EngineReport, CheckResult, Finding
+from gol_tpu.ops import stencil
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel.halo import halo_extend
+
+MESH_N = 4
+
+
+def _mesh():
+    return mesh_mod.make_mesh_1d(MESH_N)
+
+
+def _cfg(**kw):
+    defaults = dict(name="fixture", engine="dense", mesh="1d", size=64)
+    defaults.update(kw)
+    return configs.EngineConfig(**defaults)
+
+
+def _sharded_spec(mesh, h=64, w=64):
+    return jax.ShapeDtypeStruct(
+        (h, w), jnp.uint8, sharding=mesh_mod.board_sharding(mesh)
+    )
+
+
+# -- comm --------------------------------------------------------------------
+
+
+def _ring_program(body):
+    """jit(shard_map(body)) over the 4-device row ring."""
+    fn = compat.shard_map(
+        body, mesh=_mesh(), in_specs=P("rows", None), out_specs=P("rows", None)
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
+def test_comm_flags_shallow_halo_band():
+    """An engine shipping a (k-1)-deep band for k-generation chunks."""
+    k = 4
+
+    def local(blk):  # ships k-1, config claims k: the blocking contract bug
+        def chunk(b):
+            ext = halo_extend(b, ((0, "rows", MESH_N),), depth=k - 1)
+            for _ in range(k - 1):
+                ext = stencil.step_halo_rows(ext[1:-1], ext[0], ext[-1])
+            return ext
+
+        return lax.fori_loop(0, 2, lambda _, b: chunk(b), blk)
+
+    jaxpr = walker.trace_jaxpr(_ring_program(local), _sharded_spec(_mesh()))
+    result = checks.check_comm(jaxpr, _cfg(halo_depth=k), _mesh())
+    assert result.status == "FAIL"
+    assert any("exchanged halo depth" in f.message for f in result.errors)
+
+
+def test_comm_flags_non_ring_permutation():
+    """Halos from the wrong neighbor (a ±2 'ring') must be caught."""
+
+    def local(blk):
+        def body(_, b):
+            perm = [(i, (i + 2) % MESH_N) for i in range(MESH_N)]
+            top = lax.ppermute(b[-1:], "rows", perm)
+            bottom = lax.ppermute(b[:1], "rows", perm)
+            return stencil.step_halo_rows(b, top[0], bottom[0])
+
+        return lax.fori_loop(0, 3, body, blk)
+
+    jaxpr = walker.trace_jaxpr(_ring_program(local), _sharded_spec(_mesh()))
+    result = checks.check_comm(jaxpr, _cfg(), _mesh())
+    assert result.status == "FAIL"
+    assert any("not a ±1 ring" in f.message for f in result.errors)
+
+
+def test_comm_flags_missing_exchange():
+    """A sharded 'engine' with no exchange at all is bug B1 forever."""
+
+    def local(blk):
+        return lax.fori_loop(0, 3, lambda _, b: stencil.step(b), blk)
+
+    jaxpr = walker.trace_jaxpr(_ring_program(local), _sharded_spec(_mesh()))
+    result = checks.check_comm(jaxpr, _cfg(), _mesh())
+    assert result.status == "FAIL"
+    assert any("no ppermute" in f.message for f in result.errors)
+
+
+def test_comm_flags_collective_in_single_device_program():
+    """A stray collective in a mesh-none program is a config/dispatch bug."""
+    fn = compat.shard_map(
+        lambda b: lax.ppermute(b, "rows", [(0, 0)]),
+        mesh=mesh_mod.make_mesh_1d(1),
+        in_specs=P(None, None),
+        out_specs=P(None, None),
+        check_vma=False,  # keep the trivial ppermute unrewritten
+    )
+    jaxpr = walker.trace_jaxpr(
+        jax.jit(fn), jax.ShapeDtypeStruct((16, 16), jnp.uint8)
+    )
+    result = checks.check_comm(jaxpr, _cfg(mesh="none"), None)
+    assert result.status == "FAIL"
+    assert any("contains collectives" in f.message for f in result.errors)
+
+    clean = walker.trace_jaxpr(
+        jax.jit(lambda b: stencil.step(b)),
+        jax.ShapeDtypeStruct((16, 16), jnp.uint8),
+    )
+    assert checks.check_comm(clean, _cfg(mesh="none"), None).status == "PASS"
+
+
+def test_comm_passes_correct_ring_engine():
+    from gol_tpu.parallel import sharded
+
+    mesh = _mesh()
+    jaxpr = walker.trace_jaxpr(
+        sharded.compiled_evolve(mesh, 8, "explicit", 4), _sharded_spec(mesh)
+    )
+    result = checks.check_comm(jaxpr, _cfg(halo_depth=4), mesh)
+    assert result.status == "PASS"
+
+
+# -- dtype -------------------------------------------------------------------
+
+
+def test_dtype_flags_float_upcast_in_loop():
+    @jax.jit
+    def leaky(board):
+        def body(_, b):
+            # The classic accidental upcast: mean-field math in f32.
+            blurred = b.astype(jnp.float32) * 0.5
+            return (blurred > 0.2).astype(jnp.uint8)
+
+        return lax.fori_loop(0, 4, body, board)
+
+    jaxpr = walker.trace_jaxpr(
+        leaky, jax.ShapeDtypeStruct((16, 16), jnp.uint8)
+    )
+    result = checks.check_dtype(jaxpr, _cfg(mesh="none"))
+    assert result.status == "FAIL"
+    assert any("float leak" in f.message for f in result.errors)
+
+
+def test_dtype_flags_packed_tier_alien_dtype():
+    @jax.jit
+    def widens(words):
+        return lax.fori_loop(
+            0, 2, lambda _, w: (w.astype(jnp.int16) + 1).astype(jnp.uint32), words
+        )
+
+    jaxpr = walker.trace_jaxpr(
+        widens, jax.ShapeDtypeStruct((8, 4), jnp.uint32)
+    )
+    result = checks.check_dtype(jaxpr, _cfg(mesh="none", engine="bitpack"))
+    assert result.status == "FAIL"
+    assert any("packed-tier dtype leak" in f.message for f in result.errors)
+
+
+def test_dtype_passes_real_packed_engine():
+    from gol_tpu.ops import bitlife
+
+    jaxpr = walker.trace_jaxpr(
+        bitlife.evolve_dense_io, jax.ShapeDtypeStruct((16, 32), jnp.uint8), 3
+    )
+    assert checks.check_dtype(
+        jaxpr, _cfg(mesh="none", engine="bitpack")
+    ).status == "PASS"
+
+
+# -- purity ------------------------------------------------------------------
+
+
+def test_purity_flags_callback_in_generation_loop():
+    @jax.jit
+    def chatty(board):
+        def body(_, b):
+            jax.debug.callback(lambda x: None, b[0, 0])
+            return stencil.step(b)
+
+        return lax.fori_loop(0, 3, body, board)
+
+    jaxpr = walker.trace_jaxpr(
+        chatty, jax.ShapeDtypeStruct((16, 16), jnp.uint8)
+    )
+    result = checks.check_purity(jaxpr, _cfg(mesh="none"))
+    assert result.status == "FAIL"
+    assert any(
+        "debug_callback" in f.message and "loop" in f.message
+        for f in result.errors
+    )
+
+
+def test_purity_flags_pure_callback():
+    @jax.jit
+    def hosty(board):
+        return jax.pure_callback(
+            lambda x: x, jax.ShapeDtypeStruct(board.shape, board.dtype), board
+        )
+
+    jaxpr = walker.trace_jaxpr(
+        hosty, jax.ShapeDtypeStruct((8, 8), jnp.uint8)
+    )
+    result = checks.check_purity(jaxpr, _cfg(mesh="none"))
+    assert result.status == "FAIL"
+
+
+# -- donation ----------------------------------------------------------------
+
+
+def test_donation_flags_missing_alias():
+    fn = jax.jit(lambda b: lax.fori_loop(0, 4, lambda _, x: stencil.step(x), b))
+    compiled = fn.lower(jax.ShapeDtypeStruct((32, 32), jnp.uint8)).compile()
+    result = checks.check_donation(compiled, _cfg(mesh="none"), 32 * 32)
+    assert result.status == "FAIL"
+    assert any("aliased" in f.message or "aliasing" in f.message
+               for f in result.errors)
+
+
+def test_donation_passes_donated_engine():
+    from gol_tpu.parallel import engine as engine_mod
+
+    compiled = engine_mod.evolve_fresh.lower(
+        jax.ShapeDtypeStruct((32, 32), jnp.uint8), 4
+    ).compile()
+    assert checks.check_donation(
+        compiled, _cfg(mesh="none"), 32 * 32
+    ).status == "PASS"
+
+
+# -- cost --------------------------------------------------------------------
+
+
+def test_cost_flags_unmodeled_extra_work():
+    """Triple-stepping per generation must blow the 2× drift gate."""
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def wasteful(board):
+        def body(_, b):
+            for _ in range(3):  # does 3 generations of work, reports 1
+                b = stencil.step(b)
+            return b
+
+        return lax.fori_loop(0, 4, body, board)
+
+    compiled = wasteful.lower(
+        jax.ShapeDtypeStruct((64, 64), jnp.uint8)
+    ).compile()
+    cfg = _cfg(mesh="none", cost_gate=True, schedule=(4,))
+    result = checks.check_cost(compiled, cfg, None, 1)
+    assert result.status == "FAIL"
+    assert any("drift exceeds" in f.message for f in result.errors)
+
+
+def test_cost_passes_real_dense_engine():
+    from gol_tpu.parallel import engine as engine_mod
+
+    compiled = engine_mod.evolve_fresh.lower(
+        jax.ShapeDtypeStruct((64, 64), jnp.uint8), 8
+    ).compile()
+    cfg = _cfg(mesh="none", cost_gate=True, schedule=(8,))
+    assert checks.check_cost(compiled, cfg, None, 1).status == "PASS"
+
+
+def test_xla_flops_model_matches_measured_dense():
+    """The roofline XLA model is exact for the depth-1 dense engine."""
+    from gol_tpu.utils import roofline
+    from gol_tpu.parallel import engine as engine_mod
+
+    compiled = engine_mod.evolve_fresh.lower(
+        jax.ShapeDtypeStruct((64, 64), jnp.uint8), 8
+    ).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    model = roofline.xla_flops_model("dense", 64 * 64, 8, 1)
+    assert ca["flops"] == pytest.approx(model, rel=0.05)
+
+
+# -- retrace -----------------------------------------------------------------
+
+
+class _RetracingRuntime:
+    """A broken 'runtime' whose builder retraces for every chunk."""
+
+    def _evolve_fn(self, steps):
+        # BUG: fresh closure per call — defeats the AOT compile cache.
+        fn = jax.jit(
+            lambda b: lax.fori_loop(
+                0, steps, lambda _, x: stencil.step(x), b
+            )
+        )
+        return fn, (), ()
+
+
+def test_retrace_flags_uncached_builder():
+    cfg = _cfg(mesh="none", schedule=(8, 8, 4))
+    result = checks.check_retrace(
+        _RetracingRuntime(), cfg, make_board=None, execute=False
+    )
+    assert result.status == "FAIL"
+    assert any("retrace and recompile" in f.message for f in result.errors)
+
+
+def test_retrace_passes_real_runtime():
+    cfg = _cfg(mesh="none", engine="dense", schedule=(6, 6, 3))
+    rt = cfg.build_runtime()
+
+    def make_board():
+        return jnp.zeros((64, 64), jnp.uint8)
+
+    result = checks.check_retrace(rt, cfg, make_board, execute=True)
+    assert result.status == "PASS"
+
+
+# -- report / exit-code contract --------------------------------------------
+
+
+def test_report_exit_code_nonzero_on_any_violation():
+    report = AnalysisReport()
+    report.engines.append(
+        EngineReport(
+            config_name="x",
+            checks=[
+                CheckResult.from_findings(
+                    "comm", [Finding("error", "comm", "boom")]
+                )
+            ],
+        )
+    )
+    assert report.exit_code == 1
+    assert "FAIL" in report.render_text()
+    assert '"ok": false' in report.to_json()
+
+
+def test_report_exit_code_zero_when_clean():
+    report = AnalysisReport()
+    report.engines.append(
+        EngineReport(
+            config_name="x",
+            checks=[CheckResult.from_findings("comm", [])],
+        )
+    )
+    assert report.exit_code == 0
+
+
+# -- integration: the full matrix --------------------------------------------
+
+
+def test_full_matrix_verifies_clean():
+    """The all-engines × all-mesh-modes pass: every invariant holds."""
+    report = AnalysisReport()
+    for cfg in configs.default_matrix():
+        report.engines.append(checks.run_config(cfg))
+    failing = [e.config_name for e in report.engines if not e.ok]
+    assert not failing, f"verifier flagged: {failing}\n{report.render_text()}"
+    assert report.exit_code == 0
+    # The matrix genuinely covers all four engines in mesh modes none+1d.
+    covered = {(c.engine, c.mesh) for c in configs.default_matrix()}
+    for engine in ("dense", "bitpack", "pallas", "pallas_bitpack"):
+        for mesh in ("none", "1d"):
+            assert (engine, mesh) in covered or (
+                engine in ("pallas",) and mesh == "1d"
+            )
+
+
+def test_matrix_covers_every_engine_and_mode():
+    covered = {(c.engine, c.mesh) for c in configs.default_matrix()}
+    for engine in ("dense", "bitpack", "pallas", "pallas_bitpack"):
+        assert (engine, "none") in covered
+        assert (engine, "1d") in covered  # incl. the must-reject entries
+
+
+def test_cli_verify_subcommand():
+    from gol_tpu import cli
+
+    rc = cli.main(["verify", "--engine", "dense", "--mesh", "none"])
+    assert rc == 0
+
+
+def test_cli_verify_list():
+    from gol_tpu import cli
+
+    rc = cli.main(["verify", "--list"])
+    assert rc == 0

@@ -142,6 +142,7 @@ def test_sharded3d_pallas_matches_oracle(shape, steps):
     np.testing.assert_array_equal(got, _ref3(vol, steps))
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_sharded3d_pallas_roll_dispatch_and_wt_fallback(monkeypatch):
     """r4: the sharded engine dispatches between the rolling-plane and
     word-tiled ext kernels by recompute score.  On x-unsharded meshes the
@@ -191,6 +192,7 @@ def test_sharded3d_pallas_roll_dispatch_and_wt_fallback(monkeypatch):
     sharded3d.compiled_evolve3d_pallas.cache_clear()
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_sharded3d_pallas_ghosted_roll_dispatch(monkeypatch):
     """r4: on x-SHARDED meshes with wide shards (nw > wt's 16-word tile
     cap) the ghost-word rolling kernel outscores wt ((nw+2)/nw vs

@@ -6,7 +6,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 from gol_tpu import cli
 from gol_tpu.utils import io as gol_io

@@ -266,7 +266,6 @@ def test_mesh_shape_validation(capsys):
 def test_sharded_checkpoint_and_resume_byte_exact(tmp_path, capsys):
     """Mesh run writes the sharded piece-file format (no monolithic npz,
     no host gather); resume from it == straight run, byte-exact."""
-    import os
 
     common = ["2", "64", "10", "64", "1", "--mesh", "3d", "--mesh-shape",
               "2,1,2", "--engine", "bitpack"]

@@ -2,10 +2,11 @@
 
 import os
 import subprocess
+
+import pytest
 import sys
 
 import jax
-import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -20,10 +21,12 @@ def test_entry_jits_and_runs():
     assert out.shape == args[0].shape and out.dtype == args[0].dtype
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_dryrun_multichip_8():
     __graft_entry__.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_dryrun_multichip_odd_counts():
     for n in (1, 2, 3, 6):
         __graft_entry__.dryrun_multichip(n)

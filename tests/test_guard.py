@@ -450,7 +450,6 @@ def test_checker_runtime_picks_a_different_engine():
 
 
 def test_cli_guard_redundant_flag(tmp_path, capsys, monkeypatch):
-    import os
 
     from gol_tpu import cli
 

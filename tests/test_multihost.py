@@ -144,7 +144,8 @@ _WORKER = textwrap.dedent(
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
     from gol_tpu import cli
     from gol_tpu.utils import checkpoint as ckpt_mod
     pid = sys.argv[1]
@@ -181,7 +182,8 @@ _WORKER_2D_GUARDED = textwrap.dedent(
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
     from gol_tpu import cli
     pid = sys.argv[1]
     rc = cli.main([
@@ -326,7 +328,8 @@ _WORKER_PALLAS = textwrap.dedent(
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
     from gol_tpu import cli
     pid = sys.argv[1]
     rc = cli.main([
@@ -351,7 +354,8 @@ _WORKER_KITCHEN_SINK = textwrap.dedent(
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
     from gol_tpu import cli
     from gol_tpu.utils import checkpoint as ckpt_mod
     pid = sys.argv[1]
@@ -428,7 +432,8 @@ _WORKER_3D = textwrap.dedent(
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
     from gol_tpu import cli3d
     from gol_tpu.utils import checkpoint as ckpt_mod
     pid = sys.argv[1]

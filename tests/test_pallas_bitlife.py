@@ -40,6 +40,7 @@ def test_tile_smaller_than_board():
 
 
 @pytest.mark.parametrize("k", [2, 5, 8, 16])
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_multi_step_matches_sequential(k):
     """Temporal blocking: k fused generations == k single-step launches."""
     from jax import lax

@@ -18,7 +18,14 @@ only pointwise.
 from __future__ import annotations
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property-based families need Hypothesis; the example-based "
+    "suite still pins each engine pointwise",
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 import jax
 import jax.numpy as jnp

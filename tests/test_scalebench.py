@@ -75,7 +75,8 @@ _WORKER_SCALEBENCH = """
 import sys
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+from gol_tpu import compat as _compat
+_compat.set_cpu_device_count(2)
 from gol_tpu.utils import scalebench
 scalebench.main([
     "32", "3", "dense",

@@ -248,6 +248,7 @@ def test_sharded_pallas_2d_matches_oracle(shape, width, steps):
 
 
 @pytest.mark.parametrize("halo_depth", [16, 32])
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_sharded_pallas_2d_deep_band(halo_depth):
     """Deeper temporal bands stay inside the 1-word column light cone."""
     from gol_tpu.parallel import packed
@@ -345,6 +346,7 @@ def test_sharded_pallas_overlap_2d_matches_oracle(shape, width):
     np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_sharded_pallas_overlap_deep_band():
     """k=16 band: boundary kernels span [-16, 32) with a 48-row shard."""
     from gol_tpu.parallel.sharded import place_private
@@ -464,6 +466,7 @@ def test_overlap_interior_kernel_independent_of_exchange():
     assert sorted(overlap) == [False, True, True]
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_runtime_sharded_pallas_overlap_end_to_end():
     from gol_tpu.models import patterns
     from gol_tpu.models.state import Geometry
@@ -631,6 +634,7 @@ def test_sharded_pallas_folded_1d_matches_oracle(steps):
 
 
 @pytest.mark.parametrize("halo_depth", [16, 32])
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_sharded_pallas_folded_deep_band_ext_fallback(halo_depth):
     """hg=8 < k: the folded ext fallback, with band slices spanning
     multiple fold groups (the k > hg case of folded_bands)."""
@@ -768,6 +772,7 @@ def test_sharded_pallas_folded_overlap_2d_matches_oracle(steps):
     np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
 
 
+@pytest.mark.slow  # minutes-scale interpret-mode sweep; run with -m slow
 def test_sharded_pallas_folded_overlap_deep_band():
     """k=16 band folded: boundary windows span 3k=48 folded rows."""
     board = oracle.random_board(512, 4096, seed=87)
