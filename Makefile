@@ -1,7 +1,8 @@
 # Entry points for builders and reviewers.  `make check` is the one
-# gate: lint + static verifier + tier-1 tests (see scripts/check.sh).
+# gate: lint + static verifier + telemetry smoke + tier-1 tests (see
+# scripts/check.sh).
 
-.PHONY: lint verify test check
+.PHONY: lint verify test check telemetry-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -13,6 +14,14 @@ test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 	    --continue-on-collection-errors -p no:cacheprovider \
 	    -p no:xdist -p no:randomly
+
+# Tiny run with --telemetry, then `summarize` must schema-validate the
+# stream and exit 0 (docs/OBSERVABILITY.md).
+telemetry-smoke:
+	@tdir=$$(mktemp -d); trap 'rm -rf "$$tdir"' EXIT; \
+	JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
+	    --telemetry "$$tdir" --run-id smoke > /dev/null && \
+	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$$tdir"
 
 check:
 	bash scripts/check.sh

@@ -49,11 +49,14 @@ def _cpu_json(args: list) -> dict:
     return payload
 
 
-def _cpu_json_2proc(args: list, devices_per_proc: int = 4) -> dict:
+def _cpu_json_2proc(
+    args: list, devices_per_proc: int = 4, timeout_per_worker: float = 900.0
+) -> dict:
     """Run a module across two real coordinator-connected OS processes
     (Gloo over localhost, 2×4 = 8 global CPU devices); process 0 prints
     the report."""
     import socket
+    import time
 
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -65,17 +68,22 @@ def _cpu_json_2proc(args: list, devices_per_proc: int = 4) -> dict:
         ),
     }
     trio = ["--coordinator", coord, "--num-processes", "2"]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-m", *args, *trio, "--process-id", str(i)],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            cwd=REPO,
+    procs = []
+    deadlines = []
+    for i in range(2):
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", *args, *trio, "--process-id", str(i)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=REPO,
+            )
         )
-        for i in range(2)
-    ]
+        # Each worker gets the full budget from its own start — not one
+        # shared window the slower worker's wait eats into.
+        deadlines.append(time.monotonic() + timeout_per_worker)
     # Drain both processes concurrently (a thread per pipe pair, so
     # neither can deadlock on a full pipe) and fail FAST on the first
     # nonzero exit: if one worker dies during coordinator startup the
@@ -92,15 +100,43 @@ def _cpu_json_2proc(args: list, devices_per_proc: int = 4) -> dict:
     with cf.ThreadPoolExecutor(max_workers=2) as ex:
         futs = {ex.submit(_drain, p): i for i, p in enumerate(procs)}
         try:
-            for fut in cf.as_completed(futs, timeout=900):
-                i = futs[fut]
-                rc, out, err = fut.result()
-                outs[i] = (rc, out, err)
-                if rc != 0:
+            pending = set(futs)
+            while pending:
+                now = time.monotonic()
+                expired = [
+                    futs[f] for f in pending if now >= deadlines[futs[f]]
+                ]
+                if expired:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    # The kills EOF the pipes, so every pending _drain
+                    # returns promptly; join them to recover what each
+                    # worker managed to say before dying — the drained
+                    # output IS the diagnostic, never discard it.
+                    drained = {i: f.result() for f, i in futs.items()}
                     raise RuntimeError(
-                        f"2-process worker {i} failed rc={rc}\n"
-                        f"stdout:{out}\nstderr:{err}"
+                        f"2-process worker(s) {expired} exceeded "
+                        f"{timeout_per_worker:.0f}s\n"
+                        + "\n".join(
+                            f"worker {i}: rc={rc}\nstdout:{out}\nstderr:{err}"
+                            for i, (rc, out, err) in sorted(drained.items())
+                        )
                     )
+                wait_s = min(deadlines[futs[f]] for f in pending) - now
+                done, pending = cf.wait(
+                    pending, timeout=max(wait_s, 0.0),
+                    return_when=cf.FIRST_COMPLETED,
+                )
+                for fut in done:
+                    i = futs[fut]
+                    rc, out, err = fut.result()
+                    outs[i] = (rc, out, err)
+                    if rc != 0:
+                        raise RuntimeError(
+                            f"2-process worker {i} failed rc={rc}\n"
+                            f"stdout:{out}\nstderr:{err}"
+                        )
         finally:
             # Killing the survivors EOFs their pipes, so the remaining
             # _drain threads (and the executor shutdown) return promptly.

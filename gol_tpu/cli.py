@@ -84,6 +84,12 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--rule", default=None, metavar="B<d>/S<d>")
     ext.add_argument("--outdir", default=".")
     ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
+    # Structured JSONL telemetry (docs/OBSERVABILITY.md): per-process
+    # event stream in DIR, summarized/diffed by `python -m
+    # gol_tpu.telemetry`.  Multi-host jobs should pass an explicit
+    # --run-id so every rank's file shares one prefix.
+    ext.add_argument("--telemetry", default=None, metavar="DIR")
+    ext.add_argument("--run-id", default=None, metavar="NAME")
     ext.add_argument("--compat-banner", action="store_true")
     ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
     ext.add_argument("--checkpoint-dir", default=None)
@@ -223,6 +229,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             shard_mode=ns.shard_mode,
             halo_depth=ns.halo_depth,
             rule=ns.rule,
+            telemetry_dir=ns.telemetry,
+            run_id=ns.run_id,
         )
         guard_report = None
         if ns.guard_every > 0:

@@ -247,6 +247,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     # jax.profiler trace of the steady-state loop (2-D driver parity).
     ext.add_argument("--profile", default=None, metavar="TRACE_DIR")
+    # Structured JSONL telemetry, same surface and schema as the 2-D
+    # driver (docs/OBSERVABILITY.md).
+    ext.add_argument("--telemetry", default=None, metavar="DIR")
+    ext.add_argument("--run-id", default=None, metavar="NAME")
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -275,6 +279,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     guard_report = None
     ckpt_writer = None
+    events = None
     try:
         if size <= 0:
             raise ValueError(f"size must be positive, got {size}")
@@ -400,6 +405,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # redundant checker's counterpart engine.
         resolved = _resolve_engine3d(ns.engine, mesh, size)
 
+        from gol_tpu import telemetry as telemetry_mod
+
+        num_devices = 1 if mesh is None else mesh.devices.size
+        shard_cells = size**3 // max(num_devices, 1)
+        if ns.telemetry:
+            events = telemetry_mod.EventLog(ns.telemetry, run_id=ns.run_id)
+            events.run_header(
+                dict(
+                    driver="3d",
+                    engine=ns.engine,
+                    resolved_engine=resolved,
+                    mesh=None if mesh is None else dict(mesh.shape),
+                    rule=rulestr,
+                    size=size,
+                    checkpoint_every=ns.checkpoint_every,
+                )
+            )
+
+        def util3d(take, wall_s):
+            return telemetry_mod.roofline_utilization_3d(
+                resolved, shard_cells, take, wall_s
+            )
+
         # Async writer for the single-device path (same overlap +
         # final-flush contract as GolRuntime.run; the sharded save ends
         # in a device barrier and must stay on the main thread).  The
@@ -461,10 +489,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
             schedule = chunk_schedule(iterations, interval)
             with sw.phase("compile"):
-                evolvers = {
-                    take: _build_evolver(ns.engine, mesh, take, rule, size)
-                    for take in set(schedule)
-                }
+                import time as time_mod
+
+                evolvers = {}
+                for take in set(schedule):
+                    t0 = time_mod.perf_counter()
+                    evolvers[take] = _build_evolver(
+                        ns.engine, mesh, take, rule, size
+                    )
+                    if events is not None:
+                        # _build_evolver lowers + compiles in one step;
+                        # the record carries the combined duration.
+                        events.compile_event(
+                            take, 0.0, time_mod.perf_counter() - t0
+                        )
                 place = evolvers[schedule[0]][1]
                 board = placed if placed is not None else place(vol)
                 force_ready(board)
@@ -509,20 +547,48 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     ),
                     save_snapshot=save_snapshot,
                     checkpoint_every=ns.checkpoint_every,
+                    events=events,
+                    chunk_utilization=util3d,
+                    checkpoint_overlapped=ckpt_writer is not None,
                 )
             else:
                 from gol_tpu.utils.timing import maybe_profile
 
-                with maybe_profile(ns.profile):
-                    for take in schedule:
+                with maybe_profile(ns.profile), telemetry_mod.trace_annotation(
+                    "gol3d.run.evolve"
+                ):
+                    for i, take in enumerate(schedule):
                         compiled, _ = evolvers[take]
-                        with sw.phase("total"):
-                            board = compiled(board)
-                            force_ready(board)
+                        with telemetry_mod.step_annotation("gol.chunk", i):
+                            with sw.phase("total"):
+                                t0 = time_mod.perf_counter()
+                                board = compiled(board)
+                                force_ready(board)
+                                dt = time_mod.perf_counter() - t0
                         generation += take
+                        if events is not None:
+                            events.chunk_event(
+                                i,
+                                take,
+                                generation,
+                                dt,
+                                size**3 * take,
+                                util3d(take, dt),
+                            )
                         if ns.checkpoint_every > 0:
-                            with sw.phase("checkpoint"):
+                            with telemetry_mod.trace_annotation(
+                                "gol.checkpoint.save"
+                            ), sw.phase("checkpoint"):
+                                t0 = time_mod.perf_counter()
                                 save_snapshot(board, generation)
+                                dt = time_mod.perf_counter() - t0
+                            if events is not None:
+                                events.checkpoint_event(
+                                    generation,
+                                    dt,
+                                    size**3,
+                                    overlapped=ckpt_writer is not None,
+                                )
             if ckpt_writer is not None:
                 # Completion fence only; main's finally owns the close.
                 with sw.phase("checkpoint"):
@@ -552,6 +618,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
         else:
             population = int(np.asarray(out).sum())
+        report = sw.report(size**3 * iterations)
+        if events is not None:
+            events.summary(report)
     except (ValueError, OSError) as e:
         # Same surface as the 2-D driver (gol_tpu/cli.py): bad --resume
         # paths, corrupt snapshots, unavailable engines, unwritable dirs
@@ -564,8 +633,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             # (e.g. a guard restore-budget exhaustion — the exact case
             # mid-run snapshots exist for); close() never raises.
             ckpt_writer.close()
+        if events is not None:
+            # The rank file keeps everything emitted before a failure —
+            # telemetry exists precisely for runs that die mid-loop.
+            events.close()
 
-    report = sw.report(size**3 * iterations)
     if topo.is_coordinator:
         print(report.duration_line())
         if guard_report is not None:

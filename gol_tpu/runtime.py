@@ -82,6 +82,12 @@ class GolRuntime:
     shard_mode: str = "explicit"  # shard_map+ppermute vs XLA auto-SPMD
     halo_depth: int = 1  # temporal blocking: ghost layers shipped per exchange
     rule: Optional[str] = None  # B/S rulestring; None = B3/S23 fast paths
+    # Structured telemetry (gol_tpu.telemetry): per-process JSONL event
+    # stream written to telemetry_dir/<run_id>.rank<k>.jsonl.  Host-side
+    # only — emission happens strictly after force_ready fences and never
+    # enters a compiled program (pinned by the trace-identity test).
+    telemetry_dir: Optional[str] = None
+    run_id: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -677,7 +683,7 @@ class GolRuntime:
         """Full chunks of ``chunk`` generations plus one tail."""
         return chunk_schedule(iterations, chunk)
 
-    def compile_evolvers(self, board, schedule) -> dict:
+    def compile_evolvers(self, board, schedule, events=None) -> dict:
         """AOT-compile one evolver per distinct chunk size in ``schedule``.
 
         Lowers from a ShapeDtypeStruct (no execution, no throwaway board) so
@@ -686,7 +692,15 @@ class GolRuntime:
         where the full call is ``compiled(board, *dynamic)``.  Shared by
         :meth:`run` and the guarded loop (:func:`gol_tpu.utils.guard.
         run_guarded`), so engine dispatch can never diverge between them.
+        An :class:`~gol_tpu.telemetry.EventLog` in ``events`` receives one
+        ``compile`` record per distinct chunk size (lowering and compile
+        durations separately — on TPU the XLA compile dominates and is the
+        number worth tracking across rounds).
         """
+        import time as time_mod
+
+        from gol_tpu import telemetry as telemetry_mod
+
         if self.mesh is not None:
             spec = jax.ShapeDtypeStruct(
                 board.shape,
@@ -698,9 +712,60 @@ class GolRuntime:
         evolvers = {}
         for take in set(schedule):
             fn, dynamic, static = self._evolve_fn(take)
-            evolvers[take] = (fn.lower(spec, *dynamic, *static).compile(), dynamic)
+            with telemetry_mod.trace_annotation(f"gol.compile.{take}"):
+                t0 = time_mod.perf_counter()
+                lowered = fn.lower(spec, *dynamic, *static)
+                t1 = time_mod.perf_counter()
+                compiled = lowered.compile()
+                t2 = time_mod.perf_counter()
+            evolvers[take] = (compiled, dynamic)
+            if events is not None:
+                events.compile_event(take, t1 - t0, t2 - t1)
         force_ready(board)
         return evolvers
+
+    # -- telemetry ----------------------------------------------------------
+    def open_event_log(self):
+        """A fresh :class:`~gol_tpu.telemetry.EventLog` with the run header
+        emitted, or ``None`` when telemetry is off.  Callers own close()."""
+        if not self.telemetry_dir:
+            return None
+        from gol_tpu import telemetry as telemetry_mod
+
+        events = telemetry_mod.EventLog(self.telemetry_dir, run_id=self.run_id)
+        mesh_shape = None if self.mesh is None else dict(self.mesh.shape)
+        events.run_header(
+            dict(
+                driver="2d",
+                engine=self.engine,
+                resolved_engine=self._resolved,
+                mesh=mesh_shape,
+                shard_mode=self.shard_mode,
+                halo_mode=self.halo_mode,
+                halo_depth=self.halo_depth,
+                rule=self.rule,
+                height=self.geometry.global_height,
+                width=self.geometry.global_width,
+                num_ranks=self.geometry.num_ranks,
+                checkpoint_every=self.checkpoint_every,
+            )
+        )
+        return events
+
+    def chunk_utilization(self, take: int, wall_s: float):
+        """Roofline fraction of one executed chunk (see telemetry module)."""
+        from gol_tpu import telemetry as telemetry_mod
+
+        num_devices = 1 if self.mesh is None else self.mesh.devices.size
+        cells = self.geometry.global_height * self.geometry.global_width
+        return telemetry_mod.roofline_utilization(
+            self._resolved,
+            cells // max(num_devices, 1),
+            take,
+            self.halo_depth,
+            sharded=self.mesh is not None,
+            wall_s=wall_s,
+        )
 
     # -- main entry ---------------------------------------------------------
     def run(
@@ -710,6 +775,10 @@ class GolRuntime:
         resume: Optional[str] = None,
         profile_dir: Optional[str] = None,
     ) -> Tuple[RunReport, GolState]:
+        import time as time_mod
+
+        from gol_tpu import telemetry as telemetry_mod
+
         sw = Stopwatch()
         with sw.phase("init"):
             state = self.initial_state(pattern, resume)
@@ -724,35 +793,71 @@ class GolRuntime:
         if self.mesh is not None:
             board = mesh_mod.shard_board(board, self.mesh)
 
-        with sw.phase("compile"):
-            evolvers = self.compile_evolvers(board, schedule)
-
-        writer = None
-        if self.checkpoint_every > 0 and jax.process_count() == 1:
-            # Overlap snapshot writes with the next chunk's compute; the
-            # final flush (inside the checkpoint phase, so the report
-            # stays honest about I/O cost that did NOT overlap) fences
-            # run completion on every snapshot being durably renamed.
-            writer = ckpt_mod.AsyncSnapshotWriter()
-        self._ckpt_writer = writer
+        events = self.open_event_log()
         try:
-            with maybe_profile(profile_dir):
-                for take in schedule:
-                    compiled, dynamic = evolvers[take]
-                    with sw.phase("total"):
-                        board = compiled(board, *dynamic)
-                        force_ready(board)
-                    state = GolState.create(board, int(state.generation) + take)
-                    if self.checkpoint_every > 0:
-                        with sw.phase("checkpoint"):
-                            self._save_snapshot(state)
-            if writer is not None:
-                with sw.phase("checkpoint"):
-                    writer.flush()
-        finally:
-            self._ckpt_writer = None
-            if writer is not None:
-                writer.close()
+            with sw.phase("compile"):
+                evolvers = self.compile_evolvers(board, schedule, events)
 
-        report = sw.report(self.geometry.cell_updates(iterations))
+            writer = None
+            if self.checkpoint_every > 0 and jax.process_count() == 1:
+                # Overlap snapshot writes with the next chunk's compute;
+                # the final flush (inside the checkpoint phase, so the
+                # report stays honest about I/O cost that did NOT overlap)
+                # fences run completion on every snapshot being durably
+                # renamed.
+                writer = ckpt_mod.AsyncSnapshotWriter()
+            self._ckpt_writer = writer
+            try:
+                with maybe_profile(profile_dir), telemetry_mod.trace_annotation(
+                    "gol.run.evolve"
+                ):
+                    for i, take in enumerate(schedule):
+                        compiled, dynamic = evolvers[take]
+                        with telemetry_mod.step_annotation("gol.chunk", i):
+                            with sw.phase("total"):
+                                t0 = time_mod.perf_counter()
+                                board = compiled(board, *dynamic)
+                                force_ready(board)
+                                dt = time_mod.perf_counter() - t0
+                        state = GolState.create(
+                            board, int(state.generation) + take
+                        )
+                        if events is not None:
+                            events.chunk_event(
+                                i,
+                                take,
+                                int(state.generation),
+                                dt,
+                                self.geometry.cell_updates(take),
+                                self.chunk_utilization(take, dt),
+                            )
+                        if self.checkpoint_every > 0:
+                            with telemetry_mod.trace_annotation(
+                                "gol.checkpoint.save"
+                            ):
+                                with sw.phase("checkpoint"):
+                                    t0 = time_mod.perf_counter()
+                                    self._save_snapshot(state)
+                                    dt = time_mod.perf_counter() - t0
+                            if events is not None:
+                                events.checkpoint_event(
+                                    int(state.generation),
+                                    dt,
+                                    int(state.board.size),
+                                    overlapped=writer is not None,
+                                )
+                if writer is not None:
+                    with sw.phase("checkpoint"):
+                        writer.flush()
+            finally:
+                self._ckpt_writer = None
+                if writer is not None:
+                    writer.close()
+
+            report = sw.report(self.geometry.cell_updates(iterations))
+            if events is not None:
+                events.summary(report)
+        finally:
+            if events is not None:
+                events.close()
         return report, state

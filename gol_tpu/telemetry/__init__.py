@@ -1,0 +1,314 @@
+"""Structured run telemetry: a schema-versioned JSONL event stream.
+
+The reference's entire observability surface is one rank-0 wall-clock pair
+and a single printed line (gol-main.c:124-125); our own ``Stopwatch``/
+``RunReport`` still reduces a run to a handful of phase floats while the
+loops compute — and then discard — per-chunk device timings, guard audit
+scalars, checkpoint latencies, and compile times.  This package keeps all
+of it:
+
+- :class:`EventLog` appends schema-versioned JSONL records to
+  ``<dir>/<run_id>.rank<k>.jsonl``.  Every process writes only its own
+  file, so multi-host runs never gather (the same no-gather discipline as
+  the sharded checkpoint format).  Record types: ``run_header``,
+  ``compile``, ``chunk``, ``guard_audit``, ``checkpoint``, ``bench_row``,
+  ``summary`` — see ``REQUIRED_FIELDS`` for the schema.
+- :func:`roofline_utilization` stamps each chunk with how far the run sits
+  from the VPU roofline the repo already models
+  (:func:`gol_tpu.utils.roofline.xla_flops_model` per-chip FLOPs over the
+  ``V5E_VPU_LANE_OPS`` peak), so utilization cliffs are visible per chunk,
+  not per run.
+- :func:`step_annotation` / :func:`trace_annotation` wrap the host-side
+  loop bodies in ``jax.profiler`` annotations so ``--profile`` traces are
+  navigable (named chunks/audits/saves) instead of anonymous.
+- ``python -m gol_tpu.telemetry summarize <dir>`` merges rank files,
+  renders per-phase/per-chunk tables with the roofline column, and flags
+  anomalies; ``diff`` compares two runs (:mod:`gol_tpu.telemetry.
+  summarize`).
+
+Purity invariant: everything here is host-side Python running strictly
+outside compiled code, after the ``force_ready`` fences — emission can
+never change a traced program (pinned by the trace-identity test in
+``tests/test_telemetry.py``; the static verifier's purity check would
+catch any callback that leaked inside).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+SCHEMA_VERSION = 1
+
+# Required fields per event type (beyond the envelope's "event" and "t").
+# Extra fields are always allowed — the schema pins what consumers may
+# rely on, not everything a producer may add.
+REQUIRED_FIELDS: Dict[str, frozenset] = {
+    # One per rank file, first record: who ran what, where.
+    "run_header": frozenset(
+        {"schema", "run_id", "process_index", "process_count", "config"}
+    ),
+    # One per distinct chunk size: AOT lowering + compile durations.
+    "compile": frozenset({"chunk", "lower_s", "compile_s"}),
+    # One per executed chunk (including guard replays): the device wall
+    # time between force_ready fences, and the roofline fraction.
+    "chunk": frozenset(
+        {"index", "take", "generation", "wall_s", "updates_per_sec",
+         "roofline_util"}
+    ),
+    # One per guard audit: the detection scalars the recovery decision
+    # used (fingerprints compare across ranks and across runs).
+    "guard_audit": frozenset(
+        {"generation", "ok", "max_cell", "population", "fingerprint"}
+    ),
+    # One per snapshot: fenced (non-overlapped) seconds and payload size.
+    "checkpoint": frozenset(
+        {"generation", "wall_s", "bytes", "overlapped"}
+    ),
+    # One per bench-harness measurement row (halobench/scalebench).
+    "bench_row": frozenset({"bench", "data"}),
+    # One per run, last record: matches RunReport exactly.
+    "summary": frozenset(
+        {"duration_s", "cell_updates", "updates_per_sec", "phases"}
+    ),
+}
+
+
+class SchemaError(ValueError):
+    """A telemetry record violates the JSONL schema."""
+
+
+def validate_record(rec: dict) -> None:
+    """Raise :class:`SchemaError` unless ``rec`` is schema-valid.
+
+    Shared by the writer (:meth:`EventLog.emit` — an invalid record is a
+    bug at the emission site, not something to discover at read time) and
+    the ``summarize`` reader (whose input may come from anywhere).
+    """
+    if not isinstance(rec, dict):
+        raise SchemaError(f"record is {type(rec).__name__}, not an object")
+    event = rec.get("event")
+    if event not in REQUIRED_FIELDS:
+        raise SchemaError(
+            f"unknown event type {event!r}; expected one of "
+            f"{sorted(REQUIRED_FIELDS)}"
+        )
+    if not isinstance(rec.get("t"), (int, float)):
+        raise SchemaError(f"{event}: missing/non-numeric timestamp 't'")
+    missing = REQUIRED_FIELDS[event] - rec.keys()
+    if missing:
+        raise SchemaError(f"{event}: missing fields {sorted(missing)}")
+    if event == "run_header" and rec["schema"] != SCHEMA_VERSION:
+        raise SchemaError(
+            f"run_header: schema {rec['schema']!r} != supported "
+            f"{SCHEMA_VERSION}"
+        )
+
+
+def rank_file(directory: str, run_id: str, process_index: int) -> str:
+    return os.path.join(directory, f"{run_id}.rank{process_index}.jsonl")
+
+
+class EventLog:
+    """Per-process JSONL event writer.
+
+    ``run_id`` defaults to a wall-clock stamp — fine for single-process
+    runs; multi-host jobs should pass an explicit ``--run-id`` so every
+    rank's file shares one prefix (processes start at slightly different
+    times, and there is deliberately no cross-host coordination here).
+    Lines are flushed per record so a killed run keeps everything emitted
+    up to the failure — telemetry exists precisely for runs that die.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        run_id: Optional[str] = None,
+        process_index: Optional[int] = None,
+    ) -> None:
+        import jax
+
+        self.directory = directory
+        self.run_id = run_id or time.strftime("run-%Y%m%dT%H%M%S")
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index
+        )
+        os.makedirs(directory, exist_ok=True)
+        self.path = rank_file(directory, self.run_id, self.process_index)
+        self._f = open(self.path, "w")
+
+    # -- envelope -----------------------------------------------------------
+    def emit(self, event: str, **fields) -> None:
+        rec = {"event": event, "t": time.time(), **fields}
+        validate_record(rec)
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- typed convenience emitters ----------------------------------------
+    def run_header(self, config: dict) -> None:
+        import jax
+
+        self.emit(
+            "run_header",
+            schema=SCHEMA_VERSION,
+            run_id=self.run_id,
+            process_index=self.process_index,
+            process_count=jax.process_count(),
+            jax_version=jax.__version__,
+            backend=jax.default_backend(),
+            device_count=len(jax.devices()),
+            config=config,
+        )
+
+    def compile_event(
+        self, chunk: int, lower_s: float, compile_s: float
+    ) -> None:
+        self.emit(
+            "compile", chunk=chunk, lower_s=lower_s, compile_s=compile_s
+        )
+
+    def chunk_event(
+        self,
+        index: int,
+        take: int,
+        generation: int,
+        wall_s: float,
+        updates: int,
+        roofline_util: Optional[float],
+        **extra,
+    ) -> None:
+        self.emit(
+            "chunk",
+            index=index,
+            take=take,
+            generation=generation,
+            wall_s=wall_s,
+            updates_per_sec=(updates / wall_s) if wall_s > 0 else 0.0,
+            roofline_util=roofline_util,
+            **extra,
+        )
+
+    def guard_event(self, audit) -> None:
+        """One :class:`gol_tpu.utils.guard.Audit`'s scalars."""
+        self.emit(
+            "guard_audit",
+            generation=audit.generation,
+            ok=audit.ok,
+            max_cell=audit.max_cell,
+            population=audit.population,
+            fingerprint=audit.fingerprint,
+            redundant_fingerprint=audit.redundant_fingerprint,
+        )
+
+    def checkpoint_event(
+        self,
+        generation: int,
+        wall_s: float,
+        nbytes: int,
+        overlapped: bool,
+        **extra,
+    ) -> None:
+        self.emit(
+            "checkpoint",
+            generation=generation,
+            wall_s=wall_s,
+            bytes=nbytes,
+            overlapped=overlapped,
+            **extra,
+        )
+
+    def bench_row(self, bench: str, data: dict) -> None:
+        self.emit("bench_row", bench=bench, data=data)
+
+    def summary(self, report) -> None:
+        """The final record, mirroring :class:`~gol_tpu.utils.timing.
+        RunReport` field-for-field so the JSONL stream is a superset of
+        the printed report."""
+        self.emit(
+            "summary",
+            duration_s=report.duration_s,
+            cell_updates=report.cell_updates,
+            updates_per_sec=report.updates_per_sec,
+            phases=dict(report.phases),
+        )
+
+
+def roofline_utilization(
+    engine: str,
+    shard_cells: int,
+    take: int,
+    halo_depth: int,
+    sharded: bool,
+    wall_s: float,
+) -> Optional[float]:
+    """Per-chip roofline fraction of one executed chunk.
+
+    ``xla_flops_model`` predicts one shard's compiled FLOPs for the chunk
+    (lane-ops for the packed tiers); dividing by the chunk's wall seconds
+    gives a per-chip op rate, and the fraction is that rate over the
+    ``V5E_VPU_LANE_OPS`` peak.  An *estimate with the model's own ±
+    caveats* (see the roofline module docstring) meant to expose
+    utilization cliffs between chunks/configs — off-TPU backends report
+    tiny fractions, which is itself the honest answer.
+    """
+    from gol_tpu.utils import roofline
+
+    if wall_s <= 0:
+        return None
+    flops = roofline.xla_flops_model(
+        engine, shard_cells, take, halo_depth, sharded=sharded
+    )
+    return (flops / wall_s) / roofline.V5E_VPU_LANE_OPS
+
+
+def roofline_utilization_3d(
+    engine: str, shard_cells: int, take: int, wall_s: float
+) -> Optional[float]:
+    """3-D counterpart for the packed volume engines (flat per-word op
+    model — the tiled kernels' recompute multipliers are attribution the
+    bench harnesses own; ``None`` for the dense tier, whose 26-neighbor
+    FLOP count has no audited model)."""
+    from gol_tpu.utils import roofline
+
+    if wall_s <= 0 or engine not in ("bitpack", "pallas"):
+        return None
+    lane_ops = (
+        roofline.OPS_3D_WT_PER_WORD * (shard_cells / roofline.BITS) * take
+    )
+    return (lane_ops / wall_s) / roofline.V5E_VPU_LANE_OPS
+
+
+# -- jax.profiler annotations (host-side; no-ops unless a trace is live) ----
+
+
+def step_annotation(name: str, step: int):
+    """``StepTraceAnnotation`` for one chunk — numbered steps in xprof."""
+    import jax
+
+    try:
+        return jax.profiler.StepTraceAnnotation(name, step_num=step)
+    except AttributeError:  # pragma: no cover - profiler API absent
+        return contextlib.nullcontext()
+
+
+def trace_annotation(name: str):
+    """Named ``TraceAnnotation`` span (compile, audit, checkpoint save)."""
+    import jax
+
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except AttributeError:  # pragma: no cover - profiler API absent
+        return contextlib.nullcontext()

@@ -1,0 +1,8 @@
+"""``python -m gol_tpu.telemetry {summarize <dir> | diff <a> <b>}``."""
+
+import sys
+
+from gol_tpu.telemetry.summarize import main
+
+if __name__ == "__main__":
+    sys.exit(main())

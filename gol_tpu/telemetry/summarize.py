@@ -1,0 +1,393 @@
+"""Merge, render, and compare telemetry runs (the read side).
+
+``summarize <dir>`` merges every ``<run_id>.rank<k>.jsonl`` file, validates
+each record against the schema, prints a per-phase table and a per-chunk
+table (with the roofline-utilization column), and flags anomalies:
+
+- **chunk-time outliers** — a chunk wall time > 2× the median of its
+  chunk-size class (same ``take``; the tail chunk is legitimately shorter,
+  so classes never mix sizes);
+- **utilization cliffs** — a chunk's roofline fraction < half the run's
+  best;
+- **audit divergence** — the same generation fingerprinted differently by
+  different ranks (replicated audit scalars MUST agree everywhere; a
+  divergence means a rank computed a different world — the exact
+  multi-host SDC signature the guard exists for);
+- **chunk/total drift** — per-chunk wall times not summing to the
+  summary's total phase within 5%.
+
+``diff <dir_a> <dir_b>`` compares two runs phase-by-phase and
+chunk-size-by-chunk-size — the missing tool behind BENCH_r* trajectory
+analysis (was: eyeballing two JSON blobs).
+
+Exit codes: 0 on success (anomalies are reported, not fatal — they are
+the tool's *output*), 2 on schema-invalid or unreadable input.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+from typing import Dict, List, Optional, Tuple
+
+from gol_tpu.telemetry import SchemaError, validate_record
+
+_RANK_RE = re.compile(r"^(?P<run>.+)\.rank(?P<rank>\d+)\.jsonl$")
+
+
+class Run:
+    """All records of one run_id, keyed by rank."""
+
+    def __init__(self, run_id: str) -> None:
+        self.run_id = run_id
+        self.ranks: Dict[int, List[dict]] = {}
+
+    def records(self, event: str, rank: Optional[int] = None) -> List[dict]:
+        out = []
+        for r, recs in sorted(self.ranks.items()):
+            if rank is not None and r != rank:
+                continue
+            out.extend(rec for rec in recs if rec["event"] == event)
+        return out
+
+    @property
+    def header(self) -> Optional[dict]:
+        heads = self.records("run_header", rank=min(self.ranks, default=None))
+        return heads[0] if heads else None
+
+    @property
+    def summary_record(self) -> Optional[dict]:
+        s = self.records("summary", rank=min(self.ranks, default=None))
+        return s[-1] if s else None
+
+
+def load_dir(directory: str) -> Dict[str, Run]:
+    """Parse + schema-validate every rank file; group by run_id.
+
+    Raises :class:`SchemaError` (exit 2 at the CLI) on any invalid line —
+    a telemetry directory that fails validation is worse than no
+    telemetry, because downstream analysis would silently trust it.
+    """
+    if not os.path.isdir(directory):
+        raise SchemaError(f"{directory}: not a directory")
+    runs: Dict[str, Run] = {}
+    paths = sorted(glob.glob(os.path.join(directory, "*.jsonl")))
+    if not paths:
+        raise SchemaError(f"{directory}: no .jsonl telemetry files")
+    for path in paths:
+        m = _RANK_RE.match(os.path.basename(path))
+        if not m:
+            raise SchemaError(
+                f"{path}: filename is not <run_id>.rank<k>.jsonl"
+            )
+        run_id, rank = m.group("run"), int(m.group("rank"))
+        run = runs.setdefault(run_id, Run(run_id))
+        recs = run.ranks.setdefault(rank, [])
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise SchemaError(f"{path}:{lineno}: bad JSON ({e})")
+                try:
+                    validate_record(rec)
+                except SchemaError as e:
+                    raise SchemaError(f"{path}:{lineno}: {e}")
+                recs.append(rec)
+    return runs
+
+
+def latest_run(runs: Dict[str, Run]) -> Run:
+    """The run whose header timestamp is newest (ties: run_id order)."""
+
+    def key(run: Run):
+        head = run.header
+        return (head["t"] if head else 0.0, run.run_id)
+
+    return max(runs.values(), key=key)
+
+
+# -- anomaly detection -------------------------------------------------------
+
+
+def find_anomalies(run: Run) -> List[str]:
+    flags: List[str] = []
+    rank0 = min(run.ranks, default=0)
+    chunks = run.records("chunk", rank=rank0)
+
+    # Chunk-time outliers, per chunk-size class.
+    by_take: Dict[int, List[dict]] = {}
+    for c in chunks:
+        by_take.setdefault(c["take"], []).append(c)
+    for take, cs in sorted(by_take.items()):
+        if len(cs) < 3:
+            continue  # no meaningful baseline
+        med = statistics.median(c["wall_s"] for c in cs)
+        for c in cs:
+            if med > 0 and c["wall_s"] > 2.0 * med:
+                flags.append(
+                    f"chunk-time outlier: chunk {c['index']} "
+                    f"({take} gens) took {c['wall_s']:.4f}s, "
+                    f"{c['wall_s'] / med:.1f}x the {med:.4f}s median of "
+                    "its size class"
+                )
+
+    # Utilization cliffs.
+    utils = [
+        (c["index"], c["roofline_util"])
+        for c in chunks
+        if c.get("roofline_util") is not None
+    ]
+    if len(utils) >= 2:
+        best = max(u for _, u in utils)
+        for idx, u in utils:
+            if best > 0 and u < 0.5 * best:
+                flags.append(
+                    f"utilization cliff: chunk {idx} at "
+                    f"{100 * u:.3g}% roofline vs the run's best "
+                    f"{100 * best:.3g}%"
+                )
+
+    # Audit fingerprint divergence across ranks.
+    by_gen: Dict[int, Dict[int, int]] = {}
+    for rank in sorted(run.ranks):
+        for a in run.records("guard_audit", rank=rank):
+            by_gen.setdefault(a["generation"], {})[rank] = a["fingerprint"]
+    for gen, fps in sorted(by_gen.items()):
+        if len(set(fps.values())) > 1:
+            detail = ", ".join(
+                f"rank{r}={fp:#010x}" for r, fp in sorted(fps.items())
+            )
+            flags.append(
+                f"audit fingerprint divergence at generation {gen}: "
+                f"{detail} — ranks disagree about the world (SDC or a "
+                "broken collective)"
+            )
+
+    # Per-chunk walls must account for the summary's total phase.
+    summ = run.summary_record
+    if summ is not None and chunks:
+        total = summ["phases"].get("total", summ["duration_s"])
+        acc = sum(c["wall_s"] for c in chunks)
+        if total > 0 and abs(acc - total) > 0.05 * total + 1e-3:
+            flags.append(
+                f"chunk/total drift: per-chunk walls sum to {acc:.4f}s "
+                f"but the total phase is {total:.4f}s"
+            )
+    return flags
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def _fmt_rate(x: float) -> str:
+    return f"{x:.3e}"
+
+
+def _fmt_util(u: Optional[float]) -> str:
+    if u is None:
+        return "-"
+    pct = 100 * u
+    # Sub-0.01% fractions (CPU backends vs the TPU peak) stay legible
+    # instead of rounding to a meaningless 0.00%.
+    return f"{pct:6.2f}%" if pct >= 0.005 else f"{pct:.1e}%"
+
+
+def render_run(run: Run, out) -> None:
+    head = run.header
+    print(f"run {run.run_id}", file=out)
+    if head is not None:
+        cfg = head.get("config", {})
+        print(
+            f"  ranks: {len(run.ranks)}/{head['process_count']}  "
+            f"backend: {head.get('backend', '?')}  "
+            f"jax: {head.get('jax_version', '?')}",
+            file=out,
+        )
+        items = ", ".join(f"{k}={v}" for k, v in sorted(cfg.items()))
+        print(f"  config: {items}", file=out)
+
+    rank0 = min(run.ranks, default=0)
+    compiles = run.records("compile", rank=rank0)
+    if compiles:
+        print("  compile:", file=out)
+        for c in compiles:
+            print(
+                f"    chunk {c['chunk']:>8} gens  lower {c['lower_s']:.3f}s"
+                f"  compile {c['compile_s']:.3f}s",
+                file=out,
+            )
+
+    chunks = run.records("chunk", rank=rank0)
+    if chunks:
+        print(
+            "  chunk     gens       gen      wall_s     updates/s  "
+            "roofline",
+            file=out,
+        )
+        for c in chunks:
+            print(
+                f"  {c['index']:>5} {c['take']:>8} {c['generation']:>9} "
+                f"{c['wall_s']:>11.4f}  {_fmt_rate(c['updates_per_sec']):>12}"
+                f"  {_fmt_util(c.get('roofline_util')):>8}",
+                file=out,
+            )
+
+    audits = run.records("guard_audit", rank=rank0)
+    if audits:
+        failures = sum(1 for a in audits if not a["ok"])
+        print(
+            f"  guard: {len(audits)} audits, {failures} failures "
+            f"(population {audits[-1]['population']} at gen "
+            f"{audits[-1]['generation']})",
+            file=out,
+        )
+
+    ckpts = run.records("checkpoint", rank=rank0)
+    if ckpts:
+        fenced = sum(c["wall_s"] for c in ckpts)
+        nbytes = sum(c["bytes"] for c in ckpts)
+        overlapped = sum(1 for c in ckpts if c["overlapped"])
+        print(
+            f"  checkpoints: {len(ckpts)} ({overlapped} overlapped), "
+            f"{nbytes} payload bytes, {fenced:.4f}s fenced",
+            file=out,
+        )
+
+    benches = run.records("bench_row")
+    if benches:
+        for b in benches:
+            print(f"  bench[{b['bench']}]: {json.dumps(b['data'])}", file=out)
+
+    summ = run.summary_record
+    if summ is not None:
+        print(
+            f"  total: {summ['duration_s']:.5f}s  "
+            f"{summ['cell_updates']} cell updates  "
+            f"{_fmt_rate(summ['updates_per_sec'])} updates/s",
+            file=out,
+        )
+        for name, secs in sorted(summ["phases"].items()):
+            print(f"    phase {name:<12} {secs:>10.4f}s", file=out)
+
+    for flag in find_anomalies(run):
+        print(f"  ANOMALY: {flag}", file=out)
+
+
+def summarize(directory: str, out) -> int:
+    runs = load_dir(directory)
+    for run_id in sorted(runs):
+        render_run(runs[run_id], out)
+    return 0
+
+
+# -- diff --------------------------------------------------------------------
+
+
+def _phase_table(run: Run) -> Dict[str, float]:
+    summ = run.summary_record
+    return dict(summ["phases"]) if summ else {}
+
+
+def _chunk_medians(run: Run) -> Dict[int, Tuple[float, Optional[float]]]:
+    """take -> (median wall_s, median roofline_util) on rank 0."""
+    rank0 = min(run.ranks, default=0)
+    by_take: Dict[int, List[dict]] = {}
+    for c in run.records("chunk", rank=rank0):
+        by_take.setdefault(c["take"], []).append(c)
+    out = {}
+    for take, cs in by_take.items():
+        walls = [c["wall_s"] for c in cs]
+        utils = [
+            c["roofline_util"]
+            for c in cs
+            if c.get("roofline_util") is not None
+        ]
+        out[take] = (
+            statistics.median(walls),
+            statistics.median(utils) if utils else None,
+        )
+    return out
+
+
+def _delta(a: float, b: float) -> str:
+    if a == 0:
+        return "   n/a"
+    return f"{100 * (b - a) / a:+6.1f}%"
+
+
+def diff(dir_a: str, dir_b: str, out) -> int:
+    run_a = latest_run(load_dir(dir_a))
+    run_b = latest_run(load_dir(dir_b))
+    print(f"A: {dir_a} run {run_a.run_id}", file=out)
+    print(f"B: {dir_b} run {run_b.run_id}", file=out)
+
+    pa, pb = _phase_table(run_a), _phase_table(run_b)
+    names = sorted(set(pa) | set(pb))
+    if names:
+        print("  phase            A_s         B_s    delta", file=out)
+        for name in names:
+            a, b = pa.get(name, 0.0), pb.get(name, 0.0)
+            print(
+                f"  {name:<12} {a:>10.4f}  {b:>10.4f}  {_delta(a, b)}",
+                file=out,
+            )
+
+    sa, sb = run_a.summary_record, run_b.summary_record
+    if sa and sb:
+        a, b = sa["updates_per_sec"], sb["updates_per_sec"]
+        print(
+            f"  updates/s    {_fmt_rate(a):>10}  {_fmt_rate(b):>10}  "
+            f"{_delta(a, b)}",
+            file=out,
+        )
+
+    ca, cb = _chunk_medians(run_a), _chunk_medians(run_b)
+    common = sorted(set(ca) & set(cb))
+    if common:
+        print(
+            "  chunk_gens   A_med_wall_s  B_med_wall_s    delta  "
+            "A_util  B_util",
+            file=out,
+        )
+        for take in common:
+            (wa, ua), (wb, ub) = ca[take], cb[take]
+            print(
+                f"  {take:>10} {wa:>13.4f} {wb:>13.4f}  {_delta(wa, wb)}"
+                f"  {_fmt_util(ua):>6}  {_fmt_util(ub):>6}",
+                file=out,
+            )
+    only = sorted(set(ca) ^ set(cb))
+    if only:
+        print(f"  chunk sizes present in only one run: {only}", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="gol_tpu.telemetry",
+        description="Summarize or diff structured run telemetry "
+        "(docs/OBSERVABILITY.md)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    ps = sub.add_parser("summarize", help="merge rank files, render tables")
+    ps.add_argument("directory")
+    pd = sub.add_parser("diff", help="compare two telemetry runs")
+    pd.add_argument("dir_a")
+    pd.add_argument("dir_b")
+    ns = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+    try:
+        if ns.command == "summarize":
+            return summarize(ns.directory, sys.stdout)
+        return diff(ns.dir_a, ns.dir_b, sys.stdout)
+    except (SchemaError, OSError) as e:
+        print(f"telemetry: {e}", file=sys.stderr)
+        return 2

@@ -332,6 +332,8 @@ def run_guarded(
     hardware from the last audited-good state (only audited boards are ever
     written — a snapshot can't capture corruption the guard would catch).
     """
+    from gol_tpu import telemetry as telemetry_mod
+
     sw = Stopwatch()
     guard = GuardReport()
     with sw.phase("init"):
@@ -342,44 +344,55 @@ def run_guarded(
 
     schedule: List[int] = rt.chunk_schedule(iterations, config.check_every)
 
-    with sw.phase("compile"):
-        evolvers = rt.compile_evolvers(board, schedule)
-        checker_evolvers = None
-        if config.redundant:
-            checker_evolvers = _checker_runtime(rt).compile_evolvers(
-                board, schedule
-            )
-
-    generation = int(state.generation)
-    writer = None
-    if rt.checkpoint_every > 0 and jax.process_count() == 1:
-        # Same async overlap + final-flush contract as GolRuntime.run.
-        writer = ckpt_mod.AsyncSnapshotWriter()
-    rt._ckpt_writer = writer
+    events = rt.open_event_log()
     try:
-        board, generation = guarded_loop(
-            sw,
-            guard,
-            board,
-            generation,
-            schedule,
-            evolvers,
-            checker_evolvers,
-            config,
-            save_snapshot=lambda b, g, fp: rt._save_snapshot(
-                GolState.create(b, g), fingerprint=fp
-            ),
-            checkpoint_every=rt.checkpoint_every,
-        )
-        if writer is not None:
-            with sw.phase("checkpoint"):
-                writer.flush()
-    finally:
-        rt._ckpt_writer = None
-        if writer is not None:
-            writer.close()
+        with sw.phase("compile"):
+            evolvers = rt.compile_evolvers(board, schedule, events)
+            checker_evolvers = None
+            if config.redundant:
+                checker_evolvers = _checker_runtime(rt).compile_evolvers(
+                    board, schedule
+                )
 
-    report = sw.report(rt.geometry.cell_updates(iterations))
+        generation = int(state.generation)
+        writer = None
+        if rt.checkpoint_every > 0 and jax.process_count() == 1:
+            # Same async overlap + final-flush contract as GolRuntime.run.
+            writer = ckpt_mod.AsyncSnapshotWriter()
+        rt._ckpt_writer = writer
+        try:
+            with telemetry_mod.trace_annotation("gol.guard.run"):
+                board, generation = guarded_loop(
+                    sw,
+                    guard,
+                    board,
+                    generation,
+                    schedule,
+                    evolvers,
+                    checker_evolvers,
+                    config,
+                    save_snapshot=lambda b, g, fp: rt._save_snapshot(
+                        GolState.create(b, g), fingerprint=fp
+                    ),
+                    checkpoint_every=rt.checkpoint_every,
+                    events=events,
+                    chunk_utilization=rt.chunk_utilization,
+                    checkpoint_overlapped=writer is not None,
+                )
+            if writer is not None:
+                with sw.phase("checkpoint"):
+                    writer.flush()
+        finally:
+            rt._ckpt_writer = None
+            if writer is not None:
+                writer.close()
+
+        report = sw.report(rt.geometry.cell_updates(iterations))
+        if events is not None:
+            events.summary(report)
+    finally:
+        if events is not None:
+            events.close()
     return report, GolState.create(board, generation), guard
 
 
@@ -394,6 +407,9 @@ def guarded_loop(
     config: GuardConfig,
     save_snapshot=None,
     checkpoint_every: int = 0,
+    events=None,
+    chunk_utilization=None,
+    checkpoint_overlapped: bool = False,
 ):
     """The chunk/audit/rollback core, shared by the 2-D and 3-D drivers.
 
@@ -402,7 +418,18 @@ def guarded_loop(
     fingerprint)`` persists an audited-good state (the audit's device
     fingerprint rides along so no host-side recompute happens).  Returns
     the final ``(board, generation)``; the caller owns reporting.
+
+    ``events`` (a :class:`gol_tpu.telemetry.EventLog`) receives one
+    ``chunk`` record per *executed* chunk — replays included, so the
+    stream shows recovery work the phase totals hide — plus one
+    ``guard_audit`` record per audit and one ``checkpoint`` record per
+    snapshot.  ``chunk_utilization(take, wall_s)`` maps a chunk to its
+    roofline fraction (``None`` skips the column).  All emission is
+    host-side, after the ``force_ready`` fences.
     """
+    import time as time_mod
+
+    from gol_tpu import telemetry as telemetry_mod
     # The rollback base lives on device (in the same fault domain as the
     # board — the price of not all-gathering per chunk), so its audit
     # fingerprint is recorded at snapshot time and re-verified before any
@@ -417,13 +444,29 @@ def guarded_loop(
     while i < len(schedule):
         take = schedule[i]
         compiled, dynamic = evolvers[take]
-        with sw.phase("total"):
-            candidate = compiled(board, *dynamic)
-            force_ready(candidate)
+        with telemetry_mod.step_annotation("gol.guard.chunk", i):
+            with sw.phase("total"):
+                t0 = time_mod.perf_counter()
+                candidate = compiled(board, *dynamic)
+                force_ready(candidate)
+                chunk_dt = time_mod.perf_counter() - t0
+        if events is not None:
+            events.chunk_event(
+                i,
+                take,
+                generation + take,
+                chunk_dt,
+                int(candidate.size) * take,
+                None
+                if chunk_utilization is None
+                else chunk_utilization(take, chunk_dt),
+                restores_this_chunk=restores_this_chunk,
+            )
         if config.fault_hook is not None:
             candidate = config.fault_hook(candidate, generation + take)
-        with sw.phase("audit"):
-            audit = audit_board(candidate, generation + take)
+        with telemetry_mod.trace_annotation("gol.guard.audit"):
+            with sw.phase("audit"):
+                audit = audit_board(candidate, generation + take)
         # Sampling keys on the stable chunk index, so a sampled chunk's
         # replays — after either a cheap-audit or a recompute failure —
         # are re-verified redundantly, and failures cannot drift the
@@ -435,15 +478,18 @@ def guarded_loop(
             # second engine; fingerprints of two independent programs can
             # only agree if neither run was corrupted.
             comp2, dyn2 = checker_evolvers[take]
-            with sw.phase("redundant"):
-                reference = comp2(_device_copy(last_good[0]), *dyn2)
-                audit2 = audit_board(reference, generation + take)
+            with telemetry_mod.trace_annotation("gol.guard.redundant"):
+                with sw.phase("redundant"):
+                    reference = comp2(_device_copy(last_good[0]), *dyn2)
+                    audit2 = audit_board(reference, generation + take)
             audit = dataclasses.replace(
                 audit,
                 ok=audit2.fingerprint == audit.fingerprint,
                 redundant_fingerprint=audit2.fingerprint,
             )
         guard.audits.append(audit)
+        if events is not None:
+            events.guard_event(audit)
         if not audit.ok:
             guard.failures += 1
             restores_this_chunk += 1
@@ -462,7 +508,9 @@ def guarded_loop(
                     f"({config.max_restores}) is exhausted — persistent fault"
                 )
             guard.restores += 1
-            with sw.phase("restore"):
+            with telemetry_mod.trace_annotation(
+                "gol.guard.restore"
+            ), sw.phase("restore"):
                 # Copy again: the replayed chunk donates its input, and
                 # the last-good buffer must survive for further replays.
                 board = _device_copy(last_good[0])
@@ -484,11 +532,21 @@ def guarded_loop(
             # on device) — recorded for the base-integrity check above.
             last_good = (_device_copy(board), generation, audit.fingerprint)
         if next_ckpt is not None and generation >= next_ckpt:
-            with sw.phase("checkpoint"):
-                # The audit already fingerprinted this exact board on
-                # device — no host-side fingerprint pass; multi-host runs
-                # write sharded pieces with no gather at all.
-                save_snapshot(board, generation, audit.fingerprint)
+            with telemetry_mod.trace_annotation("gol.checkpoint.save"):
+                with sw.phase("checkpoint"):
+                    # The audit already fingerprinted this exact board on
+                    # device — no host-side fingerprint pass; multi-host
+                    # runs write sharded pieces with no gather at all.
+                    t0 = time_mod.perf_counter()
+                    save_snapshot(board, generation, audit.fingerprint)
+                    ckpt_dt = time_mod.perf_counter() - t0
+            if events is not None:
+                events.checkpoint_event(
+                    generation,
+                    ckpt_dt,
+                    int(board.size),
+                    overlapped=checkpoint_overlapped,
+                )
             next_ckpt = generation + checkpoint_every
         i += 1
     return board, generation

@@ -367,6 +367,18 @@ def main(argv=None) -> None:
     import sys
 
     args = list(sys.argv[1:] if argv is None else argv)
+    # Optional structured-telemetry sink (docs/OBSERVABILITY.md), peeled
+    # off before the positional surface so the published CLI is unchanged.
+    telemetry_dir = run_id = None
+    for flag in ("--telemetry", "--run-id"):
+        if flag in args:
+            k = args.index(flag)
+            value = args[k + 1]
+            del args[k : k + 2]
+            if flag == "--telemetry":
+                telemetry_dir = value
+            else:
+                run_id = value
     if len(args) > 0 and "x" in args[0]:
         parts = tuple(int(v) for v in args[0].split("x"))
         size = parts if len(parts) > 1 else parts[0]
@@ -409,6 +421,12 @@ def main(argv=None) -> None:
         }
     )
     print(json.dumps(out))
+    if telemetry_dir:
+        from gol_tpu import telemetry as telemetry_mod
+
+        with telemetry_mod.EventLog(telemetry_dir, run_id=run_id) as ev:
+            ev.run_header(dict(tool="halobench", engine=engine, kind=kind))
+            ev.bench_row("halobench", out)
 
 
 if __name__ == "__main__":

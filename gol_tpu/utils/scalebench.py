@@ -213,6 +213,10 @@ def main(argv=None) -> None:
     # The multi-host trio, same surface as the main CLI: every process of
     # the job runs this module with its own --process-id.
     add_multihost_args(p)
+    # Structured-telemetry sink (docs/OBSERVABILITY.md): every process
+    # writes its own rank file with the rows it measured.
+    p.add_argument("--telemetry", default=None, metavar="DIR")
+    p.add_argument("--run-id", default=None, metavar="NAME")
     ns = p.parse_args(list(sys.argv[1:] if argv is None else argv))
     size = int(ns.positionals[0]) if len(ns.positionals) > 0 else 1024
     steps = int(ns.positionals[1]) if len(ns.positionals) > 1 else 64
@@ -225,6 +229,21 @@ def main(argv=None) -> None:
         ns.coordinator, ns.num_processes, ns.process_id
     )
     rows = measure_weak_scaling(size, steps, engine, mesh_kind=mesh_kind)
+    if ns.telemetry:
+        from gol_tpu import telemetry as telemetry_mod
+
+        with telemetry_mod.EventLog(ns.telemetry, run_id=ns.run_id) as ev:
+            ev.run_header(
+                dict(
+                    tool="scalebench",
+                    engine=engine,
+                    mesh_kind=mesh_kind,
+                    size_per_chip=size,
+                    steps=steps,
+                )
+            )
+            for row in rows:
+                ev.bench_row("scalebench", row)
     if topo.is_coordinator:
         # Process 0 owns the full curve (its devices lead the global list,
         # so it participates in every row, including the 1-device

@@ -1,0 +1,428 @@
+"""Structured-telemetry tests (gol_tpu.telemetry).
+
+What they pin:
+
+- the JSONL schema round-trips and the writer refuses invalid records;
+- per-chunk records match the chunk schedule and their wall times sum to
+  the ``RunReport`` total (the acceptance contract: the event stream is a
+  superset of the printed report, never a different story);
+- ``summarize``/``diff`` render the fixture run's tables (roofline
+  column included) and exit 0; schema-invalid input exits 2;
+- rank-file merge flags audit-fingerprint divergence across ranks;
+- a real two-process run (the test_multihost.py harness) writes one rank
+  file per process, gather-free, and summarize merges them;
+- **trace identity**: telemetry on/off produces byte-identical jaxprs —
+  emission is host-side only and can never change the compiled program.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.models.state import Geometry
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+
+# -- schema round-trip -------------------------------------------------------
+
+
+def _emit_all(ev: telemetry.EventLog) -> None:
+    from gol_tpu.utils.guard import Audit
+    from gol_tpu.utils.timing import RunReport
+
+    ev.run_header({"driver": "2d", "engine": "auto"})
+    ev.compile_event(8, 0.1, 0.2)
+    ev.chunk_event(0, 8, 8, 0.5, 4096, 0.25)
+    ev.guard_event(
+        Audit(generation=8, ok=True, max_cell=1, population=3,
+              fingerprint=0x1234)
+    )
+    ev.checkpoint_event(8, 0.01, 4096, overlapped=True)
+    ev.bench_row("halobench", {"exchange_s": 1e-5})
+    ev.summary(
+        RunReport(duration_s=0.5, cell_updates=4096, phases={"total": 0.5})
+    )
+
+
+def test_schema_roundtrip(tmp_path):
+    with telemetry.EventLog(str(tmp_path), run_id="rt", process_index=0) as ev:
+        _emit_all(ev)
+        path = ev.path
+    lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert [r["event"] for r in lines] == [
+        "run_header", "compile", "chunk", "guard_audit", "checkpoint",
+        "bench_row", "summary",
+    ]
+    for rec in lines:
+        telemetry.validate_record(rec)  # must not raise
+    # Fields survive the trip.
+    assert lines[2]["take"] == 8 and lines[2]["roofline_util"] == 0.25
+    assert lines[3]["fingerprint"] == 0x1234
+    assert lines[6]["phases"] == {"total": 0.5}
+
+
+@pytest.mark.parametrize(
+    "rec",
+    [
+        {"event": "nonsense", "t": 1.0},
+        {"event": "chunk", "t": 1.0, "index": 0},  # missing fields
+        {"event": "run_header"},  # no timestamp
+        {"event": "run_header", "t": 1.0, "schema": 99, "run_id": "x",
+         "process_index": 0, "process_count": 1, "config": {}},
+    ],
+)
+def test_validate_rejects_bad_records(rec):
+    with pytest.raises(telemetry.SchemaError):
+        telemetry.validate_record(rec)
+
+
+def test_emitter_never_writes_invalid(tmp_path):
+    ev = telemetry.EventLog(str(tmp_path), run_id="bad", process_index=0)
+    try:
+        with pytest.raises(telemetry.SchemaError):
+            ev.emit("chunk", index=0)  # missing required fields
+    finally:
+        ev.close()
+    assert open(ev.path).read() == ""
+
+
+# -- runtime emission --------------------------------------------------------
+
+
+def _run(tmp_path, name, iterations=8, checkpoint_every=3, **kw):
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        checkpoint_every=checkpoint_every,
+        checkpoint_dir=str(tmp_path / f"{name}-ck"),
+        telemetry_dir=str(tmp_path / name),
+        run_id=name,
+        **kw,
+    )
+    report, state = rt.run(pattern=4, iterations=iterations)
+    recs = [
+        json.loads(ln)
+        for ln in open(tmp_path / name / f"{name}.rank0.jsonl")
+    ]
+    return rt, report, recs
+
+
+def test_runtime_chunk_records_match_schedule(tmp_path):
+    rt, report, recs = _run(tmp_path, "sched")
+    by = {}
+    for r in recs:
+        by.setdefault(r["event"], []).append(r)
+
+    # Schedule [3, 3, 2]: one chunk record each, generations cumulative.
+    chunks = by["chunk"]
+    assert [c["take"] for c in chunks] == rt.chunk_schedule(8, 3) == [3, 3, 2]
+    assert [c["generation"] for c in chunks] == [3, 6, 8]
+    assert [c["index"] for c in chunks] == [0, 1, 2]
+    # One compile record per distinct chunk size, with both durations.
+    assert sorted(c["chunk"] for c in by["compile"]) == [2, 3]
+    assert all(c["lower_s"] > 0 and c["compile_s"] > 0 for c in by["compile"])
+    # One checkpoint record per snapshot, single-process => overlapped.
+    assert [c["generation"] for c in by["checkpoint"]] == [3, 6, 8]
+    assert all(c["overlapped"] and c["bytes"] == 64 * 64
+               for c in by["checkpoint"])
+    # Per-chunk walls sum to the RunReport total (same fenced region).
+    acc = sum(c["wall_s"] for c in chunks)
+    assert acc == pytest.approx(report.phases["total"], rel=0.05, abs=1e-3)
+    # The summary record mirrors RunReport exactly.
+    (summary,) = by["summary"]
+    assert summary["duration_s"] == report.duration_s
+    assert summary["cell_updates"] == report.cell_updates == 64 * 64 * 8
+    assert summary["phases"] == report.phases
+    # Roofline column is populated (bitpack resolves, model exists).
+    assert all(c["roofline_util"] > 0 for c in chunks)
+
+
+def test_guarded_run_emits_audits(tmp_path):
+    from gol_tpu.utils import guard as guard_mod
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        telemetry_dir=str(tmp_path / "g"),
+        run_id="g",
+    )
+    report, state, greport = guard_mod.run_guarded(
+        rt, pattern=4, iterations=8,
+        config=guard_mod.GuardConfig(check_every=4),
+    )
+    recs = [json.loads(ln) for ln in open(tmp_path / "g" / "g.rank0.jsonl")]
+    audits = [r for r in recs if r["event"] == "guard_audit"]
+    assert len(audits) == greport.checks == 2
+    assert [a["generation"] for a in audits] == [4, 8]
+    assert all(a["ok"] and a["max_cell"] <= 1 for a in audits)
+    # Audit scalars in the stream match the in-memory report.
+    assert [a["fingerprint"] for a in audits] == [
+        a.fingerprint for a in greport.audits
+    ]
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert [c["take"] for c in chunks] == [4, 4]
+
+
+# -- trace identity ----------------------------------------------------------
+
+
+def test_telemetry_never_changes_the_traced_program(tmp_path):
+    """Telemetry-on and telemetry-off runtimes trace byte-identical
+    jaxprs for every engine the CPU backend dispatches — emission is
+    host-side, after the force_ready fences, by construction."""
+    from gol_tpu.analysis import walker
+
+    for engine in ("dense", "bitpack"):
+        kw = dict(geometry=Geometry(size=64, num_ranks=1), engine=engine)
+        rt_off = GolRuntime(**kw)
+        rt_on = GolRuntime(
+            **kw, telemetry_dir=str(tmp_path / "ti"), run_id="ti"
+        )
+        spec = jax.ShapeDtypeStruct((64, 64), np.uint8)
+        jaxprs = []
+        for rt in (rt_off, rt_on):
+            fn, dynamic, static = rt._evolve_fn(4)
+            jaxprs.append(str(walker.trace_jaxpr(fn, spec, *dynamic, *static)))
+        assert jaxprs[0] == jaxprs[1], f"engine {engine} trace diverged"
+
+
+def test_telemetry_run_bit_identical_board(tmp_path):
+    _, _, recs = _run(tmp_path, "bit")
+    rt_off = GolRuntime(geometry=Geometry(size=64, num_ranks=1))
+    report, state = rt_off.run(pattern=4, iterations=8)
+    rt_on = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        telemetry_dir=str(tmp_path / "bit2"),
+        run_id="bit2",
+    )
+    _, state_on = rt_on.run(pattern=4, iterations=8)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), np.asarray(state_on.board)
+    )
+
+
+# -- summarize / diff --------------------------------------------------------
+
+
+def test_summarize_fixture_run(tmp_path):
+    _run(tmp_path, "fix")
+    out = io.StringIO()
+    assert summ_mod.summarize(str(tmp_path / "fix"), out) == 0
+    text = out.getvalue()
+    assert "run fix" in text
+    assert "roofline" in text  # the utilization column header
+    assert "chunk     gens" in text
+    assert text.count("\n  ") >= 5
+    # 3 chunk rows with cumulative generations rendered.
+    for idx, take, gen in [(0, 3, 3), (1, 3, 6), (2, 2, 8)]:
+        assert f"{idx:>5} {take:>8} {gen:>9}" in text.replace("  ", "  ")
+    assert "phase total" in text
+    assert "checkpoints: 3" in text
+
+
+def test_summarize_rejects_schema_violation(tmp_path, capsys):
+    d = tmp_path / "bad"
+    d.mkdir()
+    (d / "x.rank0.jsonl").write_text('{"event": "chunk", "t": 1.0}\n')
+    assert summ_mod.main(["summarize", str(d)]) == 2
+    assert "missing fields" in capsys.readouterr().err
+
+
+def test_summarize_missing_dir_exit_code(capsys):
+    assert summ_mod.main(["summarize", "/nonexistent-telemetry"]) == 2
+
+
+def test_diff_two_runs(tmp_path):
+    _run(tmp_path, "a")
+    _run(tmp_path, "b", iterations=6)
+    out = io.StringIO()
+    assert summ_mod.diff(str(tmp_path / "a"), str(tmp_path / "b"), out) == 0
+    text = out.getvalue()
+    assert "A:" in text and "B:" in text
+    assert "phase" in text and "total" in text
+    assert "updates/s" in text
+    assert "chunk_gens" in text  # per-chunk-size comparison table
+    assert "delta" in text
+
+
+def test_cli_telemetry_flag(tmp_path, capsys):
+    from gol_tpu import cli
+
+    d = tmp_path / "t"
+    rc = cli.main(
+        ["0", "64", "8", "512", "0", "--telemetry", str(d),
+         "--run-id", "clirun"]
+    )
+    assert rc == 0
+    assert (d / "clirun.rank0.jsonl").exists()
+    capsys.readouterr()
+    assert summ_mod.main(["summarize", str(d)]) == 0
+    assert "clirun" in capsys.readouterr().out
+
+
+def test_cli3d_telemetry_flag(tmp_path, capsys):
+    from gol_tpu import cli3d
+
+    d = tmp_path / "t3"
+    rc = cli3d.main(
+        ["2", "32", "4", "16", "0", "--engine", "bitpack",
+         "--guard-every", "2", "--telemetry", str(d), "--run-id", "v3"]
+    )
+    assert rc == 0
+    recs = [json.loads(ln) for ln in open(d / "v3.rank0.jsonl")]
+    events = [r["event"] for r in recs]
+    assert events[0] == "run_header" and events[-1] == "summary"
+    assert events.count("chunk") == 2 and events.count("guard_audit") == 2
+    assert recs[0]["config"]["driver"] == "3d"
+    capsys.readouterr()
+    assert summ_mod.main(["summarize", str(d)]) == 0
+
+
+# -- anomaly detection -------------------------------------------------------
+
+
+def _write_rank(tmp_path, run_id, rank, records):
+    path = telemetry.rank_file(str(tmp_path), run_id, rank)
+    with open(path, "w") as f:
+        for rec in records:
+            telemetry.validate_record(rec)
+            f.write(json.dumps(rec) + "\n")
+
+
+def _header(run_id, rank):
+    return {
+        "event": "run_header", "t": 1.0, "schema": 1, "run_id": run_id,
+        "process_index": rank, "process_count": 2, "config": {},
+    }
+
+
+def _audit(gen, fp):
+    return {
+        "event": "guard_audit", "t": 2.0, "generation": gen, "ok": True,
+        "max_cell": 1, "population": 7, "fingerprint": fp,
+    }
+
+
+def test_summarize_flags_fingerprint_divergence(tmp_path, capsys):
+    _write_rank(tmp_path, "m", 0, [_header("m", 0), _audit(4, 0x11)])
+    _write_rank(tmp_path, "m", 1, [_header("m", 1), _audit(4, 0x22)])
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "ANOMALY: audit fingerprint divergence at generation 4" in text
+    assert "rank0=0x00000011" in text and "rank1=0x00000022" in text
+
+
+def test_summarize_flags_chunk_outlier(tmp_path, capsys):
+    def chunk(i, wall):
+        return {
+            "event": "chunk", "t": 2.0, "index": i, "take": 4,
+            "generation": 4 * (i + 1), "wall_s": wall,
+            "updates_per_sec": 1e6, "roofline_util": None,
+        }
+
+    _write_rank(
+        tmp_path, "o", 0,
+        [_header("o", 0)] + [chunk(i, 0.1) for i in range(4)]
+        + [chunk(4, 0.9)],
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    text = capsys.readouterr().out
+    assert "ANOMALY: chunk-time outlier: chunk 4" in text
+
+
+def test_no_false_anomalies_on_clean_fixture(tmp_path, capsys):
+    """A healthy run must not cry wolf on the divergence/drift flags
+    (utilization can legitimately vary chunk-to-chunk on CPU warm-up, so
+    only the hard flags are asserted absent)."""
+    _run(tmp_path, "clean")
+    assert summ_mod.main(["summarize", str(tmp_path / "clean")]) == 0
+    text = capsys.readouterr().out
+    assert "divergence" not in text
+    assert "chunk/total drift" not in text
+
+
+# -- bench harness emission --------------------------------------------------
+
+
+def test_halobench_telemetry(tmp_path, capsys):
+    from gol_tpu.utils import halobench
+
+    halobench.main(
+        ["64", "4", "1d", "dense", "--telemetry", str(tmp_path),
+         "--run-id", "hb"]
+    )
+    capsys.readouterr()
+    recs = [json.loads(ln) for ln in open(tmp_path / "hb.rank0.jsonl")]
+    assert [r["event"] for r in recs] == ["run_header", "bench_row"]
+    assert recs[0]["config"]["tool"] == "halobench"
+    assert "exchange_s" in recs[1]["data"]
+
+
+def test_scalebench_telemetry(tmp_path, capsys):
+    from gol_tpu.utils import scalebench
+
+    scalebench.main(
+        ["64", "2", "dense", "--telemetry", str(tmp_path),
+         "--run-id", "sb"]
+    )
+    capsys.readouterr()
+    recs = [json.loads(ln) for ln in open(tmp_path / "sb.rank0.jsonl")]
+    rows = [r for r in recs if r["event"] == "bench_row"]
+    assert len(rows) == len(scalebench.device_counts())
+    assert rows[0]["data"]["devices"] == 1
+    assert rows[0]["data"]["efficiency"] == 1.0
+
+
+# -- real two-process rank-file merge (the test_multihost.py harness) --------
+
+_WORKER_TELEMETRY = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gol_tpu import compat as _compat
+_compat.set_cpu_device_count(2)
+from gol_tpu import cli
+pid = sys.argv[1]
+sys.exit(cli.main([
+    "4", "8", "4", "16", "0",
+    "--ranks", "4", "--mesh", "1d",
+    "--coordinator", sys.argv[2],
+    "--num-processes", "2", "--process-id", pid,
+    "--guard-every", "2",
+    "--telemetry", sys.argv[3], "--run-id", "mh",
+]))
+"""
+
+
+def test_two_process_rank_files_merge(tmp_path, capsys):
+    from tests.test_multihost import _run_two_workers
+
+    tdir = tmp_path / "mh"
+    _run_two_workers(_WORKER_TELEMETRY, [str(tdir)])
+
+    # One file per process — written gather-free by each rank.
+    assert (tdir / "mh.rank0.jsonl").exists()
+    assert (tdir / "mh.rank1.jsonl").exists()
+    runs = summ_mod.load_dir(str(tdir))
+    assert sorted(runs) == ["mh"]
+    run = runs["mh"]
+    assert sorted(run.ranks) == [0, 1]
+    # Replicated audit scalars agree across ranks — no divergence flags.
+    audits0 = run.records("guard_audit", rank=0)
+    audits1 = run.records("guard_audit", rank=1)
+    assert [a["fingerprint"] for a in audits0] == [
+        a["fingerprint"] for a in audits1
+    ]
+    assert len(audits0) == 2
+    assert summ_mod.main(["summarize", str(tdir)]) == 0
+    text = capsys.readouterr().out
+    assert "ranks: 2/2" in text
+    assert "divergence" not in text
