@@ -1,8 +1,8 @@
 # Entry points for builders and reviewers.  `make check` is the one
-# gate: lint + static verifier + telemetry smoke + tier-1 tests (see
-# scripts/check.sh).
+# gate: lint + static verifier + telemetry smoke + stats smoke +
+# tier-1 tests (see scripts/check.sh).
 
-.PHONY: lint verify test check telemetry-smoke
+.PHONY: lint verify test check telemetry-smoke stats-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -22,6 +22,15 @@ telemetry-smoke:
 	JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
 	    --telemetry "$$tdir" --run-id smoke > /dev/null && \
 	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$$tdir"
+
+# Tiny CPU run with --stats --telemetry; `summarize` must exit 0 and
+# render the per-chunk population (stats) table.
+stats-smoke:
+	@sdir=$$(mktemp -d); trap 'rm -rf "$$sdir"' EXIT; \
+	JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
+	    --telemetry "$$sdir" --run-id statsmoke --stats > /dev/null && \
+	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$$sdir" \
+	    | grep "stats     gen"
 
 check:
 	bash scripts/check.sh

@@ -33,6 +33,7 @@ pin:
 
 from __future__ import annotations
 
+import dataclasses
 import warnings
 from typing import List, Sequence, Tuple
 
@@ -374,6 +375,63 @@ def check_purity(jaxpr, cfg) -> CheckResult:
     return CheckResult.from_findings("purity", findings)
 
 
+def check_stats_purity(rt, cfg, spec, take) -> CheckResult:
+    """The ``--stats`` wrapper adds reductions only — never callbacks.
+
+    Builds the stats-mode program through the *real* runtime path
+    (:func:`gol_tpu.telemetry.stats.build_stats_evolver` on a
+    ``stats=True`` sibling of the verified runtime) and re-runs the
+    purity scan over its jaxpr: the chunk statistics must stay in-graph
+    (fused reductions, psums on a mesh), because one ``debug_callback``
+    smuggled in for "just a population print" would serialize every
+    chunk on a host round-trip — precisely the failure mode the
+    stats subsystem exists to avoid.  ``stale_t0`` configs are skipped
+    (their frozen-halo operands are bound at board init, not trace
+    time; stats mode is a fresh-run observability feature).
+    """
+    if cfg.halo_mode != "fresh":
+        return CheckResult.skipped(
+            "stats-purity", "stale_t0 runs bind frozen halos at init"
+        )
+    from gol_tpu.telemetry import stats as stats_mod
+
+    findings: List[Finding] = []
+    try:
+        rt_stats = dataclasses.replace(rt, stats=True)
+        sfn, sdyn = stats_mod.build_stats_evolver(rt_stats, take)
+        sjaxpr = walker.trace_jaxpr(sfn, spec, *sdyn)
+    except Exception as e:
+        findings.append(
+            Finding(
+                ERROR,
+                "stats-purity",
+                f"stats-mode program failed to build/trace: {e}",
+            )
+        )
+        return CheckResult.from_findings("stats-purity", findings)
+    for info in walker.iter_eqns(sjaxpr):
+        if info.name in IMPURE_PRIMITIVES:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "stats-purity",
+                    f"host-interaction primitive {info.name!r} in the "
+                    f"stats-mode program (path "
+                    f"{'/'.join(info.path) or 'top'}) — chunk statistics "
+                    "must be in-graph reductions, never callbacks",
+                )
+            )
+    if not findings:
+        findings.append(
+            Finding(
+                INFO,
+                "stats-purity",
+                "stats-mode program traced pure (reductions only)",
+            )
+        )
+    return CheckResult.from_findings("stats-purity", findings)
+
+
 # ---------------------------------------------------------------------------
 # donation + cost
 # ---------------------------------------------------------------------------
@@ -650,6 +708,7 @@ def run_config(cfg, execute_retrace: bool = True):
     report.checks.append(check_comm(jaxpr, cfg, mesh))
     report.checks.append(check_dtype(jaxpr, cfg))
     report.checks.append(check_purity(jaxpr, cfg))
+    report.checks.append(check_stats_purity(rt, cfg, spec, take))
 
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")

@@ -90,6 +90,14 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     # --run-id so every rank's file shares one prefix.
     ext.add_argument("--telemetry", default=None, metavar="DIR")
     ext.add_argument("--run-id", default=None, metavar="NAME")
+    # In-graph simulation statistics: each chunk additionally returns
+    # fused device reductions (population, births/deaths, changed,
+    # boundary-band populations — global via psum on sharded runs),
+    # emitted as schema-v2 `stats` events.  Requires --telemetry (the
+    # events are the output) and excludes --guard-every (the guard's
+    # audit already reports population, and its rollback replay needs
+    # the donated buffers stats mode must keep alive).
+    ext.add_argument("--stats", action="store_true")
     ext.add_argument("--compat-banner", action="store_true")
     ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
     ext.add_argument("--checkpoint-dir", default=None)
@@ -213,6 +221,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"--guard-every must be >= 0, got {ns.guard_every} "
                 "(0 disables the guard)"
             )
+        if ns.stats and not ns.telemetry:
+            raise ValueError(
+                "--stats emits schema-v2 stats events, so it requires "
+                "--telemetry DIR"
+            )
+        if ns.stats and ns.guard_every > 0:
+            raise ValueError(
+                "--stats applies to unguarded runs; drop --guard-every "
+                "(the guard's audit already reports population per chunk)"
+            )
     except ValueError as e:
         print(e)
         return 255
@@ -231,6 +249,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             rule=ns.rule,
             telemetry_dir=ns.telemetry,
             run_id=ns.run_id,
+            stats=ns.stats,
         )
         guard_report = None
         if ns.guard_every > 0:

@@ -102,15 +102,33 @@ def _pallas3d_sharded_fits(mesh, size: int) -> bool:
     )
 
 
-def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
+def _build_evolver(
+    engine: str, mesh, steps: int, rule, size: int, stats: bool = False
+):
     """(compiled, place) for the chosen engine/mesh.
 
     ``compiled`` is AOT-lowered from a ShapeDtypeStruct — like
     ``GolRuntime.compile_evolvers``, compilation never executes a throwaway
     evolution — and donates its input; ``place`` puts the host volume on
     device(s) with the sharding the compiled program expects.
+
+    ``stats=True`` wraps the program in the in-graph volume reductions
+    (:func:`gol_tpu.telemetry.stats.wrap_evolver_3d`): the compiled
+    chunk returns ``(volume, stats)`` — population/births/deaths/changed
+    — with sharded volumes reduced at the global-array level (XLA
+    derives the collectives; the scalars replicate to every process).
+    The chunk-start volume stays live for the diff, so the wrapped form
+    forfeits the input donation (one extra volume of HBM).
     """
     import jax
+
+    def finish(fn, static, spec, place):
+        if stats:
+            from gol_tpu.telemetry import stats as stats_mod
+
+            wrapped = stats_mod.wrap_evolver_3d(fn, static)
+            return wrapped.lower(spec).compile(), place
+        return fn.lower(spec, *static).compile(), place
 
     spec_shape = (size, size, size)
     explicit_pallas = engine == "pallas"
@@ -143,7 +161,7 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
         sharding = sharded3d.volume_sharding(mesh)
         spec = jax.ShapeDtypeStruct(spec_shape, np.uint8, sharding=sharding)
         place = lambda v: jax.device_put(v, sharding)
-        return fn.lower(spec).compile(), place
+        return finish(fn, (), spec, place)
 
     if engine == "pallas":
         from gol_tpu.ops import pallas_bitlife3d
@@ -165,7 +183,7 @@ def _build_evolver(engine: str, mesh, steps: int, rule, size: int):
         fn = life3d.run3d
         static = (steps, rule)
     spec = jax.ShapeDtypeStruct(spec_shape, np.uint8)
-    return fn.lower(spec, *static).compile(), jax.device_put
+    return finish(fn, static, spec, jax.device_put)
 
 
 def _resolve_engine3d(engine: str, mesh, size: int) -> str:
@@ -251,6 +269,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # driver (docs/OBSERVABILITY.md).
     ext.add_argument("--telemetry", default=None, metavar="DIR")
     ext.add_argument("--run-id", default=None, metavar="NAME")
+    # In-graph volume statistics per chunk (schema-v2 `stats` events):
+    # population/births/deaths/changed fused onto the chunk program —
+    # same surface and constraints as the 2-D driver's --stats.
+    ext.add_argument("--stats", action="store_true")
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -309,6 +331,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if ns.profile and ns.guard_every > 0:
             raise ValueError(
                 "--profile applies to unguarded runs; drop --guard-every"
+            )
+        if ns.stats and not ns.telemetry:
+            raise ValueError(
+                "--stats emits schema-v2 stats events, so it requires "
+                "--telemetry DIR"
+            )
+        if ns.stats and ns.guard_every > 0:
+            raise ValueError(
+                "--stats applies to unguarded runs; drop --guard-every "
+                "(the guard's audit already reports population per chunk)"
             )
         rule = parse_rule3d(ns.rule)
 
@@ -495,13 +527,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 for take in set(schedule):
                     t0 = time_mod.perf_counter()
                     evolvers[take] = _build_evolver(
-                        ns.engine, mesh, take, rule, size
+                        ns.engine, mesh, take, rule, size, stats=ns.stats
                     )
                     if events is not None:
                         # _build_evolver lowers + compiles in one step;
-                        # the record carries the combined duration.
+                        # the record carries the combined duration (and,
+                        # schema v2, the compiled memory footprint).
+                        from gol_tpu.telemetry import stats as stats_mod
+
                         events.compile_event(
-                            take, 0.0, time_mod.perf_counter() - t0
+                            take,
+                            0.0,
+                            time_mod.perf_counter() - t0,
+                            memory=stats_mod.compiled_memory(
+                                evolvers[take][0]
+                            ),
                         )
                 place = evolvers[schedule[0]][1]
                 board = placed if placed is not None else place(vol)
@@ -559,10 +599,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 ):
                     for i, take in enumerate(schedule):
                         compiled, _ = evolvers[take]
+                        dev_stats = None
                         with telemetry_mod.step_annotation("gol.chunk", i):
                             with sw.phase("total"):
                                 t0 = time_mod.perf_counter()
-                                board = compiled(board)
+                                if ns.stats:
+                                    board, dev_stats = compiled(board)
+                                else:
+                                    board = compiled(board)
                                 force_ready(board)
                                 dt = time_mod.perf_counter() - t0
                         generation += take
@@ -574,6 +618,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                 dt,
                                 size**3 * take,
                                 util3d(take, dt),
+                            )
+                        if dev_stats is not None and events is not None:
+                            from gol_tpu.telemetry import (
+                                stats as stats_mod,
+                            )
+
+                            events.stats_event(
+                                i,
+                                take,
+                                generation,
+                                stats_mod.stats_values(dev_stats),
                             )
                         if ns.checkpoint_every > 0:
                             with telemetry_mod.trace_annotation(

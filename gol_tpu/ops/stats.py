@@ -1,0 +1,199 @@
+"""In-graph chunk statistics: what the simulation *did*, not how fast.
+
+The reference's only view of the evolving world is a full dump after the
+run (``gol_printWorld``, gol-main.c:17-28); our telemetry (PR 2) added
+per-chunk *timings* but still says nothing about the board without a
+device→host grid pull.  This module owns the device-side reductions the
+``--stats`` mode fuses onto each chunk program:
+
+- **population** — live cells of the chunk-end board;
+- **births / deaths / changed** — cells that flipped 0→1 / 1→0 across
+  the whole chunk (``changed = births + deaths``), computed from the
+  chunk-start board the compiled program still holds — the extinction /
+  all-static-fixpoint watchdog inputs;
+- **face_top/bottom/left/right** — live cells in the four boundary
+  bands of depth ``band`` (what the next halo exchange ships), the
+  boundary-flux signal for sharded runs.
+
+Two tiers, mutually bit-equal (pinned by tests/test_stats.py):
+
+- :func:`dense_chunk_stats` — plain ``jnp.sum`` reductions on the uint8
+  board (the dense and Pallas-dense engines).
+- :func:`packed_chunk_stats` — popcount-based: the boards are packed 32
+  cells/word (:func:`gol_tpu.ops.bitlife.pack`) and every reduction runs
+  ``lax.population_count`` over uint32 words, so the reduce tree sees
+  1/32nd the elements and the flip planes (``new & ~prev``) are single
+  bitwise ops — the bitpacked/folded tiers' native idiom.
+
+Overflow discipline: scalars travel as **uint32 split accumulators**
+``[hi, lo]`` with ``value = (hi << 16) + lo`` (:func:`pair_value`),
+because jnp has no uint64 without the global x64 switch and a single
+uint32 population wraps exactly at the 65536² whole-board scale in
+BASELINE.md.  Row partial sums are exact for any width < 2³²; the split
+accumulation is exact while ``rows ≤ 65536`` — one bound past every
+geometry the repo runs, documented here so nobody "simplifies" it back
+to one word.
+
+Everything here is pure jnp/lax on device values — no host callbacks,
+no collectives (the psum wiring for sharded runs lives in
+:mod:`gol_tpu.parallel.stats`); the analysis suite's stats-purity check
+traces these programs to prove it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.ops import bitlife
+
+# Scalar names of one chunk's stats, in emission order.  ``face_*`` are
+# the four boundary bands; 3-D volumes report the first four only.
+STATS_FIELDS = (
+    "population",
+    "births",
+    "deaths",
+    "changed",
+    "face_top",
+    "face_bottom",
+    "face_left",
+    "face_right",
+)
+
+_LO16 = np.uint32(0xFFFF)
+
+
+def sum_pair(partials: jax.Array) -> jax.Array:
+    """uint32 partial sums -> ``uint32[2]`` split accumulator [hi, lo].
+
+    Exact while the partial count stays ≤ 2¹⁶ (see module docstring);
+    reassembled by :func:`pair_value` on host or added pairwise on
+    device (psum of pairs is a pair — carries resolve at reassembly).
+    """
+    partials = partials.astype(jnp.uint32).ravel()
+    hi = jnp.sum(partials >> 16, dtype=jnp.uint32)
+    lo = jnp.sum(partials & _LO16, dtype=jnp.uint32)
+    return jnp.stack([hi, lo])
+
+
+def pair_value(pair) -> int:
+    """Host-side reassembly of a split accumulator (exact Python int)."""
+    arr = np.asarray(pair, dtype=np.uint64)
+    return (int(arr[0]) << 16) + int(arr[1])
+
+
+def stats_values(stats: dict) -> dict:
+    """Device stats dict (field -> uint32[2]) to plain Python ints."""
+    return {k: pair_value(v) for k, v in stats.items()}
+
+
+def _clamp_band(band: int, h: int, w: int) -> int:
+    return max(1, min(band, h, w))
+
+
+def dense_chunk_stats(prev: jax.Array, new: jax.Array, band: int) -> dict:
+    """Chunk stats of a dense uint8 0/1 board pair (shard-local).
+
+    ``prev`` is the chunk-start board, ``new`` the chunk-end board; both
+    are values the compiled chunk program already holds, so the
+    reductions fuse into it with no extra HBM round trip beyond keeping
+    ``prev`` live (the one cost of ``--stats``: the chunk-start buffer
+    cannot be donated to the evolution).
+    """
+    h, w = new.shape
+    band = _clamp_band(band, h, w)
+    n = new.astype(jnp.uint32)
+    flips = (prev ^ new).astype(jnp.uint32)
+    born = flips * n  # changed and now alive
+    died = flips - born
+
+    def rows(x):
+        return jnp.sum(x, axis=1, dtype=jnp.uint32)
+
+    return {
+        "population": sum_pair(rows(n)),
+        "births": sum_pair(rows(born)),
+        "deaths": sum_pair(rows(died)),
+        "changed": sum_pair(rows(flips)),
+        "face_top": sum_pair(rows(n[:band])),
+        "face_bottom": sum_pair(rows(n[-band:])),
+        "face_left": sum_pair(rows(n[:, :band])),
+        "face_right": sum_pair(rows(n[:, -band:])),
+    }
+
+
+def _col_band_masks(nw: int, band: int):
+    """uint32[nw] word masks selecting the left / right ``band`` columns.
+
+    Bit j of word k is column ``32k + j`` (the :func:`bitlife.pack`
+    layout), so the left band is the low bits of the leading words and
+    the right band the high bits of the trailing ones.
+    """
+    left = np.zeros(nw, np.uint32)
+    right = np.zeros(nw, np.uint32)
+    full, rem = divmod(band, bitlife.BITS)
+    left[:full] = np.uint32(0xFFFFFFFF)
+    right[nw - full :] = np.uint32(0xFFFFFFFF)
+    if rem:
+        left[full] = np.uint32((1 << rem) - 1)
+        right[nw - full - 1] = np.uint32(((1 << rem) - 1) << (bitlife.BITS - rem))
+    return left, right
+
+
+def packed_chunk_stats(prev: jax.Array, new: jax.Array, band: int) -> dict:
+    """Popcount-based chunk stats for the bitpacked/folded tiers.
+
+    Same contract and bit-identical values as :func:`dense_chunk_stats`
+    (pinned by the tier-equality test); the boards are packed once and
+    every count is ``lax.population_count`` over uint32 words, so the
+    flip planes are single bitwise ops and the reduce tree is 32×
+    shorter than the dense one.
+    """
+    h, w = new.shape
+    band = _clamp_band(band, h, w)
+    p = bitlife.pack(prev)
+    n = bitlife.pack(new)
+    born = n & ~p
+    died = p & ~n
+    left_mask, right_mask = _col_band_masks(n.shape[1], band)
+
+    def rows(words):
+        return jnp.sum(
+            lax.population_count(words).astype(jnp.uint32),
+            axis=1,
+            dtype=jnp.uint32,
+        )
+
+    return {
+        "population": sum_pair(rows(n)),
+        "births": sum_pair(rows(born)),
+        "deaths": sum_pair(rows(died)),
+        "changed": sum_pair(rows(born | died)),
+        "face_top": sum_pair(rows(n[:band])),
+        "face_bottom": sum_pair(rows(n[-band:])),
+        "face_left": sum_pair(rows(n & left_mask[None, :])),
+        "face_right": sum_pair(rows(n & right_mask[None, :])),
+    }
+
+
+def dense_chunk_stats3d(prev: jax.Array, new: jax.Array) -> dict:
+    """3-D volume counterpart (population/births/deaths/changed only —
+    a volume has six faces and no driver consumes per-face flux yet).
+    Per-plane uint32 partials (each < 2³²: a plane has size² cells) feed
+    the same split accumulators."""
+    n = new.astype(jnp.uint32)
+    flips = (prev ^ new).astype(jnp.uint32)
+    born = flips * n
+    died = flips - born
+
+    def planes(x):
+        return jnp.sum(x, axis=(1, 2), dtype=jnp.uint32)
+
+    return {
+        "population": sum_pair(planes(n)),
+        "births": sum_pair(planes(born)),
+        "deaths": sum_pair(planes(died)),
+        "changed": sum_pair(planes(flips)),
+    }

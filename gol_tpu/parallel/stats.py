@@ -1,0 +1,68 @@
+"""Global chunk statistics over a device mesh: shard-local reduce + psum.
+
+The sharded engines never materialize the global board anywhere (the
+no-gather discipline of the checkpoint and telemetry formats), so
+"population of the world" has to be computed the same way the world is
+computed: each shard reduces its own block
+(:mod:`gol_tpu.ops.stats`) and a ``lax.psum`` over every mesh axis turns
+the shard partials into the global value — replicated, so **every rank
+of a multi-host run reports the identical number** with no extra
+communication (the property the cross-rank population watchdog in
+``summarize`` then verifies for free).
+
+Face bands need one extra step: the global top band lives only on the
+shards in mesh row 0, so each shard's face contribution is gated by its
+``lax.axis_index`` before the psum (a 1-D row mesh leaves the width
+unsharded — every shard holds a piece of the global left/right bands and
+contributes unconditionally).
+
+The psum pairs are :func:`gol_tpu.ops.stats.sum_pair` split
+accumulators; summing pairs across R shards keeps the exactness bound of
+the single-shard case (the per-shard hi/lo are already collapsed, so the
+psum adds R words ≤ 2¹⁶ apart from the documented 65536-row bound).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gol_tpu import compat
+from gol_tpu.parallel.mesh import COLS, ROWS
+
+
+def global_stats_fn(mesh: Mesh, local_stats, band: int):
+    """``fn(prev, new) -> stats`` with globally-psummed split accumulators.
+
+    ``local_stats(prev, new, band)`` is one of the shard-local reducers
+    in :mod:`gol_tpu.ops.stats` (dense or popcount, matching the engine
+    tier).  Inputs carry the canonical board sharding; outputs are
+    replicated ``uint32[2]`` pairs.
+    """
+    two_d = COLS in mesh.axis_names
+    axes = tuple(mesh.axis_names)
+    spec = P(ROWS, COLS) if two_d else P(ROWS, None)
+
+    def shardwise(prev, new):
+        s = local_stats(prev, new, band)
+        r = lax.axis_index(ROWS)
+        gates = {
+            "face_top": r == 0,
+            "face_bottom": r == mesh.shape[ROWS] - 1,
+        }
+        if two_d:
+            c = lax.axis_index(COLS)
+            gates["face_left"] = c == 0
+            gates["face_right"] = c == mesh.shape[COLS] - 1
+        out = {}
+        for name, pair in s.items():
+            gate = gates.get(name)
+            if gate is not None:
+                pair = jnp.where(gate, pair, jnp.zeros_like(pair))
+            out[name] = lax.psum(pair, axes)
+        return out
+
+    return compat.shard_map(
+        shardwise, mesh=mesh, in_specs=(spec, spec), out_specs=P()
+    )

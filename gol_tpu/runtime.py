@@ -88,6 +88,17 @@ class GolRuntime:
     # enters a compiled program (pinned by the trace-identity test).
     telemetry_dir: Optional[str] = None
     run_id: Optional[str] = None
+    # In-graph simulation statistics (--stats): each chunk program is
+    # wrapped in fused device reductions (population, births/deaths,
+    # changed cells, boundary-band populations — psummed to the global
+    # value on sharded runs) and returns (board, stats) in one launch.
+    # Off (the default), the evolve programs are byte-identical to the
+    # stats-less build (pinned by the trace-identity test); on, the
+    # evolution itself is untouched (final grid bit-equal, pinned per
+    # tier × mesh) but the chunk-start buffer stays live for the
+    # births/deaths diff, so donation is forfeited: one extra board of
+    # HBM.  Stats land in telemetry `stats` events and in `last_stats`.
+    stats: bool = False
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -290,6 +301,9 @@ class GolRuntime:
         # loops are live (single-process runs only — see
         # checkpoint.AsyncSnapshotWriter).
         self._ckpt_writer = None
+        # Host-int stats of the last run()'s chunks (--stats mode):
+        # [{"index", "take", "generation", "population", ...}, ...].
+        self.last_stats: list = []
 
     def _resolve_auto(self) -> str:
         """Pick the fastest engine this run's geometry and mode support.
@@ -695,7 +709,14 @@ class GolRuntime:
         An :class:`~gol_tpu.telemetry.EventLog` in ``events`` receives one
         ``compile`` record per distinct chunk size (lowering and compile
         durations separately — on TPU the XLA compile dominates and is the
-        number worth tracking across rounds).
+        number worth tracking across rounds — plus the compiled program's
+        memory/cost analysis when the backend exposes it: peak HBM and
+        argument/output/temp bytes are the real scaling limit for the
+        whole-board runs, and never appeared anywhere before schema v2).
+
+        With :attr:`stats` set, each program is the stats-wrapped form
+        (:func:`gol_tpu.telemetry.stats.build_stats_evolver`) returning
+        ``(board, stats)``; off, this path is byte-for-byte the PR 2 one.
         """
         import time as time_mod
 
@@ -711,7 +732,13 @@ class GolRuntime:
             spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
         evolvers = {}
         for take in set(schedule):
-            fn, dynamic, static = self._evolve_fn(take)
+            if self.stats:
+                from gol_tpu.telemetry import stats as stats_mod
+
+                fn, dynamic = stats_mod.build_stats_evolver(self, take)
+                static = ()
+            else:
+                fn, dynamic, static = self._evolve_fn(take)
             with telemetry_mod.trace_annotation(f"gol.compile.{take}"):
                 t0 = time_mod.perf_counter()
                 lowered = fn.lower(spec, *dynamic, *static)
@@ -720,7 +747,14 @@ class GolRuntime:
                 t2 = time_mod.perf_counter()
             evolvers[take] = (compiled, dynamic)
             if events is not None:
-                events.compile_event(take, t1 - t0, t2 - t1)
+                from gol_tpu.telemetry import stats as stats_mod
+
+                events.compile_event(
+                    take,
+                    t1 - t0,
+                    t2 - t1,
+                    memory=stats_mod.compiled_memory(compiled),
+                )
         force_ready(board)
         return evolvers
 
@@ -780,6 +814,7 @@ class GolRuntime:
         from gol_tpu import telemetry as telemetry_mod
 
         sw = Stopwatch()
+        self.last_stats = []
         with sw.phase("init"):
             state = self.initial_state(pattern, resume)
             board = state.board
@@ -813,10 +848,15 @@ class GolRuntime:
                 ):
                     for i, take in enumerate(schedule):
                         compiled, dynamic = evolvers[take]
+                        dev_stats = None
                         with telemetry_mod.step_annotation("gol.chunk", i):
                             with sw.phase("total"):
                                 t0 = time_mod.perf_counter()
-                                board = compiled(board, *dynamic)
+                                out = compiled(board, *dynamic)
+                                if self.stats:
+                                    board, dev_stats = out
+                                else:
+                                    board = out
                                 force_ready(board)
                                 dt = time_mod.perf_counter() - t0
                         state = GolState.create(
@@ -831,6 +871,27 @@ class GolRuntime:
                                 self.geometry.cell_updates(take),
                                 self.chunk_utilization(take, dt),
                             )
+                        if dev_stats is not None:
+                            # The scalar fetch happens after the timed
+                            # fence (the same program already produced
+                            # them — this moves a few dozen bytes).
+                            from gol_tpu.telemetry import (
+                                stats as stats_mod,
+                            )
+
+                            vals = stats_mod.stats_values(dev_stats)
+                            self.last_stats.append(
+                                dict(
+                                    index=i,
+                                    take=take,
+                                    generation=int(state.generation),
+                                    **vals,
+                                )
+                            )
+                            if events is not None:
+                                events.stats_event(
+                                    i, take, int(state.generation), vals
+                                )
                         if self.checkpoint_every > 0:
                             with telemetry_mod.trace_annotation(
                                 "gol.checkpoint.save"

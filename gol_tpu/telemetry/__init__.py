@@ -12,7 +12,11 @@ of it:
   file, so multi-host runs never gather (the same no-gather discipline as
   the sharded checkpoint format).  Record types: ``run_header``,
   ``compile``, ``chunk``, ``guard_audit``, ``checkpoint``, ``bench_row``,
-  ``summary`` — see ``REQUIRED_FIELDS`` for the schema.
+  ``summary``, and (schema v2) ``stats`` — see ``REQUIRED_FIELDS``.
+  ``--stats`` chunks carry in-graph simulation reductions
+  (:mod:`gol_tpu.telemetry.stats`), ``compile`` events the compiled
+  program's memory footprint, and ``python -m gol_tpu.telemetry watch``
+  tails a live run (:mod:`gol_tpu.telemetry.watch`).
 - :func:`roofline_utilization` stamps each chunk with how far the run sits
   from the VPU roofline the repo already models
   (:func:`gol_tpu.utils.roofline.xla_flops_model` per-chip FLOPs over the
@@ -41,7 +45,13 @@ import os
 import time
 from typing import Dict, Optional
 
-SCHEMA_VERSION = 1
+# Version 2 (this round) adds the ``stats`` event type and optional
+# ``memory``/``cost`` blocks on ``compile`` events.  v1 streams (PR 2
+# runs) stay readable: every v1 event type and field survives unchanged,
+# so consumers only ever *gain* records (back-compat pinned by the
+# committed v1 fixture test).
+SCHEMA_VERSION = 2
+SUPPORTED_SCHEMAS = (1, 2)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -70,6 +80,14 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     ),
     # One per bench-harness measurement row (halobench/scalebench).
     "bench_row": frozenset({"bench", "data"}),
+    # v2: one per executed chunk in --stats mode — in-graph simulation
+    # reductions (global values on sharded runs via psum, so every
+    # rank's record must agree).  "faces" is a dict of boundary-band
+    # populations ({top,bottom,left,right}; empty for 3-D volumes).
+    "stats": frozenset(
+        {"index", "take", "generation", "population", "births", "deaths",
+         "changed", "faces"}
+    ),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -101,10 +119,10 @@ def validate_record(rec: dict) -> None:
     missing = REQUIRED_FIELDS[event] - rec.keys()
     if missing:
         raise SchemaError(f"{event}: missing fields {sorted(missing)}")
-    if event == "run_header" and rec["schema"] != SCHEMA_VERSION:
+    if event == "run_header" and rec["schema"] not in SUPPORTED_SCHEMAS:
         raise SchemaError(
-            f"run_header: schema {rec['schema']!r} != supported "
-            f"{SCHEMA_VERSION}"
+            f"run_header: schema {rec['schema']!r} not in supported "
+            f"{SUPPORTED_SCHEMAS}"
         )
 
 
@@ -138,6 +156,16 @@ class EventLog:
         )
         os.makedirs(directory, exist_ok=True)
         self.path = rank_file(directory, self.run_id, self.process_index)
+        # Rerunning with an existing --run-id must not clobber (or, worse,
+        # interleave with) the old stream: the previous rank file is
+        # rotated to ``<path>.<n>`` — a suffix the ``summarize`` glob
+        # (``*.jsonl``) deliberately does not match, so rotated history
+        # stays on disk without polluting the merge.
+        if os.path.exists(self.path):
+            n = 1
+            while os.path.exists(f"{self.path}.{n}"):
+                n += 1
+            os.replace(self.path, f"{self.path}.{n}")
         self._f = open(self.path, "w")
 
     # -- envelope -----------------------------------------------------------
@@ -174,10 +202,21 @@ class EventLog:
         )
 
     def compile_event(
-        self, chunk: int, lower_s: float, compile_s: float
+        self,
+        chunk: int,
+        lower_s: float,
+        compile_s: float,
+        memory: Optional[dict] = None,
     ) -> None:
+        """``memory`` (v2, optional): the compiled program's
+        ``memory_analysis``/``cost_analysis`` distillation
+        (:func:`gol_tpu.telemetry.stats.compiled_memory`) — peak HBM and
+        argument/output/temp bytes per chunk size, the actual scaling
+        limit compile *durations* never showed."""
+        extra = {} if memory is None else {"memory": memory}
         self.emit(
-            "compile", chunk=chunk, lower_s=lower_s, compile_s=compile_s
+            "compile", chunk=chunk, lower_s=lower_s, compile_s=compile_s,
+            **extra,
         )
 
     def chunk_event(
@@ -232,6 +271,32 @@ class EventLog:
 
     def bench_row(self, bench: str, data: dict) -> None:
         self.emit("bench_row", bench=bench, data=data)
+
+    def stats_event(
+        self, index: int, take: int, generation: int, values: dict
+    ) -> None:
+        """One chunk's in-graph simulation stats (v2; ``--stats`` mode).
+
+        ``values`` maps :data:`gol_tpu.ops.stats.STATS_FIELDS` (or the
+        3-D subset) to host ints; ``face_*`` entries fold into the
+        ``faces`` dict.
+        """
+        faces = {
+            k[len("face_"):]: v
+            for k, v in values.items()
+            if k.startswith("face_")
+        }
+        self.emit(
+            "stats",
+            index=index,
+            take=take,
+            generation=generation,
+            population=values["population"],
+            births=values["births"],
+            deaths=values["deaths"],
+            changed=values["changed"],
+            faces=faces,
+        )
 
     def summary(self, report) -> None:
         """The final record, mirroring :class:`~gol_tpu.utils.timing.

@@ -1,4 +1,5 @@
-"""``python -m gol_tpu.telemetry {summarize <dir> | diff <a> <b>}``."""
+"""``python -m gol_tpu.telemetry
+{summarize <dir> | diff <a> <b> | watch <dir>}``."""
 
 import sys
 

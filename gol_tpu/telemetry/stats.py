@@ -1,0 +1,148 @@
+"""``--stats`` mode: fuse simulation statistics onto each chunk program.
+
+:func:`build_stats_evolver` wraps a runtime's evolve program for one
+chunk size in the in-graph reductions of :mod:`gol_tpu.ops.stats` /
+:mod:`gol_tpu.parallel.stats`, so the compiled chunk returns
+``(new_board, stats)`` in a single launch — population, births, deaths,
+changed cells and the four boundary-band populations, with no extra
+device→host grid pull and no second program dispatch.  Tier dispatch
+mirrors the runtime's engine resolution:
+
+- dense / Pallas-dense → :func:`~gol_tpu.ops.stats.dense_chunk_stats`;
+- bitpack / pallas_bitpack → :func:`~gol_tpu.ops.stats.
+  packed_chunk_stats` (popcount over packed words);
+- any mesh in explicit/overlap mode → the shard-map+psum wrapper
+  (:func:`gol_tpu.parallel.stats.global_stats_fn`), so every rank of a
+  multi-host run reports the replicated *global* value;
+- ``shard_mode='auto'`` → plain global reductions: the auto-SPMD
+  philosophy (annotate shardings, let XLA derive the collectives)
+  applies to the stats exactly as it does to the halo exchange.
+
+Two invariants, both pinned by tests/test_stats.py and the analysis
+suite's stats-purity check:
+
+- **stats off is byte-identical**: the wrapper is only ever built when
+  ``GolRuntime.stats`` is set — the stats-off path does not pass
+  through this module at all, so PR 2's trace-identity pin holds by
+  construction;
+- **stats on cannot alter evolution**: the wrapped program calls the
+  *unmodified* engine program and reduces its input/output values; the
+  final grid is bit-equal with stats on/off for every tier × mesh.
+
+The one real cost: the chunk-start board must stay live for the
+births/deaths diff, so the wrapper does not donate its input — stats
+mode holds one extra board of HBM (documented in OBSERVABILITY.md).
+
+:func:`compiled_memory` is the compile-time half of the observability
+story: ``Compiled.memory_analysis()`` / ``cost_analysis()`` distilled to
+a JSON-ready dict (peak HBM, argument/output/temp bytes, flops) that
+rides on ``compile`` events — the compiled program's actual HBM
+footprint is the scaling limit for whole-board runs, and until now the
+repo recorded compile *durations* but never compile *sizes*.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from gol_tpu.ops import stats as ops_stats
+from gol_tpu.ops.stats import STATS_FIELDS, pair_value, stats_values  # noqa: F401
+
+_PACKED_TIERS = ("bitpack", "pallas_bitpack")
+
+
+def build_stats_evolver(rt, steps: int):
+    """``(jitted_fn, dynamic_args)`` for one stats-mode chunk program.
+
+    The full call is ``fn(board, *dynamic_args)`` returning
+    ``(new_board, stats)`` where ``stats`` maps
+    :data:`~gol_tpu.ops.stats.STATS_FIELDS` to ``uint32[2]`` split
+    accumulators (:func:`~gol_tpu.ops.stats.stats_values` reassembles
+    host ints).  Statics are closed over so the runtime's AOT
+    lower-from-spec path works unchanged.
+    """
+    fn, dynamic, static = rt._evolve_fn(steps)
+    band = max(1, rt.halo_depth)
+    local = (
+        ops_stats.packed_chunk_stats
+        if rt._resolved in _PACKED_TIERS
+        else ops_stats.dense_chunk_stats
+    )
+    if rt.mesh is not None and rt.shard_mode != "auto":
+        from gol_tpu.parallel import stats as par_stats
+
+        stats_fn = par_stats.global_stats_fn(rt.mesh, local, band)
+    elif rt.mesh is not None:
+        # auto-SPMD: reductions on the logically-global sharded arrays;
+        # XLA's partitioner derives the all-reduces, and the scalar
+        # outputs replicate (the dense reducer — auto mode is dense-only).
+        stats_fn = lambda p, n: ops_stats.dense_chunk_stats(p, n, band)
+    else:
+        stats_fn = lambda p, n: local(p, n, band)
+
+    def evolve_with_stats(board, *dyn):
+        new = fn(board, *dyn, *static)
+        return new, stats_fn(board, new)
+
+    return jax.jit(evolve_with_stats), dynamic
+
+
+def wrap_evolver_3d(fn, static):
+    """3-D counterpart: wrap a volume evolver in the volume reductions.
+
+    ``fn(vol, *static)`` is one of the cli3d engine programs; the
+    wrapped program returns ``(new_vol, stats)`` with the four scalar
+    fields of :func:`~gol_tpu.ops.stats.dense_chunk_stats3d`.  Sharded
+    volumes reduce at the global-array level (XLA inserts the
+    collectives; scalars replicate to every process).
+    """
+
+    def evolve_with_stats(vol):
+        new = fn(vol, *static)
+        return new, ops_stats.dense_chunk_stats3d(vol, new)
+
+    return jax.jit(evolve_with_stats)
+
+
+_MEMORY_FIELDS = {
+    "peak_bytes": "peak_memory_in_bytes",
+    "argument_bytes": "argument_size_in_bytes",
+    "output_bytes": "output_size_in_bytes",
+    "temp_bytes": "temp_size_in_bytes",
+    "alias_bytes": "alias_size_in_bytes",
+    "generated_code_bytes": "generated_code_size_in_bytes",
+}
+
+
+def compiled_memory(compiled) -> Optional[dict]:
+    """``memory_analysis()``/``cost_analysis()`` as a JSON-ready dict.
+
+    Returns ``None`` when the backend exposes neither (the event then
+    simply carries no memory block).  Fields absent or non-numeric on a
+    backend are omitted rather than zero-filled — a missing number and a
+    measured zero are different claims.
+    """
+    out = {}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        for key, attr in _MEMORY_FIELDS.items():
+            val = getattr(ma, attr, None)
+            if isinstance(val, (int, float)):
+                out[key] = int(val)
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        ca = None
+    if isinstance(ca, list):
+        ca = ca[0] if ca else None
+    if ca:
+        for key, name in (("flops", "flops"), ("bytes_accessed", "bytes accessed")):
+            val = dict(ca).get(name)
+            if isinstance(val, (int, float)):
+                out[key] = float(val)
+    return out or None

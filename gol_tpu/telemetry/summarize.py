@@ -16,6 +16,20 @@ table (with the roofline-utilization column), and flags anomalies:
 - **chunk/total drift** — per-chunk wall times not summing to the
   summary's total phase within 5%.
 
+Schema-v2 ``stats`` events add the simulation watchdogs (shared with
+``watch`` — a multi-hour run's extinction should be caught live, not at
+the post-mortem):
+
+- **extinction** — the population hits zero after having been nonzero;
+- **all-static fixpoint** — a whole chunk changed no cell: Life is
+  deterministic, so the world will never change again (an oscillator
+  still flips cells every chunk — only a true fixpoint trips this);
+- **cross-rank population disagreement** — ``stats`` values are global
+  (psummed over the mesh), so two ranks reporting different populations
+  for the same generation mean a rank computed a different world — the
+  same SDC signature as audit-fingerprint divergence, caught from the
+  stats stream alone.
+
 ``diff <dir_a> <dir_b>`` compares two runs phase-by-phase and
 chunk-size-by-chunk-size — the missing tool behind BENCH_r* trajectory
 analysis (was: eyeballing two JSON blobs).
@@ -169,6 +183,9 @@ def find_anomalies(run: Run) -> List[str]:
                 "broken collective)"
             )
 
+    # Simulation watchdogs over the --stats stream (schema v2).
+    flags.extend(stats_watchdogs(run))
+
     # Per-chunk walls must account for the summary's total phase.
     summ = run.summary_record
     if summ is not None and chunks:
@@ -178,6 +195,57 @@ def find_anomalies(run: Run) -> List[str]:
             flags.append(
                 f"chunk/total drift: per-chunk walls sum to {acc:.4f}s "
                 f"but the total phase is {total:.4f}s"
+            )
+    return flags
+
+
+def stats_watchdogs(run: Run) -> List[str]:
+    """Extinction / static-fixpoint / cross-rank disagreement flags.
+
+    Shared verbatim by ``summarize`` and the live ``watch`` dashboard so
+    the two tools can never disagree about what "unhealthy" means.
+    """
+    flags: List[str] = []
+    rank0 = min(run.ranks, default=0)
+    stats = run.records("stats", rank=rank0)
+
+    seen_alive = False
+    flagged_extinct = False
+    for s in stats:
+        if s["population"] > 0:
+            seen_alive = True
+        elif seen_alive and not flagged_extinct:
+            flags.append(
+                f"extinction: population hit 0 at generation "
+                f"{s['generation']} (was alive earlier) — the run can be "
+                "stopped, nothing further will happen"
+            )
+            flagged_extinct = True
+    for s in stats:
+        if s["take"] > 0 and s["changed"] == 0:
+            flags.append(
+                f"all-static fixpoint at generation {s['generation']}: no "
+                f"cell changed across the {s['take']}-generation chunk — "
+                "the world is frozen (deterministic rule: it stays frozen)"
+            )
+            break  # one flag; every later chunk is the same fixpoint
+
+    # Cross-rank disagreement: stats are global (psummed), so every
+    # rank must report the identical population per generation.
+    by_gen: Dict[int, Dict[int, int]] = {}
+    for rank in sorted(run.ranks):
+        for s in run.records("stats", rank=rank):
+            by_gen.setdefault(s["generation"], {})[rank] = s["population"]
+    for gen, pops in sorted(by_gen.items()):
+        if len(set(pops.values())) > 1:
+            detail = ", ".join(
+                f"rank{r}={p}" for r, p in sorted(pops.items())
+            )
+            flags.append(
+                f"cross-rank population disagreement at generation {gen}: "
+                f"{detail} — the psummed global value must be identical "
+                "everywhere; a rank computed a different world (SDC or a "
+                "broken collective)"
             )
     return flags
 
@@ -222,6 +290,29 @@ def render_run(run: Run, out) -> None:
                 f"  compile {c['compile_s']:.3f}s",
                 file=out,
             )
+        if any(c.get("memory") for c in compiles):
+            # Compiled-program footprint per chunk size (schema v2): the
+            # argument/output/temp/peak bytes XLA reports — the number
+            # that actually caps whole-board geometry, next to the
+            # durations that never showed it.
+            print(
+                "  memory: chunk      arg_B      out_B     temp_B"
+                "     peak_B    alias_B",
+                file=out,
+            )
+            for c in compiles:
+                m = c.get("memory") or {}
+
+                def cell(key, m=m):
+                    v = m.get(key)
+                    return "-" if v is None else str(v)
+
+                print(
+                    f"  {c['chunk']:>12} {cell('argument_bytes'):>10} "
+                    f"{cell('output_bytes'):>10} {cell('temp_bytes'):>10} "
+                    f"{cell('peak_bytes'):>10} {cell('alias_bytes'):>10}",
+                    file=out,
+                )
 
     chunks = run.records("chunk", rank=rank0)
     if chunks:
@@ -235,6 +326,26 @@ def render_run(run: Run, out) -> None:
                 f"  {c['index']:>5} {c['take']:>8} {c['generation']:>9} "
                 f"{c['wall_s']:>11.4f}  {_fmt_rate(c['updates_per_sec']):>12}"
                 f"  {_fmt_util(c.get('roofline_util')):>8}",
+                file=out,
+            )
+
+    stats = run.records("stats", rank=rank0)
+    if stats:
+        print(
+            "  stats     gen  population     births     deaths    "
+            "changed  faces(t/b/l/r)",
+            file=out,
+        )
+        for s in stats:
+            f = s.get("faces") or {}
+            faces = "/".join(
+                str(f[k]) for k in ("top", "bottom", "left", "right")
+                if k in f
+            ) or "-"
+            print(
+                f"  {s['generation']:>11} {s['population']:>11} "
+                f"{s['births']:>10} {s['deaths']:>10} {s['changed']:>10}"
+                f"  {faces}",
                 file=out,
             )
 
@@ -383,10 +494,33 @@ def main(argv=None) -> int:
     pd = sub.add_parser("diff", help="compare two telemetry runs")
     pd.add_argument("dir_a")
     pd.add_argument("dir_b")
+    pw = sub.add_parser(
+        "watch", help="live dashboard tailing a run's rank files"
+    )
+    pw.add_argument("directory")
+    pw.add_argument("--run-id", default=None, metavar="NAME")
+    pw.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECONDS"
+    )
+    pw.add_argument(
+        "--once",
+        action="store_true",
+        help="render a single frame and exit (tests, cron probes)",
+    )
     ns = p.parse_args(list(sys.argv[1:] if argv is None else argv))
     try:
         if ns.command == "summarize":
             return summarize(ns.directory, sys.stdout)
+        if ns.command == "watch":
+            from gol_tpu.telemetry import watch as watch_mod
+
+            return watch_mod.watch(
+                ns.directory,
+                sys.stdout,
+                run_id=ns.run_id,
+                interval=ns.interval,
+                frames=1 if ns.once else None,
+            )
         return diff(ns.dir_a, ns.dir_b, sys.stdout)
     except (SchemaError, OSError) as e:
         print(f"telemetry: {e}", file=sys.stderr)

@@ -1,0 +1,258 @@
+"""``python -m gol_tpu.telemetry watch <dir>`` — live run dashboard.
+
+``summarize`` is the post-mortem; ``watch`` is the same telemetry read
+*while the run is alive*.  The ROADMAP north star is pod-scale multi-hour
+runs, and the failure mode this tool exists for is concrete: a 65536²
+run extinguishes (or freezes into a fixpoint, or a rank starts reporting
+a different world) three hours in, and nobody notices until the job's
+wall-clock budget is gone.  ``watch`` tails the per-rank JSONL files the
+run is already writing — read-only, no coordination with the run, works
+from any machine that sees the telemetry directory — and renders one
+terminal frame per poll:
+
+- progress: chunks done, current generation, last chunk wall/rate and
+  roofline fraction, chunk throughput over the recent window;
+- population trend: latest value plus a sparkline of the ``stats``
+  stream (the extinction/divergence signal at a glance);
+- anomaly flags: **exactly** ``summarize``'s rules
+  (:func:`~gol_tpu.telemetry.summarize.find_anomalies`, which includes
+  the stats watchdogs) — the live view and the post-mortem can never
+  disagree about what "unhealthy" means.
+
+Tailing discipline: files are read incrementally from per-file offsets,
+only up to the last complete line (the writer may be mid-record), and a
+torn/invalid line is counted and skipped instead of killing the watcher
+— a live tool that dies on one bad record is worse than none.  This is
+deliberately *weaker* than ``summarize``'s exit-2 validation: the
+post-mortem gate stays strict.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from gol_tpu.telemetry import SchemaError, validate_record
+from gol_tpu.telemetry import summarize as summ_mod
+
+_BARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[int], width: int = 40) -> str:
+    """Population trend as unicode block bars (min..max normalized)."""
+    vals = values[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi == lo:
+        return _BARS[3] * len(vals)
+    return "".join(
+        _BARS[int((v - lo) * (len(_BARS) - 1) / (hi - lo))] for v in vals
+    )
+
+
+class _Tail:
+    """Incremental reader of one rank file (offset-tracked)."""
+
+    def __init__(self, path: str, rank: int) -> None:
+        self.path = path
+        self.rank = rank
+        self.offset = 0
+
+    def read_new(self) -> tuple:
+        """(new valid records, invalid-line count) since the last poll."""
+        recs, bad = [], 0
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return recs, bad
+        if size <= self.offset:
+            return recs, bad
+        with open(self.path) as f:
+            f.seek(self.offset)
+            data = f.read(size - self.offset)
+        cut = data.rfind("\n")
+        if cut < 0:  # no complete new line yet
+            return recs, bad
+        self.offset += cut + 1
+        for line in data[: cut + 1].splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                validate_record(rec)
+            except (json.JSONDecodeError, SchemaError):
+                bad += 1
+                continue
+            recs.append(rec)
+        return recs, bad
+
+
+class Watcher:
+    """Accumulated state of one telemetry directory across polls."""
+
+    def __init__(self, directory: str, run_id: Optional[str] = None) -> None:
+        self.directory = directory
+        self.run_id = run_id
+        self.tails: Dict[str, _Tail] = {}
+        self.runs: Dict[str, summ_mod.Run] = {}
+        self.invalid_lines = 0
+        self.polls = 0
+
+    def poll(self) -> None:
+        self.polls += 1
+        for path in sorted(
+            glob.glob(os.path.join(self.directory, "*.jsonl"))
+        ):
+            m = summ_mod._RANK_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            run_id, rank = m.group("run"), int(m.group("rank"))
+            if self.run_id is not None and run_id != self.run_id:
+                continue
+            tail = self.tails.get(path)
+            if tail is None:
+                tail = self.tails[path] = _Tail(path, rank)
+            recs, bad = tail.read_new()
+            self.invalid_lines += bad
+            if recs:
+                run = self.runs.setdefault(run_id, summ_mod.Run(run_id))
+                run.ranks.setdefault(rank, []).extend(recs)
+
+    def current_run(self) -> Optional[summ_mod.Run]:
+        if not self.runs:
+            return None
+        return summ_mod.latest_run(self.runs)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return "-"  # pragma: no cover
+
+
+def render_frame(w: Watcher, out) -> None:
+    stamp = time.strftime("%H:%M:%S")
+    run = w.current_run()
+    if run is None:
+        print(
+            f"watch {w.directory} @ {stamp} (poll {w.polls}): waiting for "
+            "telemetry files...",
+            file=out,
+        )
+        return
+    head = run.header or {}
+    cfg = head.get("config", {})
+    print(
+        f"watch {w.directory} — run {run.run_id} @ {stamp} "
+        f"(poll {w.polls})",
+        file=out,
+    )
+    print(
+        f"  ranks: {len(run.ranks)}/{head.get('process_count', '?')}  "
+        f"backend: {head.get('backend', '?')}  "
+        f"engine: {cfg.get('resolved_engine', cfg.get('engine', '?'))}  "
+        f"mesh: {cfg.get('mesh')}",
+        file=out,
+    )
+
+    rank0 = min(run.ranks, default=0)
+    chunks = run.records("chunk", rank=rank0)
+    if chunks:
+        last = chunks[-1]
+        line = (
+            f"  progress: {len(chunks)} chunks, generation "
+            f"{last['generation']}; last {last['wall_s']:.4f}s "
+            f"{last['updates_per_sec']:.3e} updates/s"
+        )
+        if last.get("roofline_util") is not None:
+            line += f"  roofline {summ_mod._fmt_util(last['roofline_util']).strip()}"
+        print(line, file=out)
+        recent = chunks[-10:]
+        span = recent[-1]["t"] - recent[0]["t"]
+        if len(recent) > 1 and span > 0:
+            print(
+                f"  rate: {60 * (len(recent) - 1) / span:.1f} chunks/min "
+                f"over the last {len(recent)}",
+                file=out,
+            )
+
+    stats = run.records("stats", rank=rank0)
+    if stats:
+        pops = [s["population"] for s in stats]
+        last = stats[-1]
+        print(
+            f"  population: {last['population']} {sparkline(pops)}  "
+            f"(births {last['births']} deaths {last['deaths']} changed "
+            f"{last['changed']} over the last chunk)",
+            file=out,
+        )
+
+    mems = [
+        c.get("memory")
+        for c in run.records("compile", rank=rank0)
+        if c.get("memory")
+    ]
+    if mems:
+        peak = max(
+            mems, key=lambda m: m.get("peak_bytes") or m.get("temp_bytes") or 0
+        )
+        print(
+            f"  compiled memory: peak {_fmt_bytes(peak.get('peak_bytes'))} "
+            f"arg {_fmt_bytes(peak.get('argument_bytes'))} "
+            f"temp {_fmt_bytes(peak.get('temp_bytes'))}",
+            file=out,
+        )
+
+    if run.summary_record is not None:
+        s = run.summary_record
+        print(
+            f"  FINISHED: {s['duration_s']:.4f}s, "
+            f"{s['updates_per_sec']:.3e} updates/s",
+            file=out,
+        )
+    if w.invalid_lines:
+        print(f"  torn/invalid lines skipped: {w.invalid_lines}", file=out)
+    for flag in summ_mod.find_anomalies(run):
+        print(f"  ANOMALY: {flag}", file=out)
+
+
+def watch(
+    directory: str,
+    out,
+    run_id: Optional[str] = None,
+    interval: float = 2.0,
+    frames: Optional[int] = None,
+    clear: Optional[bool] = None,
+) -> int:
+    """Poll-and-render loop.  ``frames=None`` runs until Ctrl-C;
+    ``frames=1`` is the ``--once`` snapshot mode (tests, cron).
+    ``clear`` defaults to "is a tty" — piped output gets appended frames
+    instead of ANSI clears."""
+    w = Watcher(directory, run_id=run_id)
+    if clear is None:
+        clear = bool(getattr(out, "isatty", lambda: False)())
+    n = 0
+    try:
+        while True:
+            w.poll()
+            if clear:
+                out.write("\x1b[2J\x1b[H")
+            render_frame(w, out)
+            try:
+                out.flush()
+            except OSError:  # pragma: no cover - closed pipe
+                return 0
+            n += 1
+            if frames is not None and n >= frames:
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:  # pragma: no cover - interactive exit
+        return 0

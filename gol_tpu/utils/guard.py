@@ -334,6 +334,13 @@ def run_guarded(
     """
     from gol_tpu import telemetry as telemetry_mod
 
+    if getattr(rt, "stats", False):
+        raise ValueError(
+            "--stats applies to unguarded runs: the guard's audit already "
+            "reports population/fingerprint per chunk, and its rollback "
+            "replay consumes the evolvers' donated buffers that stats "
+            "mode must keep alive"
+        )
     sw = Stopwatch()
     guard = GuardReport()
     with sw.phase("init"):

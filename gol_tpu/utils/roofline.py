@@ -118,6 +118,23 @@ def xla_flops_model(
         gens = 1  # single-device fori body is one generation
     return XLA_DENSE_FLOPS_PER_CELL * shard_cells * gens
 
+def xla_bytes_model(engine: str, shard_cells: int) -> float:
+    """Predicted I/O bytes of one compiled evolve (argument + output).
+
+    Every engine tier keeps the dense-uint8-in/dense-uint8-out contract,
+    so the compiled program's argument+output residency is 2 bytes per
+    shard cell regardless of the packed interior (whose word double
+    buffer is a *temp*, not an I/O argument).  This is the byte-side
+    twin of :func:`xla_flops_model`: ``Compiled.memory_analysis()``'s
+    argument/output sizes are gated against it within
+    :data:`XLA_COST_DRIFT` (2×) for the dense tier — slack for XLA's
+    padding/bookkeeping buffers, tight enough that a dropped donation or
+    an accidental widening (uint8 → int32 quadruples it) cannot hide.
+    """
+    del engine  # one I/O contract across tiers; kept for symmetry
+    return 2.0 * shard_cells
+
+
 # 2-D B3/S23 fused kernel, per word (see module docstring for the audit).
 OPS_2D_HSUM_PER_EXT_ROW = 15
 OPS_2D_HSUM_PER_EXT_ROW_FOLDED = 19

@@ -7,26 +7,37 @@
 #                       proven from traced programs, CPU-only)
 #   3. telemetry smoke (tiny run with --telemetry; summarize must
 #                       schema-validate the stream and exit 0)
-#   4. tier-1 tests    (the exact ROADMAP.md command)
+#   4. stats smoke     (same run with --stats; summarize must exit 0
+#                       and report a population row)
+#   5. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/4] lint =="
+echo "== [1/5] lint =="
 bash scripts/lint.sh
 
-echo "== [2/4] static verifier (gol_tpu.analysis) =="
+echo "== [2/5] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/4] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/5] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/4] tier-1 tests =="
+echo "== [4/5] stats smoke (in-graph simulation statistics) =="
+sdir="$(mktemp -d)"
+trap 'rm -rf "$tdir" "$sdir"' EXIT
+JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
+    --telemetry "$sdir" --run-id statsmoke --stats > /dev/null
+JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
+    | tee /tmp/_stats_smoke.log
+grep -q "stats     gen" /tmp/_stats_smoke.log
+
+echo "== [5/5] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

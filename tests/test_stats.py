@@ -1,0 +1,394 @@
+"""In-graph chunk statistics (``--stats`` / ``GolRuntime.stats``).
+
+The acceptance pins of the stats subsystem:
+
+- **evolution untouched**: stats on ⇒ final grid bit-equal to stats off,
+  for every engine tier × mesh none/1d/2d the CPU backend dispatches
+  (the stats wrapper calls the unmodified engine program);
+- **values honest**: the emitted population equals an independent
+  host-side (NumPy) recount of the final grid, and every field —
+  births/deaths/changed/faces — matches a NumPy model of the chunk diff,
+  identically for the dense and popcount (packed) reducers;
+- **global on meshes**: sharded runs report the psummed world value,
+  not a shard's (and the real 2-process test asserts both ranks emit
+  the identical number);
+- **memory introspection**: the dense tier's compiled argument+output
+  bytes sit within 2× of ``roofline.xla_bytes_model`` (the byte-side
+  twin of the verifier's FLOP gate);
+- **mode hygiene**: stats mode excludes the guard, and the CLI requires
+  a telemetry sink.
+
+(The stats-off trace-identity pin lives in tests/test_telemetry.py —
+the stats-off path does not pass through the stats module at all.)
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu.models import patterns
+from gol_tpu.models.state import Geometry
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.runtime import GolRuntime
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _mesh(kind):
+    if kind == "none":
+        return None
+    if kind == "1d":
+        return mesh_mod.make_mesh_1d(4)
+    return mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+
+
+def _np_chunk_stats(prev, new, band=1):
+    """Independent NumPy model of one chunk's stats fields."""
+    prev = np.asarray(prev, dtype=np.int64)
+    new = np.asarray(new, dtype=np.int64)
+    flips = prev ^ new
+    return {
+        "population": int(new.sum()),
+        "births": int((flips & new).sum()),
+        "deaths": int((flips & prev).sum()),
+        "changed": int(flips.sum()),
+        "face_top": int(new[:band].sum()),
+        "face_bottom": int(new[-band:].sum()),
+        "face_left": int(new[:, :band].sum()),
+        "face_right": int(new[:, -band:].sum()),
+    }
+
+
+# -- evolution untouched: tier × mesh bit-equality ---------------------------
+
+
+@pytest.mark.parametrize(
+    "engine,mesh_kind",
+    [
+        ("dense", "none"),
+        ("bitpack", "none"),
+        ("pallas", "none"),
+        ("pallas_bitpack", "none"),
+        ("dense", "1d"),
+        ("bitpack", "1d"),
+        ("pallas_bitpack", "1d"),
+        ("dense", "2d"),
+        ("bitpack", "2d"),
+    ],
+)
+def test_stats_on_final_grid_bit_equal(engine, mesh_kind):
+    kw = dict(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine=engine,
+        mesh=_mesh(mesh_kind),
+    )
+    _, state_off = GolRuntime(**kw).run(pattern=4, iterations=8)
+    rt_on = GolRuntime(**kw, stats=True)
+    _, state_on = rt_on.run(pattern=4, iterations=8)
+    np.testing.assert_array_equal(
+        np.asarray(state_off.board), np.asarray(state_on.board)
+    )
+    # The emitted population is the whole world's, recounted on host.
+    assert rt_on.last_stats, "stats mode produced no chunk stats"
+    assert rt_on.last_stats[-1]["population"] == int(
+        np.asarray(state_on.board, dtype=np.int64).sum()
+    )
+
+
+def test_stats_on_final_grid_bit_equal_pallas_2d():
+    """The remaining tier×mesh cell: the sharded Pallas engine on a 2-D
+    block mesh needs ≥ 2 packed words per shard, hence size 128."""
+    kw = dict(
+        geometry=Geometry(size=128, num_ranks=1),
+        engine="pallas_bitpack",
+        mesh=_mesh("2d"),
+    )
+    _, state_off = GolRuntime(**kw).run(pattern=6, iterations=8)
+    rt_on = GolRuntime(**kw, stats=True)
+    _, state_on = rt_on.run(pattern=6, iterations=8)
+    np.testing.assert_array_equal(
+        np.asarray(state_off.board), np.asarray(state_on.board)
+    )
+    assert rt_on.last_stats[-1]["population"] == int(
+        np.asarray(state_on.board, dtype=np.int64).sum()
+    )
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(engine="dense", halo_mode="stale_t0"),
+        dict(engine="bitpack", rule="B36/S23"),
+    ],
+)
+def test_stats_on_special_modes_bit_equal(kw):
+    geom = (
+        Geometry(size=16, num_ranks=4)
+        if kw.get("halo_mode") == "stale_t0"
+        else Geometry(size=64, num_ranks=1)
+    )
+    _, state_off = GolRuntime(geometry=geom, **kw).run(
+        pattern=1, iterations=6
+    )
+    rt_on = GolRuntime(geometry=geom, **kw, stats=True)
+    _, state_on = rt_on.run(pattern=1, iterations=6)
+    np.testing.assert_array_equal(
+        np.asarray(state_off.board), np.asarray(state_on.board)
+    )
+    assert rt_on.last_stats[-1]["population"] == int(
+        np.asarray(state_on.board, dtype=np.int64).sum()
+    )
+
+
+# -- values honest: every field vs the NumPy model ---------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "bitpack"])
+@pytest.mark.parametrize("pattern", [4, 6])
+def test_stats_fields_match_numpy_model(engine, pattern):
+    """Single-chunk run: prev is the pattern-init board, so every field
+    (births/deaths/changed/faces included) has an independent oracle —
+    and dense vs popcount reducers must agree with it identically.
+    Pattern 4 (wrap-spanning corner blinker) puts live cells in every
+    boundary band; pattern 6 (r-pentomino) churns births/deaths."""
+    geom = Geometry(size=64, num_ranks=1)
+    rt = GolRuntime(geometry=geom, engine=engine, stats=True)
+    _, state = rt.run(pattern=pattern, iterations=5)
+    board0 = patterns.init_global(pattern, 64, 1)
+    expected = _np_chunk_stats(board0, np.asarray(state.board))
+    (chunk_stats,) = rt.last_stats
+    got = {k: chunk_stats[k] for k in expected}
+    assert got == expected
+
+
+def test_stats_global_on_mesh_matches_numpy_model():
+    """Sharded run (2-D mesh): the psummed values are the *global*
+    board's, identical to an unsharded NumPy recount — including the
+    face bands that live on boundary shards only."""
+    geom = Geometry(size=64, num_ranks=1)
+    rt = GolRuntime(geometry=geom, engine="bitpack", mesh=_mesh("2d"),
+                    stats=True)
+    _, state = rt.run(pattern=4, iterations=5)
+    board0 = patterns.init_global(4, 64, 1)
+    expected = _np_chunk_stats(board0, np.asarray(state.board))
+    (chunk_stats,) = rt.last_stats
+    assert {k: chunk_stats[k] for k in expected} == expected
+
+
+def test_stats_band_follows_halo_depth():
+    """The face bands are ``halo_depth`` deep — the cells the next
+    exchange ships."""
+    geom = Geometry(size=64, num_ranks=1)
+    rt = GolRuntime(
+        geometry=geom, engine="dense", mesh=_mesh("1d"), halo_depth=2,
+        stats=True,
+    )
+    _, state = rt.run(pattern=4, iterations=4)
+    board0 = patterns.init_global(4, 64, 1)
+    expected = _np_chunk_stats(board0, np.asarray(state.board), band=2)
+    (chunk_stats,) = rt.last_stats
+    assert {k: chunk_stats[k] for k in expected} == expected
+
+
+def test_split_accumulator_exact_above_16_bits():
+    """Populations past 2¹⁶ must survive the uint32 [hi, lo] pair —
+    an all-ones 512×512 board is 262144 > 2¹⁶ live cells."""
+    from gol_tpu.ops import stats as ops_stats
+
+    board = np.ones((512, 512), np.uint8)
+    dev = jax.device_put(board)
+    got = ops_stats.stats_values(
+        jax.jit(lambda p, n: ops_stats.dense_chunk_stats(p, n, 1))(dev, dev)
+    )
+    assert got["population"] == 512 * 512
+    assert got["changed"] == 0
+    got_packed = ops_stats.stats_values(
+        jax.jit(lambda p, n: ops_stats.packed_chunk_stats(p, n, 1))(dev, dev)
+    )
+    assert got_packed == got
+
+
+# -- telemetry emission ------------------------------------------------------
+
+
+def test_stats_events_in_stream_and_summarize(tmp_path, capsys):
+    from gol_tpu.telemetry import summarize as summ_mod
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        checkpoint_every=3,
+        checkpoint_dir=str(tmp_path / "ck"),
+        telemetry_dir=str(tmp_path / "t"),
+        run_id="st",
+        stats=True,
+    )
+    rt.run(pattern=4, iterations=8)
+    recs = [json.loads(ln) for ln in open(tmp_path / "t" / "st.rank0.jsonl")]
+    stats = [r for r in recs if r["event"] == "stats"]
+    # One stats record per chunk, matching the schedule and last_stats.
+    assert [s["take"] for s in stats] == [3, 3, 2]
+    assert [s["generation"] for s in stats] == [3, 6, 8]
+    assert [s["population"] for s in stats] == [
+        s["population"] for s in rt.last_stats
+    ]
+    assert all(
+        set(s["faces"]) == {"top", "bottom", "left", "right"} for s in stats
+    )
+    # compile events carry the memory block (CPU exposes memory_analysis).
+    compiles = [r for r in recs if r["event"] == "compile"]
+    assert all("memory" in c for c in compiles)
+    assert all(c["memory"]["argument_bytes"] > 0 for c in compiles)
+    # summarize renders the stats and memory tables and exits 0.
+    assert summ_mod.main(["summarize", str(tmp_path / "t")]) == 0
+    out = capsys.readouterr().out
+    assert "stats     gen" in out
+    assert "memory: chunk" in out
+
+
+# -- memory introspection vs the roofline byte model -------------------------
+
+
+def test_dense_memory_analysis_within_byte_model():
+    from gol_tpu.telemetry import stats as stats_mod
+    from gol_tpu.utils import roofline
+
+    rt = GolRuntime(geometry=Geometry(size=64, num_ranks=1), engine="dense")
+    fn, dynamic, static = rt._evolve_fn(8)
+    spec = jax.ShapeDtypeStruct((64, 64), np.uint8)
+    compiled = fn.lower(spec, *dynamic, *static).compile()
+    mem = stats_mod.compiled_memory(compiled)
+    assert mem is not None, "CPU backend stopped exposing memory_analysis"
+    measured = mem["argument_bytes"] + mem["output_bytes"]
+    model = roofline.xla_bytes_model("dense", 64 * 64)
+    assert model / 2 <= measured <= model * 2, (
+        f"compiled I/O bytes {measured} vs byte model {model}"
+    )
+
+
+# -- mode hygiene ------------------------------------------------------------
+
+
+def test_guard_rejects_stats_runtime():
+    from gol_tpu.utils import guard as guard_mod
+
+    rt = GolRuntime(geometry=Geometry(size=64, num_ranks=1), stats=True)
+    with pytest.raises(ValueError, match="unguarded"):
+        guard_mod.run_guarded(
+            rt, pattern=4, iterations=8,
+            config=guard_mod.GuardConfig(check_every=4),
+        )
+
+
+def test_cli_stats_flag_validation(tmp_path, capsys):
+    from gol_tpu import cli
+
+    # --stats without --telemetry: clean error, reference exit status.
+    assert cli.main(["0", "64", "8", "512", "0", "--stats"]) == 255
+    assert "--telemetry" in capsys.readouterr().out
+    # --stats with the guard: clean error.
+    assert (
+        cli.main(
+            ["0", "64", "8", "512", "0", "--stats", "--telemetry",
+             str(tmp_path / "t"), "--guard-every", "4"]
+        )
+        == 255
+    )
+    assert "unguarded" in capsys.readouterr().out
+
+
+def test_cli_stats_end_to_end(tmp_path):
+    from gol_tpu import cli
+
+    d = tmp_path / "t"
+    rc = cli.main(
+        ["0", "64", "8", "512", "0", "--telemetry", str(d),
+         "--run-id", "clistats", "--stats"]
+    )
+    assert rc == 0
+    recs = [json.loads(ln) for ln in open(d / "clistats.rank0.jsonl")]
+    assert sum(1 for r in recs if r["event"] == "stats") == 1
+
+
+def test_cli3d_stats_end_to_end(tmp_path):
+    from gol_tpu import cli3d
+
+    d = tmp_path / "t3"
+    rc = cli3d.main(
+        ["2", "32", "4", "16", "0", "--engine", "bitpack",
+         "--checkpoint-every", "2",
+         "--checkpoint-dir", str(tmp_path / "ck3"),
+         "--telemetry", str(d), "--run-id", "v3s", "--stats"]
+    )
+    assert rc == 0
+    recs = [json.loads(ln) for ln in open(d / "v3s.rank0.jsonl")]
+    stats = [r for r in recs if r["event"] == "stats"]
+    assert [s["generation"] for s in stats] == [2, 4]
+    # 3-D volumes report the scalar quartet; no face bands.
+    assert all(s["faces"] == {} for s in stats)
+    assert all(
+        s["births"] + s["deaths"] == s["changed"] for s in stats
+    )
+    # Population of the final volume matches an independent recount.
+    from gol_tpu.cli3d import init_volume
+    from tests import oracle
+
+    expected = oracle.run_torus3d(init_volume(2, 32), 4)
+    assert stats[-1]["population"] == int(expected.sum())
+
+
+# -- real 2-process psum (the test_multihost.py harness) ---------------------
+
+_WORKER_STATS = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gol_tpu import compat as _compat
+_compat.set_cpu_device_count(2)
+from gol_tpu import cli
+pid = sys.argv[1]
+sys.exit(cli.main([
+    "4", "8", "4", "16", "0",
+    "--ranks", "4", "--mesh", "1d",
+    "--coordinator", sys.argv[2],
+    "--num-processes", "2", "--process-id", pid,
+    "--checkpoint-every", "2", "--checkpoint-dir", sys.argv[4],
+    "--telemetry", sys.argv[3], "--run-id", "mhs", "--stats",
+]))
+"""
+
+
+def test_two_process_stats_psum_agree(tmp_path):
+    """Both ranks of a real 2-process (gloo) run emit the *same* global
+    population via psum — and it matches the single-process run."""
+    from tests.test_multihost import _run_two_workers
+
+    tdir = tmp_path / "mhs"
+    _run_two_workers(_WORKER_STATS, [str(tdir), str(tmp_path / "mhck")])
+
+    def stats_of(rank):
+        recs = [
+            json.loads(ln) for ln in open(tdir / f"mhs.rank{rank}.jsonl")
+        ]
+        return [r for r in recs if r["event"] == "stats"]
+
+    s0, s1 = stats_of(0), stats_of(1)
+    assert len(s0) == len(s1) == 2  # chunks of 2 + 2 generations
+    assert [(s["generation"], s["population"], s["changed"]) for s in s0] \
+        == [(s["generation"], s["population"], s["changed"]) for s in s1]
+
+    # Single-process oracle for the same world.
+    rt = GolRuntime(
+        geometry=Geometry(size=8, num_ranks=4),
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "spck"),
+        stats=True,
+    )
+    rt.run(pattern=4, iterations=4)
+    assert [s["population"] for s in s0] == [
+        s["population"] for s in rt.last_stats
+    ]

@@ -1,0 +1,290 @@
+"""Schema v2, back-compat, rank-file rotation, and the watch dashboard.
+
+Companion to tests/test_telemetry.py (which pins the v1-era behavior
+and the trace-identity invariant).  Here:
+
+- the v2 additions round-trip: ``stats`` events and the ``memory``
+  block on ``compile`` events;
+- **back-compat**: the committed PR 2 (schema v1) fixture file still
+  loads, and a directory holding a v1 run *and* a freshly-written v2
+  run merges and renders in one ``summarize`` pass (exit 0) — while a
+  bogus schema number still takes the exit-2 validation path;
+- **rank-file collision**: re-opening an ``EventLog`` with an existing
+  ``--run-id`` rotates the old stream aside instead of clobbering or
+  interleaving; rotated files are invisible to the ``summarize`` glob;
+- the stats watchdogs flag extinction / static fixpoint / cross-rank
+  population disagreement from synthetic streams;
+- ``watch`` renders a frame from a finished run, survives torn lines,
+  and reuses ``summarize``'s anomaly rules verbatim.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pathlib
+import shutil
+
+import pytest
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+from gol_tpu.telemetry import watch as watch_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+V1_FIXTURE = (
+    pathlib.Path(__file__).parent / "data" / "telemetry_v1"
+    / "pr2run.rank0.jsonl"
+)
+
+
+# -- v2 round-trip -----------------------------------------------------------
+
+
+def test_stats_and_memory_events_roundtrip(tmp_path):
+    with telemetry.EventLog(str(tmp_path), run_id="v2", process_index=0) as ev:
+        ev.run_header({"driver": "2d"})
+        ev.compile_event(
+            8, 0.1, 0.2,
+            memory={"argument_bytes": 4096, "output_bytes": 4096,
+                    "temp_bytes": 128, "flops": 45056.0},
+        )
+        ev.stats_event(
+            0, 8, 8,
+            {"population": 7, "births": 3, "deaths": 2, "changed": 5,
+             "face_top": 1, "face_bottom": 0, "face_left": 2,
+             "face_right": 0},
+        )
+        path = ev.path
+    recs = [json.loads(ln) for ln in open(path)]
+    assert [r["event"] for r in recs] == ["run_header", "compile", "stats"]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION == 2
+    assert recs[1]["memory"]["argument_bytes"] == 4096
+    assert recs[2]["population"] == 7
+    assert recs[2]["faces"] == {"top": 1, "bottom": 0, "left": 2, "right": 0}
+    for r in recs:
+        telemetry.validate_record(r)  # must not raise
+
+
+def test_validate_rejects_incomplete_stats_record():
+    with pytest.raises(telemetry.SchemaError):
+        telemetry.validate_record(
+            {"event": "stats", "t": 1.0, "index": 0, "population": 3}
+        )
+
+
+# -- schema back-compat (v1 fixture) -----------------------------------------
+
+
+def test_v1_fixture_still_loads():
+    runs = summ_mod.load_dir(str(V1_FIXTURE.parent))
+    assert sorted(runs) == ["pr2run"]
+    run = runs["pr2run"]
+    assert run.header["schema"] == 1
+    assert len(run.records("chunk")) == 3
+    assert run.summary_record["cell_updates"] == 32768
+
+
+def test_v1_and_v2_runs_merge_in_one_summarize(tmp_path, capsys):
+    """The golden back-compat pin: a directory holding a PR 2 (v1)
+    stream next to a fresh v2 stream renders both runs, exit 0."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    shutil.copy(V1_FIXTURE, tmp_path / V1_FIXTURE.name)
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        telemetry_dir=str(tmp_path),
+        run_id="fresh",
+        stats=True,
+    )
+    rt.run(pattern=4, iterations=8)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "run pr2run" in out and "run fresh" in out
+    # v2-only tables render for the v2 run only.
+    assert out.count("stats     gen") == 1
+    # Both runs' chunk tables are there.
+    assert out.count("chunk     gens") == 2
+
+
+def test_unknown_schema_still_exits_2(tmp_path, capsys):
+    bad = dict(json.loads(V1_FIXTURE.read_text().splitlines()[0]))
+    bad["schema"] = 99
+    (tmp_path / "x.rank0.jsonl").write_text(json.dumps(bad) + "\n")
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+    assert "schema" in capsys.readouterr().err
+
+
+# -- rank-file collision rotation --------------------------------------------
+
+
+def _minimal_run(directory, run_id, marker):
+    with telemetry.EventLog(directory, run_id=run_id, process_index=0) as ev:
+        ev.run_header({"marker": marker})
+        return ev.path
+
+
+def test_rerun_with_same_run_id_rotates_old_file(tmp_path):
+    d = str(tmp_path)
+    path = _minimal_run(d, "dup", "first")
+    _minimal_run(d, "dup", "second")
+    _minimal_run(d, "dup", "third")
+    # The live file holds the newest stream; older ones rotated aside.
+    live = json.loads(open(path).read().splitlines()[0])
+    assert live["config"]["marker"] == "third"
+    rot1 = json.loads(open(path + ".1").read().splitlines()[0])
+    rot2 = json.loads(open(path + ".2").read().splitlines()[0])
+    assert rot1["config"]["marker"] == "first"
+    assert rot2["config"]["marker"] == "second"
+    # summarize sees exactly one run with one header — no interleaving,
+    # and the rotated files don't match the rank-file glob.
+    runs = summ_mod.load_dir(d)
+    assert sorted(runs) == ["dup"]
+    assert len(runs["dup"].records("run_header")) == 1
+
+
+# -- stats watchdogs ---------------------------------------------------------
+
+
+def _write_rank(tmp_path, run_id, rank, records):
+    path = telemetry.rank_file(str(tmp_path), run_id, rank)
+    with open(path, "w") as f:
+        for rec in records:
+            telemetry.validate_record(rec)
+            f.write(json.dumps(rec) + "\n")
+
+
+def _header(run_id, rank, count=1):
+    return {
+        "event": "run_header", "t": 1.0, "schema": 2, "run_id": run_id,
+        "process_index": rank, "process_count": count, "config": {},
+    }
+
+
+def _stats(idx, gen, pop, changed=1):
+    return {
+        "event": "stats", "t": 2.0 + idx, "index": idx, "take": 4,
+        "generation": gen, "population": pop,
+        "births": changed // 2, "deaths": changed - changed // 2,
+        "changed": changed, "faces": {},
+    }
+
+
+def test_watchdog_flags_extinction(tmp_path, capsys):
+    _write_rank(
+        tmp_path, "ex", 0,
+        [_header("ex", 0), _stats(0, 4, 120), _stats(1, 8, 0, changed=240)],
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "ANOMALY: extinction" in out and "generation 8" in out
+
+
+def test_watchdog_flags_static_fixpoint(tmp_path, capsys):
+    _write_rank(
+        tmp_path, "fx", 0,
+        [_header("fx", 0), _stats(0, 4, 12), _stats(1, 8, 12, changed=0)],
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    assert "ANOMALY: all-static fixpoint" in capsys.readouterr().out
+
+
+def test_watchdog_flags_cross_rank_population_divergence(tmp_path, capsys):
+    _write_rank(tmp_path, "dv", 0,
+                [_header("dv", 0, 2), _stats(0, 4, 100)])
+    _write_rank(tmp_path, "dv", 1,
+                [_header("dv", 1, 2), _stats(0, 4, 101)])
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "ANOMALY: cross-rank population disagreement" in out
+    assert "rank0=100" in out and "rank1=101" in out
+
+
+def test_no_watchdog_flags_on_healthy_stream(tmp_path, capsys):
+    _write_rank(
+        tmp_path, "ok", 0,
+        [_header("ok", 0), _stats(0, 4, 100), _stats(1, 8, 90)],
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "extinction" not in out and "fixpoint" not in out
+    assert "disagreement" not in out
+
+
+# -- watch -------------------------------------------------------------------
+
+
+def test_watch_renders_finished_run(tmp_path, capsys):
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        telemetry_dir=str(tmp_path),
+        run_id="w",
+        stats=True,
+    )
+    rt.run(pattern=4, iterations=8)
+    assert summ_mod.main(["watch", str(tmp_path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "run w" in out
+    assert "population:" in out
+    assert "FINISHED" in out
+
+
+def test_watch_waits_on_empty_directory(tmp_path, capsys):
+    assert summ_mod.main(["watch", str(tmp_path), "--once"]) == 0
+    assert "waiting for telemetry" in capsys.readouterr().out
+
+
+def test_watch_tails_incrementally_and_survives_torn_lines(tmp_path):
+    path = telemetry.rank_file(str(tmp_path), "tail", 0)
+    full = json.dumps(_header("tail", 0))
+    torn = json.dumps(_stats(0, 4, 55))
+    with open(path, "w") as f:
+        f.write(full + "\n" + torn[: len(torn) // 2])  # writer mid-record
+    w = watch_mod.Watcher(str(tmp_path))
+    w.poll()
+    run = w.current_run()
+    assert len(run.records("run_header")) == 1
+    assert run.records("stats") == []  # incomplete line not consumed
+    with open(path, "a") as f:
+        f.write(torn[len(torn) // 2 :] + "\n" + "NOT JSON\n")
+    w.poll()
+    run = w.current_run()
+    assert [s["population"] for s in run.records("stats")] == [55]
+    assert w.invalid_lines == 1  # the garbage line: counted, not fatal
+    # The frame renders the accumulated state and the shared anomaly
+    # rules find nothing to flag.
+    out = io.StringIO()
+    watch_mod.render_frame(w, out)
+    assert "population: 55" in out.getvalue()
+
+
+def test_watch_anomalies_match_summarize(tmp_path):
+    """The dashboard's flags are summarize's flags — same function,
+    same strings."""
+    _write_rank(
+        tmp_path, "wa", 0,
+        [_header("wa", 0), _stats(0, 4, 120), _stats(1, 8, 0, changed=240)],
+    )
+    out = io.StringIO()
+    assert watch_mod.watch(str(tmp_path), out, frames=1, clear=False) == 0
+    frame = out.getvalue()
+    run = summ_mod.load_dir(str(tmp_path))["wa"]
+    for flag in summ_mod.find_anomalies(run):
+        assert f"ANOMALY: {flag}" in frame
+
+
+def test_v1_fixture_is_committed():
+    """The back-compat golden test is only as good as its fixture: make
+    sure the committed file is the v1 shape (schema 1, no stats)."""
+    lines = [json.loads(ln) for ln in V1_FIXTURE.read_text().splitlines()]
+    assert lines[0]["schema"] == 1
+    assert all(r["event"] != "stats" for r in lines)
+    assert os.path.basename(V1_FIXTURE.name).endswith(".rank0.jsonl")
