@@ -2,7 +2,7 @@
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # tier-1 tests (see scripts/check.sh).
 
-.PHONY: lint verify test check telemetry-smoke stats-smoke
+.PHONY: lint verify test check telemetry-smoke stats-smoke resilience-drill
 
 lint:
 	bash scripts/lint.sh
@@ -31,6 +31,12 @@ stats-smoke:
 	    --telemetry "$$sdir" --run-id statsmoke --stats > /dev/null && \
 	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$$sdir" \
 	    | grep "stats     gen"
+
+# Supervised preempt/auto-resume smoke: SIGTERM a supervised child once
+# and assert the resumed run's final-grid hash matches an uninterrupted
+# run (docs/RESILIENCE.md; the kill-9 chaos matrix is `-m slow`).
+resilience-drill:
+	JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
 check:
 	bash scripts/check.sh

@@ -36,6 +36,7 @@ hint where applicable.
 from __future__ import annotations
 
 import argparse
+import os
 import re
 import sys
 from typing import Optional, Sequence
@@ -102,6 +103,18 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
     ext.add_argument("--checkpoint-dir", default=None)
     ext.add_argument("--resume", default=None, metavar="CKPT")
+    # Process-tier resilience (docs/RESILIENCE.md): --auto-resume starts
+    # from the newest snapshot in the checkpoint dir that fully
+    # fingerprint-verifies, falling back past corrupt/torn candidates
+    # (multi-host ranks agree on min(newest valid)); `iterations` then
+    # means the run's TOTAL generation target, so a preempted job
+    # relaunched with identical argv completes exactly the remaining
+    # work.  --keep-snapshots K retains only the newest K valid
+    # snapshots after each save (0 keeps all).  SIGTERM/SIGINT stop the
+    # run at the next chunk boundary with a final checkpoint and exit
+    # code 75 (EX_TEMPFAIL: preempted, resumable).
+    ext.add_argument("--auto-resume", action="store_true")
+    ext.add_argument("--keep-snapshots", type=int, default=3, metavar="K")
     # Multi-host (the `mpirun -np N` analog): connect this process to the
     # job before any device work; the mesh then spans the whole pod.
     from gol_tpu.parallel.multihost import add_multihost_args
@@ -231,9 +244,64 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--stats applies to unguarded runs; drop --guard-every "
                 "(the guard's audit already reports population per chunk)"
             )
+        if ns.auto_resume and ns.resume:
+            raise ValueError(
+                "--auto-resume selects the snapshot itself; pass one of "
+                "--resume/--auto-resume, not both"
+            )
+        if ns.keep_snapshots < 0:
+            raise ValueError(
+                f"--keep-snapshots must be >= 0, got {ns.keep_snapshots} "
+                "(0 keeps every snapshot)"
+            )
     except ValueError as e:
         print(e)
         return 255
+
+    from gol_tpu import resilience
+
+    resume = ns.resume
+    resume_info = None
+    iterations = ns.iterations
+    if ns.auto_resume:
+        # The walk + (multi-host) min-generation agreement is collective:
+        # every process calls it, every process gets the same answer.
+        ns.checkpoint_dir = ns.checkpoint_dir or "checkpoints"
+        try:
+            resume, resume_info = resilience.resolve_auto_resume(
+                ns.checkpoint_dir, kind="2d"
+            )
+        except (ValueError, OSError) as e:
+            print(e)
+            return 255
+        if resume is not None:
+            # Under auto-resume `iterations` is the TOTAL target: a
+            # relaunch with identical argv completes the remaining work.
+            iterations = max(0, ns.iterations - resume_info["generation"])
+            if topo.is_coordinator:
+                print(
+                    f"auto-resume: generation "
+                    f"{resume_info['generation']} from {resume}"
+                    + (
+                        "  [fallback: skipped "
+                        + ", ".join(resume_info["skipped"])
+                        + "]"
+                        if resume_info["fallback"] and resume_info["skipped"]
+                        else "  [fallback]"
+                        if resume_info["fallback"]
+                        else ""
+                    )
+                )
+        elif topo.is_coordinator:
+            print(
+                f"auto-resume: no valid snapshot in {ns.checkpoint_dir}; "
+                "starting fresh"
+            )
+
+    try:
+        restart_attempt = int(os.environ.get("GOL_RESTART_ATTEMPT", "0"))
+    except ValueError:
+        restart_attempt = 0
 
     try:
         rt = GolRuntime(
@@ -250,40 +318,65 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             telemetry_dir=ns.telemetry,
             run_id=ns.run_id,
             stats=ns.stats,
+            keep_snapshots=ns.keep_snapshots,
+            restart_attempt=restart_attempt,
+            resume_info=resume_info,
         )
         guard_report = None
-        if ns.guard_every > 0:
-            from gol_tpu.utils import guard as guard_mod
+        with resilience.preemption_guard():
+            if ns.guard_every > 0:
+                from gol_tpu.utils import guard as guard_mod
 
-            if ns.profile:
-                raise ValueError(
-                    "--profile applies to unguarded runs; drop --guard-every"
+                if ns.profile:
+                    raise ValueError(
+                        "--profile applies to unguarded runs; drop "
+                        "--guard-every"
+                    )
+                report, final_state, guard_report = guard_mod.run_guarded(
+                    rt,
+                    pattern=ns.pattern,
+                    iterations=iterations,
+                    config=guard_mod.GuardConfig(
+                        check_every=ns.guard_every,
+                        max_restores=ns.guard_max_restores,
+                        redundant=ns.guard_redundant,
+                        redundant_every=ns.guard_redundant_every,
+                    ),
+                    resume=resume,
                 )
-            report, final_state, guard_report = guard_mod.run_guarded(
-                rt,
-                pattern=ns.pattern,
-                iterations=ns.iterations,
-                config=guard_mod.GuardConfig(
-                    check_every=ns.guard_every,
-                    max_restores=ns.guard_max_restores,
-                    redundant=ns.guard_redundant,
-                    redundant_every=ns.guard_redundant_every,
-                ),
-                resume=ns.resume,
-            )
-        else:
-            report, final_state = rt.run(
-                pattern=ns.pattern,
-                iterations=ns.iterations,
-                resume=ns.resume,
-                profile_dir=ns.profile,
-            )
+            else:
+                report, final_state = rt.run(
+                    pattern=ns.pattern,
+                    iterations=iterations,
+                    resume=resume,
+                    profile_dir=ns.profile,
+                )
+    except resilience.Preempted as e:
+        # NOT the error path: the run stopped cleanly at a chunk
+        # boundary with a resumable snapshot.  EX_TEMPFAIL tells a
+        # scheduler/supervisor "relaunch me" — this run relaunched with
+        # --auto-resume completes the remaining generations bit-exactly.
+        if topo.is_coordinator:
+            print(e)
+        return resilience.EX_TEMPFAIL
     except (ValueError, OSError) as e:
         # Same clean-error convention as the pre-validation path: bad
         # --resume paths/shapes, unavailable engines, unwritable dirs,
         # corrupt snapshots, exhausted guard restore budgets (both are
         # ValueError subclasses).
         print(e)
+        from gol_tpu.utils.checkpoint import CorruptSnapshotError
+
+        if isinstance(e, CorruptSnapshotError) and ns.resume:
+            # Satellite fix: a corrupt --resume target is rarely the end
+            # of the line — say where the walk would have landed.
+            hint = resilience.corrupt_resume_hint(ns.resume, kind="2d")
+            if hint:
+                print(
+                    f"hint: an earlier valid snapshot exists at {hint}; "
+                    "resume from it, or rerun with --auto-resume to "
+                    "select it (and fall back) automatically"
+                )
         return 255
 
     # Rank 0's report (gol-main.c:121-128) + closing banner (gol-main.c:132);

@@ -33,6 +33,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from gol_tpu import resilience
 from gol_tpu.cli import atoi
 
 ENGINES3D = ("auto", "dense", "bitpack", "pallas")
@@ -252,6 +253,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--checkpoint-every", type=int, default=0, metavar="K")
     ext.add_argument("--checkpoint-dir", default="checkpoints3d")
     ext.add_argument("--resume", default=None, metavar="CKPT")
+    # Process-tier resilience, exactly the 2-D driver's surface
+    # (docs/RESILIENCE.md): validated auto-resume with total-target
+    # iteration semantics, keep-last-K snapshot retention, and
+    # SIGTERM/SIGINT → chunk-boundary checkpoint + exit 75.
+    ext.add_argument("--auto-resume", action="store_true")
+    ext.add_argument("--keep-snapshots", type=int, default=3, metavar="K")
     # Multi-host trio + failure detection, exactly the 2-D driver's
     # surface (gol_tpu/cli.py).
     from gol_tpu.parallel import multihost
@@ -342,6 +349,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--stats applies to unguarded runs; drop --guard-every "
                 "(the guard's audit already reports population per chunk)"
             )
+        if ns.auto_resume and ns.resume:
+            raise ValueError(
+                "--auto-resume selects the snapshot itself; pass one of "
+                "--resume/--auto-resume, not both"
+            )
+        if ns.keep_snapshots < 0:
+            raise ValueError(
+                f"--keep-snapshots must be >= 0, got {ns.keep_snapshots} "
+                "(0 keeps every snapshot)"
+            )
         rule = parse_rule3d(ns.rule)
 
         import jax
@@ -397,12 +414,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--rule to resume"
                 )
 
+        resume_src = ns.resume
+        resume_info = None
+        if ns.auto_resume:
+            # Collective on multi-host jobs (min-generation agreement).
+            resume_src, resume_info = resilience.resolve_auto_resume(
+                ns.checkpoint_dir, kind="3d"
+            )
+            if resume_src is not None:
+                # Total-target semantics: identical argv after a
+                # preemption completes exactly the remaining generations.
+                iterations = max(0, iterations - resume_info["generation"])
+                if topo.is_coordinator:
+                    print(
+                        f"auto-resume: generation "
+                        f"{resume_info['generation']} from {resume_src}"
+                        + ("  [fallback]" if resume_info["fallback"] else "")
+                    )
+            elif topo.is_coordinator:
+                print(
+                    f"auto-resume: no valid snapshot in "
+                    f"{ns.checkpoint_dir}; starting fresh"
+                )
+
         generation = 0
         vol = None
         placed = None  # sharded resumes build the device array directly
-        if ns.resume:
-            if ckpt_mod.is_sharded(ns.resume):
-                meta = ckpt_mod.load_sharded3d_meta(ns.resume)
+        if resume_src:
+            if ckpt_mod.is_sharded(resume_src):
+                meta = ckpt_mod.load_sharded3d_meta(resume_src)
                 check_meta(meta.shape, meta.rule)
                 generation = meta.generation
                 if mesh is not None:
@@ -413,17 +453,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         meta.shape,
                         sharded3d.volume_sharding(mesh),
                         lambda idx: ckpt_mod.read_sharded3d_region(
-                            ns.resume, meta, idx
+                            resume_src, meta, idx
                         ),
                     )
                 else:
                     vol = ckpt_mod.read_sharded3d_region(
-                        ns.resume,
+                        resume_src,
                         meta,
                         (slice(None), slice(None), slice(None)),
                     )
             else:
-                snap = ckpt_mod.load3d(ns.resume)
+                snap = ckpt_mod.load3d(resume_src)
                 check_meta(snap.volume.shape, snap.rule)
                 vol = snap.volume
                 generation = snap.generation
@@ -441,6 +481,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         num_devices = 1 if mesh is None else mesh.devices.size
         shard_cells = size**3 // max(num_devices, 1)
+        try:
+            restart_attempt = int(os.environ.get("GOL_RESTART_ATTEMPT", "0"))
+        except ValueError:
+            restart_attempt = 0
         if ns.telemetry:
             events = telemetry_mod.EventLog(ns.telemetry, run_id=ns.run_id)
             events.run_header(
@@ -454,6 +498,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     checkpoint_every=ns.checkpoint_every,
                 )
             )
+            if restart_attempt > 0:
+                events.restart_event(restart_attempt)
+            if resume_info is not None and resume_info.get("path"):
+                events.resume_event(
+                    generation=resume_info["generation"],
+                    path=resume_info["path"],
+                    fallback=bool(resume_info.get("fallback")),
+                    skipped=resume_info.get("skipped") or [],
+                )
 
         def util3d(take, wall_s):
             return telemetry_mod.roofline_utilization_3d(
@@ -472,6 +525,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             else None
         )
 
+        def gc_old_snapshots():
+            if ns.keep_snapshots > 0:
+                resilience.gc_snapshots(
+                    ns.checkpoint_dir,
+                    ns.keep_snapshots,
+                    kind="3d",
+                    protect=(resume_src,),
+                )
+
         def save_snapshot(b, g, fp=None):
             if mesh is not None:
                 ckpt_mod.save_sharded3d(
@@ -486,23 +548,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 from jax.experimental import multihost_utils
 
                 multihost_utils.sync_global_devices("gol3d_checkpoint")
+                # Retention after the barrier, one process sweeping.
+                if jax.process_index() == 0:
+                    gc_old_snapshots()
             else:
                 path = ckpt_mod.checkpoint3d_path(ns.checkpoint_dir, g)
                 # Host fetch on this thread (donation fence — and a
                 # background fetch would contend with the next chunk's
                 # device execution, see GolRuntime._save_snapshot); the
-                # compressed write overlaps.
+                # compressed write overlaps.  GC rides behind the save
+                # on whichever thread performs it.
                 vol_np = np.asarray(b)
+
+                def write(p=path, v=vol_np, g=g, fp=fp):
+                    ckpt_mod.save3d(p, v, g, rulestr, fingerprint=fp)
+                    gc_old_snapshots()
+
                 if ckpt_writer is not None:
-                    ckpt_writer.submit(
-                        lambda p=path, v=vol_np, g=g, fp=fp: (
-                            ckpt_mod.save3d(p, v, g, rulestr, fingerprint=fp)
-                        )
-                    )
+                    ckpt_writer.submit(write)
                 else:
-                    ckpt_mod.save3d(
-                        path, vol_np, g, rulestr, fingerprint=fp
-                    )
+                    write()
+
+        # Cooperative-preemption exit (docs/RESILIENCE.md): called at a
+        # chunk boundary when SIGTERM/SIGINT arrived and work remains.
+        # A final snapshot is persisted when checkpointing is configured
+        # (skipped when one just landed at this boundary), the async
+        # writer is fenced, and Preempted maps to exit code 75 below.
+        preempt_can_save = ns.checkpoint_every > 0 or ns.auto_resume
+
+        def preempt_exit(b, g, fp=None, just_saved=False):
+            checkpointed = just_saved
+            if preempt_can_save and not just_saved:
+                with sw.phase("checkpoint"):
+                    save_snapshot(b, g, fp)
+                checkpointed = True
+            if ckpt_writer is not None and checkpointed:
+                with sw.phase("checkpoint"):
+                    ckpt_writer.flush()
+            if events is not None:
+                events.preempt_event(g, checkpointed=checkpointed)
+            raise resilience.Preempted(
+                g,
+                checkpoint_dir=ns.checkpoint_dir if checkpointed else None,
+            )
 
         sw = Stopwatch()
         if iterations > 0:
@@ -571,30 +659,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 from gol_tpu.utils import guard as guard_mod
 
                 guard_report = guard_mod.GuardReport()
-                board, generation = guard_mod.guarded_loop(
-                    sw,
-                    guard_report,
-                    board,
-                    generation,
-                    schedule,
-                    {t: (c, ()) for t, (c, _) in evolvers.items()},
-                    checker_evolvers,
-                    guard_mod.GuardConfig(
-                        check_every=ns.guard_every,
-                        max_restores=ns.guard_max_restores,
-                        redundant=ns.guard_redundant,
-                        redundant_every=ns.guard_redundant_every,
-                    ),
-                    save_snapshot=save_snapshot,
-                    checkpoint_every=ns.checkpoint_every,
-                    events=events,
-                    chunk_utilization=util3d,
-                    checkpoint_overlapped=ckpt_writer is not None,
-                )
+                with resilience.preemption_guard():
+                    board, generation = guard_mod.guarded_loop(
+                        sw,
+                        guard_report,
+                        board,
+                        generation,
+                        schedule,
+                        {t: (c, ()) for t, (c, _) in evolvers.items()},
+                        checker_evolvers,
+                        guard_mod.GuardConfig(
+                            check_every=ns.guard_every,
+                            max_restores=ns.guard_max_restores,
+                            redundant=ns.guard_redundant,
+                            redundant_every=ns.guard_redundant_every,
+                        ),
+                        save_snapshot=save_snapshot,
+                        checkpoint_every=ns.checkpoint_every,
+                        events=events,
+                        chunk_utilization=util3d,
+                        checkpoint_overlapped=ckpt_writer is not None,
+                        preempt_hook=preempt_exit,
+                    )
             else:
                 from gol_tpu.utils.timing import maybe_profile
 
-                with maybe_profile(ns.profile), telemetry_mod.trace_annotation(
+                with resilience.preemption_guard(), maybe_profile(
+                    ns.profile
+                ), telemetry_mod.trace_annotation(
                     "gol3d.run.evolve"
                 ):
                     for i, take in enumerate(schedule):
@@ -644,6 +736,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     size**3,
                                     overlapped=ckpt_writer is not None,
                                 )
+                        if i < len(schedule) - 1 and (
+                            resilience.agreed_preempt_requested()
+                        ):
+                            # Chunk-boundary preemption poll (host-side
+                            # only; the compiled programs never see it).
+                            preempt_exit(
+                                board,
+                                generation,
+                                just_saved=ns.checkpoint_every > 0,
+                            )
             if ckpt_writer is not None:
                 # Completion fence only; main's finally owns the close.
                 with sw.phase("checkpoint"):
@@ -676,11 +778,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report = sw.report(size**3 * iterations)
         if events is not None:
             events.summary(report)
+    except resilience.Preempted as e:
+        # Clean chunk-boundary stop, resumable snapshot on disk:
+        # EX_TEMPFAIL (75), not the 255 error path.
+        if topo.is_coordinator:
+            print(e)
+        return resilience.EX_TEMPFAIL
     except (ValueError, OSError) as e:
         # Same surface as the 2-D driver (gol_tpu/cli.py): bad --resume
         # paths, corrupt snapshots, unavailable engines, unwritable dirs
         # all exit cleanly with the message, not a traceback.
         print(e)
+        from gol_tpu.utils.checkpoint import CorruptSnapshotError
+
+        if isinstance(e, CorruptSnapshotError) and ns.resume:
+            hint = resilience.corrupt_resume_hint(ns.resume, kind="3d")
+            if hint:
+                print(
+                    f"hint: an earlier valid snapshot exists at {hint}; "
+                    "resume from it, or rerun with --auto-resume to "
+                    "select it (and fall back) automatically"
+                )
         return 255
     finally:
         if ckpt_writer is not None:

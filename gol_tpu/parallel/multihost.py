@@ -226,6 +226,25 @@ def fetch_global(arr) -> np.ndarray:
     return np.asarray(replicated.addressable_shards[0].data)
 
 
+def allgather_host_ints(value: int) -> List[int]:
+    """One host integer from every process, on every process.
+
+    The resilience tier's agreement primitive (``--auto-resume``: all
+    ranks take min(newest valid snapshot generation) so no rank resumes
+    ahead of another).  Rides the same replication-collective machinery
+    as :func:`fetch_global` — works over gloo on CPU test jobs and
+    ICI/DCN on pods; identity on single-process jobs.
+    """
+    if jax.process_count() == 1:
+        return [int(value)]
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([int(value)], np.int64)
+    )
+    return [int(v) for v in np.asarray(gathered).ravel()]
+
+
 def precreate_host_dump_files(
     mesh, shape: Tuple[int, int], num_ranks: int, directory: str = "."
 ) -> List[str]:

@@ -1,0 +1,40 @@
+"""Process-level resilience: preemption, auto-resume, supervision, GC.
+
+The fault-tolerance story now has two tiers (docs/RESILIENCE.md):
+
+- the **in-run tier** (``utils/guard.py``): detects silent data
+  corruption while the process lives, rolls back on device, and writes
+  fingerprint-stamped checkpoints;
+- the **process tier** (this package): survives the process *dying* —
+  SIGTERM/SIGINT become a clean chunk-boundary checkpoint + exit 75
+  (:mod:`~gol_tpu.resilience.preempt`), ``--auto-resume`` restarts from
+  the newest snapshot that actually verifies, falling back past corrupt
+  or torn candidates with multi-host min-generation agreement
+  (:mod:`~gol_tpu.resilience.resume`), ``python -m gol_tpu.resilience
+  supervise`` relaunches a crashed/preempted child under a bounded
+  budget with exponential backoff + jitter
+  (:mod:`~gol_tpu.resilience.supervisor`), and keep-last-K retention
+  keeps week-long runs from exhausting disk
+  (:mod:`~gol_tpu.resilience.retention`).
+
+With none of it requested (no ``--auto-resume``, no supervisor, no
+signal delivered) every piece is a strict no-op: the chunk programs'
+jaxprs are byte-identical to the resilience-free build (pinned by the
+trace-identity tests).
+"""
+
+from gol_tpu.resilience.preempt import (  # noqa: F401
+    EX_TEMPFAIL,
+    Preempted,
+    agreed_preempt_requested,
+    clear_preemption,
+    preempt_requested,
+    preemption_guard,
+    request_preemption,
+)
+from gol_tpu.resilience.resume import (  # noqa: F401
+    corrupt_resume_hint,
+    resolve_auto_resume,
+)
+from gol_tpu.resilience.retention import gc_snapshots  # noqa: F401
+from gol_tpu.resilience.supervisor import supervise  # noqa: F401

@@ -1,0 +1,78 @@
+"""``python -m gol_tpu.resilience supervise [opts] -- <command ...>``.
+
+The process-tier entry point (docs/RESILIENCE.md).  Example:
+
+    python -m gol_tpu.resilience supervise \\
+        --max-restarts 5 --manifest runs/a/job.manifest.json \\
+        --checkpoint-dir ck -- \\
+        python -m gol_tpu 4 4096 10000 512 1 \\
+            --checkpoint-every 200 --checkpoint-dir ck --auto-resume \\
+            --telemetry runs/a --run-id a
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from gol_tpu.resilience import supervisor as sup_mod
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="gol_tpu.resilience",
+        description="Supervise a gol run: restart on crash/preemption "
+        "from the latest valid checkpoint (docs/RESILIENCE.md)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+    ps = sub.add_parser(
+        "supervise", help="run a child command under the restart budget"
+    )
+    ps.add_argument("--max-restarts", type=int, default=10, metavar="N")
+    ps.add_argument(
+        "--backoff-base", type=float, default=1.0, metavar="SECONDS"
+    )
+    ps.add_argument(
+        "--backoff-max", type=float, default=60.0, metavar="SECONDS"
+    )
+    ps.add_argument(
+        "--backoff-seed", type=int, default=None, metavar="I",
+        help="deterministic jitter (drills/tests)",
+    )
+    ps.add_argument("--manifest", default=None, metavar="PATH")
+    ps.add_argument(
+        "--checkpoint-dir", default=None, metavar="DIR",
+        help="record the resume generation per attempt in the manifest",
+    )
+    ps.add_argument("--kind", choices=["2d", "3d"], default="2d")
+    ps.add_argument("--run-id", default=None, metavar="NAME")
+    ps.add_argument(
+        "child", nargs=argparse.REMAINDER,
+        metavar="-- COMMAND ...",
+    )
+    ns = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+    child = list(ns.child)
+    if child and child[0] == "--":
+        child = child[1:]
+    if not child:
+        p.error("supervise needs a child command after '--'")
+    try:
+        return sup_mod.supervise(
+            child,
+            max_restarts=ns.max_restarts,
+            backoff_base=ns.backoff_base,
+            backoff_max=ns.backoff_max,
+            manifest_path=ns.manifest,
+            checkpoint_dir=ns.checkpoint_dir,
+            kind=ns.kind,
+            run_id=ns.run_id,
+            backoff_seed=ns.backoff_seed,
+        )
+    except (ValueError, OSError) as e:
+        print(f"supervisor: {e}", file=sys.stderr)
+        return 255
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Cooperative preemption: turn SIGTERM/SIGINT into a clean chunk-boundary
+exit instead of a mid-write kill.
+
+The reference exits the process on any signal with whatever half-written
+state the OS leaves behind; a scheduler preempting a pod job cannot tell
+"this run can be resumed" from "this run failed".  Here the signal handler
+only *sets a flag*; the chunked loops (``GolRuntime.run``, the guarded
+loop, the 3-D driver) poll it at chunk boundaries — the one point where
+the board is whole, fenced, and (in guarded mode) audited — write a final
+fingerprinted checkpoint, emit a ``preempt`` telemetry event, and raise
+:class:`Preempted`, which the CLIs map to exit code
+:data:`EX_TEMPFAIL` (75): the sysexits convention for "temporary failure,
+retry later", distinct from 0 (done) and 255 (error).
+
+A second signal while the flag is already set means the operator wants
+*out now*: the original disposition is restored and the signal re-raised,
+so a hung chunk cannot make the process unkillable short of SIGKILL.
+
+Everything here is host-side state; no compiled program ever sees the
+flag (the trace-identity tests pin that the chunk programs are
+byte-identical with the guard installed).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+# sysexits.h EX_TEMPFAIL — "preempted, resumable", the code supervisors
+# and schedulers key restart decisions on.
+EX_TEMPFAIL = 75
+
+
+class Preempted(Exception):
+    """A chunked loop stopped cooperatively at a chunk boundary.
+
+    Deliberately NOT a ``ValueError``: the CLIs' clean-error handlers
+    (``except (ValueError, OSError)`` → exit 255) must never swallow a
+    preemption — it has its own exit code.
+    """
+
+    def __init__(self, generation: int, checkpoint_dir: Optional[str] = None):
+        self.generation = generation
+        self.checkpoint_dir = checkpoint_dir
+        where = f" (checkpoints in {checkpoint_dir})" if checkpoint_dir else ""
+        super().__init__(
+            f"preempted at generation {generation}{where}"
+        )
+
+
+_flag = threading.Event()
+
+
+def preempt_requested() -> bool:
+    """Host-side poll the chunked loops call at chunk boundaries.
+
+    **Single-process view only.**  Multi-host loops must use
+    :func:`agreed_preempt_requested`: signal delivery is per-process and
+    asynchronous, and a rank that exits a boundary early while its peers
+    enter the next chunk's collectives would deadlock the job.
+    """
+    return _flag.is_set()
+
+
+def agreed_preempt_requested() -> bool:
+    """Job-wide preemption poll: true when ANY rank saw the signal.
+
+    On multi-host jobs this is one scalar allgather per chunk boundary
+    (max over the per-rank flags) — every rank takes the same decision
+    at the same boundary, so the final sharded checkpoint's barrier and
+    the exit are collective too.  The chunk cadence already pays a
+    checkpoint barrier at these boundaries; a scalar collective is
+    noise next to it.  Single-process jobs short-circuit to the local
+    flag (no collective machinery touched).
+    """
+    local = _flag.is_set()
+    import jax
+
+    if jax.process_count() == 1:
+        return local
+    from gol_tpu.parallel import multihost
+
+    agreed = max(multihost.allgather_host_ints(int(local))) > 0
+    if agreed and not local:
+        # Mirror the signal so this rank's own exit path (second-signal
+        # semantics, guard teardown) behaves as if it were signalled.
+        _flag.set()
+    return agreed
+
+
+def request_preemption() -> None:
+    """Set the flag programmatically (drills, tests, embedding code)."""
+    _flag.set()
+
+
+def clear_preemption() -> None:
+    _flag.clear()
+
+
+_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+def _handler(signum, frame) -> None:
+    if _flag.is_set():
+        # Second signal: the operator insists.  Restore the default
+        # disposition and re-raise so the process dies with the normal
+        # signal semantics (exit 128+signum), not a swallowed request.
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+        return
+    _flag.set()
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:  # pragma: no cover - unknown signal number
+        name = str(signum)
+    print(
+        f"gol: caught {name}; finishing the current chunk, then "
+        "checkpointing and exiting 75 (send again to die immediately)",
+        file=sys.stderr,
+    )
+
+
+@contextlib.contextmanager
+def preemption_guard(signals=_SIGNALS):
+    """Install the cooperative handlers for the duration of a run.
+
+    A flag already set on entry is honored (that's how drills and
+    embedders use :func:`request_preemption`: "preempt at the first
+    chunk boundary"); the flag is cleared on exit so one CLI invocation
+    never leaks its preemption into the next.  Previous handlers are
+    restored on exit, and off the main thread (where CPython forbids
+    ``signal.signal``) this degrades to a no-op — worker-thread
+    embedders don't get signal-driven preemption, but
+    :func:`request_preemption` still works.
+    """
+    previous = {}
+    try:
+        for s in signals:
+            previous[s] = signal.signal(s, _handler)
+    except ValueError:  # not the main thread: no handler was installed
+        previous = {}
+    try:
+        yield
+    finally:
+        for s, old in previous.items():
+            try:
+                signal.signal(s, old)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass
+        _flag.clear()

@@ -1,0 +1,119 @@
+"""Validated auto-resume: pick the newest snapshot that actually loads.
+
+``--resume PATH`` trusts the caller; ``--auto-resume`` trusts nothing.
+The walk (:func:`gol_tpu.utils.checkpoint.latest_valid`) goes
+newest→oldest over the checkpoint directory, fully fingerprint-verifying
+each candidate — single-file and sharded formats alike — and falls back
+past corrupt or torn snapshots instead of dying on
+``CorruptSnapshotError``: after a kill-9 mid-write or a flipped byte on
+disk, the run restarts from the newest state that is *provably* intact.
+
+Multi-host agreement: each rank validates its own view (for sharded
+checkpoints, the pieces it wrote — a rank cannot vouch for bytes another
+host owns), then all ranks take the **min** of their newest valid
+generations.  No rank may resume ahead of another: a rank whose newest
+snapshot failed validation drags the whole job back to the last
+generation *every* rank can load, which is exactly the generation the
+job can bit-exactly continue from.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from gol_tpu.utils import checkpoint as ckpt_mod
+
+
+def _allgather_min(value: int) -> int:
+    """min over all processes of a host integer (identity single-process).
+
+    Rides :func:`gol_tpu.parallel.multihost.allgather_host_ints` — the
+    scalar replicates so every rank takes the identical resume decision
+    with one collective.
+    """
+    from gol_tpu.parallel import multihost
+
+    return min(multihost.allgather_host_ints(value))
+
+
+def _snapshot_at(directory: str, kind: str, generation: int) -> Optional[str]:
+    """The on-disk snapshot path holding ``generation``, either format."""
+    if kind == "3d":
+        candidates = (
+            ckpt_mod.checkpoint3d_path(directory, generation),
+            ckpt_mod.sharded_checkpoint3d_path(directory, generation),
+        )
+    else:
+        candidates = (
+            ckpt_mod.checkpoint_path(directory, generation),
+            ckpt_mod.sharded_checkpoint_path(directory, generation),
+        )
+    for path in candidates:
+        if os.path.exists(path):
+            return path
+    return None
+
+
+def resolve_auto_resume(
+    directory: str, kind: str = "2d"
+) -> Tuple[Optional[str], dict]:
+    """(resume path or None, info dict for logs + the ``resume`` event).
+
+    ``info`` carries ``generation`` (-1 when starting fresh), ``path``,
+    ``fallback`` (True when a newer candidate was skipped as invalid or
+    another rank forced an earlier generation), and ``skipped`` (the
+    rejected newer candidates' basenames).  Collective on multi-host
+    jobs — every process must call it.
+    """
+    import jax
+
+    multi = jax.process_count() > 1
+    only = jax.process_index() if multi else None
+    path, skipped = ckpt_mod.latest_valid(directory, kind, only_process=only)
+    local_gen = -1
+    if path is not None:
+        gen = ckpt_mod.snapshot_generation(path)
+        local_gen = -1 if gen is None else gen
+    agreed = _allgather_min(local_gen) if multi else local_gen
+    fallback = bool(skipped)
+    if agreed != local_gen:
+        # Another rank's newest valid snapshot is older (or absent):
+        # fall back to the agreed generation — it verified on every rank.
+        fallback = True
+        path = (
+            None if agreed < 0 else _snapshot_at(directory, kind, agreed)
+        )
+        local_gen = agreed if path is not None else -1
+    if multi and agreed >= 0:
+        # Everyone-or-no-one: if any rank failed to locate the agreed
+        # snapshot (non-shared storage, a racing GC), all ranks start
+        # fresh rather than resuming split-brained.
+        if _allgather_min(0 if path is None else 1) == 0:
+            path, fallback = None, True
+    if path is None:
+        local_gen = -1
+    info = dict(
+        generation=local_gen,
+        path=None if path is None else os.path.abspath(path),
+        fallback=fallback and path is not None,
+        skipped=[os.path.basename(p) for p in skipped],
+    )
+    return path, info
+
+
+def corrupt_resume_hint(resume_path: str, kind: str = "2d") -> Optional[str]:
+    """For a failed plain ``--resume``: the newest *valid* sibling snapshot.
+
+    Gives the error message a concrete way out ("an earlier valid
+    snapshot exists at ...; or pass --auto-resume") instead of a dead
+    end.  Returns None when the directory holds no valid alternative.
+    """
+    directory = os.path.dirname(os.path.abspath(resume_path)) or "."
+    try:
+        path, _ = ckpt_mod.latest_valid(directory, kind)
+    except (OSError, ValueError):
+        return None
+    if path is None or os.path.abspath(path) == os.path.abspath(resume_path):
+        return None
+    return path

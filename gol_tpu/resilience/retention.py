@@ -1,0 +1,91 @@
+"""Checkpoint retention: keep-last-K *valid* snapshots, bounded disk.
+
+A week-long run checkpointing every few minutes writes thousands of
+snapshots; without GC the checkpoint directory, not the board, becomes
+the scaling limit.  The policy:
+
+- keep the newest K snapshots **that verify** (walking newest→oldest and
+  fingerprint-checking each candidate until K valid ones are found — a
+  corrupt newest snapshot must not silently shrink the usable history to
+  K-1);
+- never delete the resume source of the current run (the one snapshot a
+  rollback might still need) nor anything newer than the newest kept;
+- invalid candidates are left in place — they are evidence of a fault,
+  they never count toward K, and the auto-resume walk skips them anyway;
+- leftover ``.tmp.npz`` files from a killed writer are removed (they can
+  never be loaded; :func:`~gol_tpu.utils.checkpoint.latest` and the
+  validated walk both ignore them, so deleting them is pure cleanup).
+
+Verification cost is K full snapshot reads per GC pass — deliberate: the
+only thing worse than an unbounded checkpoint directory is a GC that
+deleted your last good fallback because it trusted a directory listing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Iterable, List
+
+from gol_tpu.utils import checkpoint as ckpt_mod
+
+
+def _remove(path: str) -> None:
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    else:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def gc_snapshots(
+    directory: str,
+    keep: int,
+    kind: str = "2d",
+    protect: Iterable[str] = (),
+) -> List[str]:
+    """Delete snapshots older than the K-th newest valid one.
+
+    Returns the deleted paths.  ``protect`` paths (the run's resume
+    source) are never deleted.  Safe to call from the async writer thread
+    (it follows the queued saves, so no in-flight ``.tmp`` of this
+    process is ever swept) and idempotent — a second pass deletes
+    nothing.
+    """
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    protected = {os.path.abspath(p) for p in protect if p}
+    candidates = ckpt_mod.list_snapshots(directory, kind)
+    valid_found = 0
+    cutoff_index = None  # delete strictly-older-than list index
+    for i in range(len(candidates) - 1, -1, -1):
+        try:
+            ckpt_mod.verify_snapshot(candidates[i])
+        except (ckpt_mod.CorruptSnapshotError, OSError, ValueError):
+            continue
+        valid_found += 1
+        if valid_found >= keep:
+            cutoff_index = i
+            break
+    deleted: List[str] = []
+    if cutoff_index is not None:
+        for path in candidates[:cutoff_index]:
+            if os.path.abspath(path) in protected:
+                continue
+            try:
+                ckpt_mod.verify_snapshot(path)
+            except (ckpt_mod.CorruptSnapshotError, OSError, ValueError):
+                continue  # invalid: evidence, not garbage
+            _remove(path)
+            deleted.append(path)
+    # Stale .tmp files: a killed writer's torn output, never loadable.
+    prefix = "ckpt3d_" if kind == "3d" else "ckpt_"
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            if name.startswith(prefix) and name.endswith(".tmp.npz"):
+                tmp = os.path.join(directory, name)
+                _remove(tmp)
+                deleted.append(tmp)
+    return deleted

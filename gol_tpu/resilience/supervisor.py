@@ -1,0 +1,189 @@
+"""Process supervisor: relaunch a crashed/preempted run until it finishes.
+
+``python -m gol_tpu.resilience supervise -- <command ...>`` runs the
+child command under a bounded restart budget.  The child is expected to
+be a gol driver invocation carrying ``--auto-resume`` (and a checkpoint
+cadence), so every relaunch continues from the newest valid snapshot —
+the supervisor itself never touches board state, it only owns the
+process lifecycle:
+
+- exit 0              → done; the supervisor exits 0.
+- exit 75 (preempted) → resumable by construction; restart.
+- any other exit / a signal death (kill -9 included) → crash; restart
+  with exponential backoff + jitter (thundering-herd hygiene: a pod of
+  supervisors must not relaunch in lockstep after a shared-storage blip).
+- budget exhausted    → exit with the child's last code (a persistent
+  fault; retrying cannot help — the same contract as the guard's
+  restore budget, one tier up).
+
+SIGTERM/SIGINT to the supervisor are forwarded to the child and stop the
+restart loop: the operator (or the cluster scheduler) killing the
+supervisor means "stop the job", not "crash worth retrying".
+
+Every attempt is recorded in an atomically-rewritten run-manifest JSON
+(attempt number, child pid, exit code, the resume generation the
+checkpoint directory held at launch, timestamps) keyed by ``run_id`` —
+the join handle ``python -m gol_tpu.telemetry summarize`` renders next
+to the event streams (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from gol_tpu.resilience.preempt import EX_TEMPFAIL
+
+
+def _write_manifest(path: Optional[str], manifest: dict) -> None:
+    if not path:
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _resume_generation(checkpoint_dir: Optional[str], kind: str):
+    """Newest valid generation the next attempt would resume from."""
+    if not checkpoint_dir:
+        return None
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    path, _ = ckpt_mod.latest_valid(checkpoint_dir, kind)
+    return None if path is None else ckpt_mod.snapshot_generation(path)
+
+
+def backoff_delay(
+    attempt: int, base: float, cap: float, rng: random.Random
+) -> float:
+    """Exponential backoff with multiplicative jitter in [0.5, 1.5)."""
+    if base <= 0:
+        return 0.0
+    return min(base * (2.0 ** max(attempt - 1, 0)), cap) * (
+        0.5 + rng.random()
+    )
+
+
+def supervise(
+    child_argv: List[str],
+    max_restarts: int = 10,
+    backoff_base: float = 1.0,
+    backoff_max: float = 60.0,
+    manifest_path: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
+    kind: str = "2d",
+    run_id: Optional[str] = None,
+    backoff_seed: Optional[int] = None,
+    out=None,
+) -> int:
+    """Run ``child_argv`` to completion under the restart budget.
+
+    Returns the exit code the supervisor should exit with.  The attempt
+    counter is exported to the child as ``GOL_RESTART_ATTEMPT`` so
+    restarted runs stamp a ``restart`` telemetry event into their own
+    streams (the restart-storm watchdog reads those).
+    """
+    if not child_argv:
+        raise ValueError("supervise needs a child command after '--'")
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    out = sys.stderr if out is None else out
+    rng = random.Random(backoff_seed)
+    stop = {"signum": None}
+    child = {"proc": None}
+
+    def forward(signum, frame):
+        stop["signum"] = signum
+        p = child["proc"]
+        if p is not None and p.poll() is None:
+            try:
+                p.send_signal(signum)
+            except OSError:  # pragma: no cover - child died in between
+                pass
+
+    previous = {}
+    try:
+        for s in (signal.SIGTERM, signal.SIGINT):
+            previous[s] = signal.signal(s, forward)
+    except ValueError:  # not the main thread (tests): run unforwarded
+        previous = {}
+
+    manifest = dict(
+        run_id=run_id,
+        child=list(child_argv),
+        max_restarts=max_restarts,
+        checkpoint_dir=checkpoint_dir,
+        attempts=[],
+        finished=False,
+        final_exit=None,
+    )
+    try:
+        rc = 1
+        for attempt in range(max_restarts + 1):
+            record = dict(
+                attempt=attempt,
+                resume_generation=_resume_generation(checkpoint_dir, kind),
+                start_t=time.time(),
+                pid=None,
+                end_t=None,
+                exit_code=None,
+            )
+            manifest["attempts"].append(record)
+            env = dict(os.environ, GOL_RESTART_ATTEMPT=str(attempt))
+            proc = subprocess.Popen(child_argv, env=env)
+            child["proc"] = proc
+            record["pid"] = proc.pid
+            _write_manifest(manifest_path, manifest)
+            rc = proc.wait()
+            child["proc"] = None
+            record["end_t"] = time.time()
+            record["exit_code"] = rc
+            _write_manifest(manifest_path, manifest)
+            if rc == 0:
+                break
+            if stop["signum"] is not None:
+                print(
+                    f"supervisor: stopping on signal {stop['signum']} "
+                    f"(child exited {rc}); not restarting",
+                    file=out,
+                )
+                break
+            if attempt == max_restarts:
+                print(
+                    f"supervisor: child exited {rc} and the restart "
+                    f"budget ({max_restarts}) is exhausted — giving up",
+                    file=out,
+                )
+                break
+            why = "preempted" if rc == EX_TEMPFAIL else "crashed"
+            delay = backoff_delay(attempt + 1, backoff_base, backoff_max, rng)
+            print(
+                f"supervisor: child exited {rc} ({why}); restart "
+                f"{attempt + 1}/{max_restarts} in {delay:.1f}s",
+                file=out,
+            )
+            # Sleep in small slices so a stop signal interrupts the wait.
+            deadline = time.time() + delay
+            while time.time() < deadline and stop["signum"] is None:
+                time.sleep(min(0.1, max(deadline - time.time(), 0)))
+            if stop["signum"] is not None:
+                break
+        manifest["finished"] = rc == 0
+        manifest["final_exit"] = rc
+        _write_manifest(manifest_path, manifest)
+        return rc
+    finally:
+        for s, old in previous.items():
+            try:
+                signal.signal(s, old)
+            except (ValueError, TypeError):  # pragma: no cover
+                pass

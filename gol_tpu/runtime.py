@@ -99,6 +99,18 @@ class GolRuntime:
     # births/deaths diff, so donation is forfeited: one extra board of
     # HBM.  Stats land in telemetry `stats` events and in `last_stats`.
     stats: bool = False
+    # Process-tier resilience knobs (gol_tpu/resilience/,
+    # docs/RESILIENCE.md) — all host-side, none touches a traced program
+    # (pinned by the trace-identity tests):
+    # keep_snapshots > 0 retains only the newest K *valid* snapshots
+    # after each save (never the resume source); 0 keeps everything.
+    keep_snapshots: int = 0
+    # restart_attempt > 0 marks this run as supervised attempt N (from
+    # GOL_RESTART_ATTEMPT) — stamped as a v3 `restart` telemetry event.
+    restart_attempt: int = 0
+    # resume_info (the dict resilience.resolve_auto_resume returns) is
+    # stamped as a v3 `resume` telemetry event by open_event_log.
+    resume_info: Optional[dict] = None
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -297,10 +309,18 @@ class GolRuntime:
                 mesh_mod.validate_geometry(shape, self.mesh)
         # Frozen t=0 halos, populated for stale_t0 runs at board init.
         self._halos: Optional[Tuple[jax.Array, jax.Array]] = None
+        if self.keep_snapshots < 0:
+            raise ValueError(
+                f"keep_snapshots must be >= 0, got {self.keep_snapshots} "
+                "(0 keeps every snapshot)"
+            )
         # Async checkpoint writer, owned by run()/run_guarded while their
         # loops are live (single-process runs only — see
         # checkpoint.AsyncSnapshotWriter).
         self._ckpt_writer = None
+        # The snapshot this run resumed from — protected from retention
+        # GC for the whole run (a rollback may still need it).
+        self._resume_source: Optional[str] = None
         # Host-int stats of the last run()'s chunks (--stats mode):
         # [{"index", "take", "generation", "population", ...}, ...].
         self.last_stats: list = []
@@ -543,6 +563,7 @@ class GolRuntime:
         the snapshot on resume (re-freezing from the resumed board would
         silently change the semantics mid-run).
         """
+        self._resume_source = resume or None
         if resume and ckpt_mod.is_sharded(resume):
             meta = ckpt_mod.load_sharded_meta(resume)
             if meta.num_ranks != self.geometry.num_ranks:
@@ -662,6 +683,17 @@ class GolRuntime:
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("gol_checkpoint")
+            # Retention: after the barrier (every host's pieces are
+            # durably renamed) exactly one process sweeps old snapshots.
+            if self.keep_snapshots > 0 and jax.process_index() == 0:
+                from gol_tpu.resilience import retention
+
+                retention.gc_snapshots(
+                    self.checkpoint_dir,
+                    self.keep_snapshots,
+                    kind="2d",
+                    protect=(self._resume_source,),
+                )
             return
         path = ckpt_mod.checkpoint_path(
             self.checkpoint_dir, int(state.generation)
@@ -683,14 +715,64 @@ class GolRuntime:
         # compressed write overlaps; on real (non-tunnel) hosts the
         # write, not the fetch, dominates the phase.
         board_np = np.asarray(state.board)
-        if self._ckpt_writer is not None:
-            self._ckpt_writer.submit(
-                lambda: ckpt_mod.save(
-                    path, board_np, generation, ranks, **kwargs
-                )
-            )
-        else:
+
+        def write():
             ckpt_mod.save(path, board_np, generation, ranks, **kwargs)
+            if self.keep_snapshots > 0:
+                # GC rides the same thread as the save (the writer's, or
+                # this one) so it always runs after the rename it follows
+                # and never races an in-flight .tmp of this process.
+                from gol_tpu.resilience import retention
+
+                retention.gc_snapshots(
+                    self.checkpoint_dir,
+                    self.keep_snapshots,
+                    kind="2d",
+                    protect=(self._resume_source,),
+                )
+
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.submit(write)
+        else:
+            write()
+
+    def _preempt(
+        self,
+        state: GolState,
+        sw: Stopwatch,
+        writer,
+        events,
+        fingerprint: Optional[int] = None,
+        already_saved: bool = False,
+    ) -> None:
+        """Cooperative-preemption exit path (shared by run/run_guarded).
+
+        Persists a final fingerprinted snapshot when a checkpoint
+        directory is configured (skipped when one just landed at this
+        exact boundary), fences the async writer so the snapshot is
+        durably renamed *before* the process exits, emits the ``preempt``
+        telemetry event, and raises :class:`gol_tpu.resilience.Preempted`
+        — which the CLIs map to exit code 75 (EX_TEMPFAIL).
+        """
+        from gol_tpu import telemetry as telemetry_mod
+        from gol_tpu import resilience
+
+        generation = int(state.generation)
+        checkpointed = already_saved
+        if self.checkpoint_dir and not already_saved:
+            with telemetry_mod.trace_annotation("gol.checkpoint.save"):
+                with sw.phase("checkpoint"):
+                    self._save_snapshot(state, fingerprint=fingerprint)
+            checkpointed = True
+        if writer is not None and checkpointed:
+            with sw.phase("checkpoint"):
+                writer.flush()
+        if events is not None:
+            events.preempt_event(generation, checkpointed=checkpointed)
+        raise resilience.Preempted(
+            generation,
+            checkpoint_dir=self.checkpoint_dir if checkpointed else None,
+        )
 
     # -- shared compile machinery -------------------------------------------
     def chunk_schedule(self, iterations: int, chunk: int) -> list:
@@ -784,6 +866,15 @@ class GolRuntime:
                 checkpoint_every=self.checkpoint_every,
             )
         )
+        if self.restart_attempt > 0:
+            events.restart_event(self.restart_attempt)
+        if self.resume_info is not None and self.resume_info.get("path"):
+            events.resume_event(
+                generation=self.resume_info["generation"],
+                path=self.resume_info["path"],
+                fallback=bool(self.resume_info.get("fallback")),
+                skipped=self.resume_info.get("skipped") or [],
+            )
         return events
 
     def chunk_utilization(self, take: int, wall_s: float):
@@ -906,6 +997,23 @@ class GolRuntime:
                                     dt,
                                     int(state.board.size),
                                     overlapped=writer is not None,
+                                )
+                        if i < len(schedule) - 1:
+                            # Chunk-boundary preemption poll: host-side
+                            # only (the compiled programs never see it).
+                            # With work remaining, stop here — the board
+                            # is whole and fenced; a snapshot for this
+                            # boundary either just landed or is written
+                            # now.
+                            from gol_tpu import resilience
+
+                            if resilience.agreed_preempt_requested():
+                                self._preempt(
+                                    state,
+                                    sw,
+                                    writer,
+                                    events,
+                                    already_saved=self.checkpoint_every > 0,
                                 )
                 if writer is not None:
                     with sw.phase("checkpoint"):

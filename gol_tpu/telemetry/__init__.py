@@ -45,13 +45,14 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 2 (this round) adds the ``stats`` event type and optional
-# ``memory``/``cost`` blocks on ``compile`` events.  v1 streams (PR 2
-# runs) stay readable: every v1 event type and field survives unchanged,
-# so consumers only ever *gain* records (back-compat pinned by the
-# committed v1 fixture test).
-SCHEMA_VERSION = 2
-SUPPORTED_SCHEMAS = (1, 2)
+# Version 3 (this round) adds the resilience events — ``preempt``,
+# ``resume``, ``restart`` (docs/RESILIENCE.md).  Version 2 added the
+# ``stats`` event type and optional ``memory``/``cost`` blocks on
+# ``compile`` events.  Older streams stay readable: every v1/v2 event
+# type and field survives unchanged, so consumers only ever *gain*
+# records (back-compat pinned by the committed v1 and v2 fixture tests).
+SCHEMA_VERSION = 3
+SUPPORTED_SCHEMAS = (1, 2, 3)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -88,6 +89,16 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
         {"index", "take", "generation", "population", "births", "deaths",
          "changed", "faces"}
     ),
+    # v3: cooperative preemption fired — the run stopped at a chunk
+    # boundary (generation) and whether a resumable snapshot was written.
+    "preempt": frozenset({"generation", "checkpointed"}),
+    # v3: this run started from a snapshot.  ``fallback`` is True when a
+    # newer candidate was skipped as corrupt/torn or another rank forced
+    # an earlier generation (the auto-resume min agreement).
+    "resume": frozenset({"generation", "path", "fallback"}),
+    # v3: this run is attempt N (> 0) of a supervised job — the
+    # restart-storm watchdog counts these across a directory's runs.
+    "restart": frozenset({"attempt"}),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -271,6 +282,34 @@ class EventLog:
 
     def bench_row(self, bench: str, data: dict) -> None:
         self.emit("bench_row", bench=bench, data=data)
+
+    def preempt_event(self, generation: int, checkpointed: bool) -> None:
+        """Cooperative preemption at a chunk boundary (v3; exit 75)."""
+        self.emit(
+            "preempt", generation=generation, checkpointed=checkpointed
+        )
+
+    def resume_event(
+        self,
+        generation: int,
+        path: Optional[str],
+        fallback: bool,
+        **extra,
+    ) -> None:
+        """This run started from a snapshot (v3).  ``extra`` may carry
+        ``skipped`` — the corrupt/torn newer candidates the validated
+        walk rejected."""
+        self.emit(
+            "resume",
+            generation=generation,
+            path=path,
+            fallback=fallback,
+            **extra,
+        )
+
+    def restart_event(self, attempt: int, **extra) -> None:
+        """Supervised restart marker (v3): this run is attempt N > 0."""
+        self.emit("restart", attempt=attempt, **extra)
 
     def stats_event(
         self, index: int, take: int, generation: int, values: dict

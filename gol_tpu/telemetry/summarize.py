@@ -186,6 +186,20 @@ def find_anomalies(run: Run) -> List[str]:
     # Simulation watchdogs over the --stats stream (schema v2).
     flags.extend(stats_watchdogs(run))
 
+    # Resilience watchdog (schema v3): a resume that had to fall back
+    # past newer snapshots means corruption happened — worth a flag even
+    # though the run recovered.
+    for r in run.records("resume"):
+        if r["fallback"]:
+            skipped = r.get("skipped") or []
+            detail = f" (skipped {', '.join(skipped)})" if skipped else ""
+            flags.append(
+                f"resume fallback: resumed from generation "
+                f"{r['generation']} instead of the newest snapshot"
+                f"{detail} — a newer candidate was corrupt/torn or "
+                "another rank forced an earlier generation"
+            )
+
     # Per-chunk walls must account for the summary's total phase.
     summ = run.summary_record
     if summ is not None and chunks:
@@ -248,6 +262,92 @@ def stats_watchdogs(run: Run) -> List[str]:
                 "broken collective)"
             )
     return flags
+
+
+def restart_storm_flags(
+    runs: Dict[str, Run],
+    max_restarts: int = 3,
+    window_s: float = 300.0,
+) -> List[str]:
+    """Directory-level watchdog: too many supervised restarts, too fast.
+
+    Every restarted attempt is its own run (its own rank files), so the
+    per-run anomaly scan cannot see a storm; this counts ``restart``
+    events (schema v3 — one per restarted attempt, stamped by the child
+    from ``GOL_RESTART_ATTEMPT``) across *all* runs in the directory and
+    flags more than ``max_restarts`` of them inside any ``window_s``
+    sliding window: the supervisor is respawning a child that keeps
+    dying — a persistent fault burning the restart budget, not a
+    preemption blip.  Shared by ``summarize`` and ``watch``.
+    """
+    times = sorted(
+        rec["t"]
+        for run in runs.values()
+        for rank in run.ranks.values()
+        for rec in rank
+        if rec["event"] == "restart"
+    )
+    need = max_restarts + 1
+    for i in range(len(times) - need + 1):
+        span = times[i + need - 1] - times[i]
+        if span <= window_s:
+            return [
+                f"restart storm: {need} restarts within {span:.0f}s "
+                f"(> {max_restarts} per {window_s:.0f}s window) — the "
+                "child keeps dying; check the supervisor manifest and "
+                "the last attempt's stderr"
+            ]
+    return []
+
+
+def load_manifests(directory: str) -> List[dict]:
+    """Supervisor run-manifests (``*.manifest.json``) in the directory.
+
+    The join handle between the event streams and the process tier:
+    the manifest carries attempts/exit codes/resume generations keyed
+    by ``run_id`` (docs/RESILIENCE.md).  Unreadable manifests are
+    skipped — they come from a different writer than the schema-gated
+    rank files.
+    """
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory, "*.manifest.json"))):
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(m, dict):
+            m["_path"] = path
+            out.append(m)
+    return out
+
+
+def render_manifest(m: dict, out) -> None:
+    attempts = m.get("attempts") or []
+    print(
+        f"supervisor manifest {os.path.basename(m['_path'])}"
+        + (f" (run {m['run_id']})" if m.get("run_id") else ""),
+        file=out,
+    )
+    print(
+        f"  attempts: {len(attempts)}  budget: {m.get('max_restarts')}  "
+        f"finished: {m.get('finished')}  final_exit: {m.get('final_exit')}",
+        file=out,
+    )
+    for a in attempts:
+        rc = a.get("exit_code")
+        state = (
+            "running" if rc is None
+            else "ok" if rc == 0
+            else "preempted" if rc == 75
+            else f"crashed({rc})"
+        )
+        gen = a.get("resume_generation")
+        print(
+            f"    attempt {a.get('attempt')}: {state}, resumed from "
+            f"{'fresh start' if gen is None else f'generation {gen}'}",
+            file=out,
+        )
 
 
 # -- rendering ---------------------------------------------------------------
@@ -370,6 +470,24 @@ def render_run(run: Run, out) -> None:
             file=out,
         )
 
+    for r in run.records("restart", rank=rank0):
+        print(
+            f"  restart: supervised attempt {r['attempt']}",
+            file=out,
+        )
+    for r in run.records("resume", rank=rank0):
+        print(
+            f"  resume: generation {r['generation']} from {r['path']}"
+            + ("  [FALLBACK]" if r["fallback"] else ""),
+            file=out,
+        )
+    for r in run.records("preempt", rank=rank0):
+        print(
+            f"  preempt: stopped at generation {r['generation']} "
+            f"({'checkpointed' if r['checkpointed'] else 'NO checkpoint'})",
+            file=out,
+        )
+
     benches = run.records("bench_row")
     if benches:
         for b in benches:
@@ -394,6 +512,12 @@ def summarize(directory: str, out) -> int:
     runs = load_dir(directory)
     for run_id in sorted(runs):
         render_run(runs[run_id], out)
+    for m in load_manifests(directory):
+        render_manifest(m, out)
+    # Directory-level: supervised restarts span runs, so the storm
+    # watchdog cannot live inside the per-run anomaly scan.
+    for flag in restart_storm_flags(runs):
+        print(f"ANOMALY: {flag}", file=out)
     return 0
 
 

@@ -211,6 +211,24 @@ def render_frame(w: Watcher, out) -> None:
             file=out,
         )
 
+    restarts = run.records("restart", rank=rank0)
+    if restarts:
+        print(
+            f"  supervised: attempt {restarts[-1]['attempt']}", file=out
+        )
+    for r in run.records("resume", rank=rank0):
+        print(
+            f"  resumed from generation {r['generation']}"
+            + ("  [FALLBACK]" if r["fallback"] else ""),
+            file=out,
+        )
+    for r in run.records("preempt", rank=rank0):
+        print(
+            f"  PREEMPTED at generation {r['generation']} "
+            f"({'checkpointed' if r['checkpointed'] else 'NO checkpoint'})",
+            file=out,
+        )
+
     if run.summary_record is not None:
         s = run.summary_record
         print(
@@ -221,6 +239,10 @@ def render_frame(w: Watcher, out) -> None:
     if w.invalid_lines:
         print(f"  torn/invalid lines skipped: {w.invalid_lines}", file=out)
     for flag in summ_mod.find_anomalies(run):
+        print(f"  ANOMALY: {flag}", file=out)
+    # Restart storms span attempts (one run each): scan every run the
+    # watcher has tailed, exactly summarize's directory-level rule.
+    for flag in summ_mod.restart_storm_flags(w.runs):
         print(f"  ANOMALY: {flag}", file=out)
 
 

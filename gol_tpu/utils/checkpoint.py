@@ -14,7 +14,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
+import re
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -33,6 +34,23 @@ class Snapshot:
 
 class CorruptSnapshotError(ValueError):
     """The snapshot's stored fingerprint does not match its board."""
+
+
+def _tmp_rename_gap() -> None:
+    """Chaos-drill hook: widen the window between the ``.tmp`` write and
+    the atomic rename.
+
+    The kill-9 drill (tests/test_resilience_drill.py) must land SIGKILL
+    *inside* a checkpoint write to prove a torn ``.tmp`` file is never
+    resumed from; real writes close that window in microseconds, so the
+    drill sets ``GOL_CKPT_TEST_WRITE_DELAY`` (seconds) to hold it open.
+    Unset (production), this is a no-op.
+    """
+    delay = os.environ.get("GOL_CKPT_TEST_WRITE_DELAY")
+    if delay:
+        import time
+
+        time.sleep(float(delay))
 
 
 class AsyncSnapshotWriter:
@@ -199,6 +217,7 @@ def save(
         )
     tmp = path + ".tmp.npz"
     np.savez_compressed(tmp, **arrays)
+    _tmp_rename_gap()
     os.replace(tmp, path)
     return path
 
@@ -207,40 +226,68 @@ def load(path: str) -> Snapshot:
     """Read a snapshot, verifying its fingerprint when present.
 
     (Snapshots written before fingerprints existed load without the check.)
+    Truncated or otherwise unreadable archives raise
+    :class:`CorruptSnapshotError` like a bad fingerprint does — the
+    auto-resume walk treats every malformation as "skip this candidate".
     """
-    with np.load(path) as data:
-        board = data["board"].astype(np.uint8)
-        top0 = data["top0"].astype(np.uint8) if "top0" in data else None
-        bottom0 = (
-            data["bottom0"].astype(np.uint8) if "bottom0" in data else None
-        )
-        if "fingerprint" in data:
-            from gol_tpu.utils.guard import fingerprint_np
+    import zipfile
+    import zlib
 
-            stored = int(data["fingerprint"])
-            actual = fingerprint_np(board)
-            if stored != actual:
+    try:
+        data = np.load(path)
+    except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+        raise CorruptSnapshotError(
+            f"{path}: not a readable snapshot archive ({e})"
+        ) from e
+    with data:
+        try:
+            return _read_snapshot(path, data)
+        except CorruptSnapshotError:
+            raise
+        except (
+            zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
+        ) as e:
+            # A flipped byte can land in zip structure, a compressed
+            # stream, or a member header — all of them are "this snapshot
+            # is corrupt", never a traceback.
+            raise CorruptSnapshotError(
+                f"{path}: snapshot archive is corrupt ({e})"
+            ) from e
+
+
+def _read_snapshot(path: str, data) -> Snapshot:
+    board = data["board"].astype(np.uint8)
+    top0 = data["top0"].astype(np.uint8) if "top0" in data else None
+    bottom0 = (
+        data["bottom0"].astype(np.uint8) if "bottom0" in data else None
+    )
+    if "fingerprint" in data:
+        from gol_tpu.utils.guard import fingerprint_np
+
+        stored = int(data["fingerprint"])
+        actual = fingerprint_np(board)
+        if stored != actual:
+            raise CorruptSnapshotError(
+                f"{path}: stored fingerprint {stored:#010x} != computed "
+                f"{actual:#010x}; the snapshot is corrupt"
+            )
+        if "halo_fingerprint" in data:
+            stored_h = int(data["halo_fingerprint"])
+            actual_h = fingerprint_np(_halo_plane(top0, bottom0))
+            if stored_h != actual_h:
                 raise CorruptSnapshotError(
-                    f"{path}: stored fingerprint {stored:#010x} != computed "
-                    f"{actual:#010x}; the snapshot is corrupt"
+                    f"{path}: halo fingerprint {stored_h:#010x} != "
+                    f"computed {actual_h:#010x}; the frozen halos are "
+                    "corrupt"
                 )
-            if "halo_fingerprint" in data:
-                stored_h = int(data["halo_fingerprint"])
-                actual_h = fingerprint_np(_halo_plane(top0, bottom0))
-                if stored_h != actual_h:
-                    raise CorruptSnapshotError(
-                        f"{path}: halo fingerprint {stored_h:#010x} != "
-                        f"computed {actual_h:#010x}; the frozen halos are "
-                        "corrupt"
-                    )
-        return Snapshot(
-            board=board,
-            generation=int(data["generation"]),
-            num_ranks=int(data["num_ranks"]),
-            top0=top0,
-            bottom0=bottom0,
-            rule=str(data["rule"]) if "rule" in data else None,
-        )
+    return Snapshot(
+        board=board,
+        generation=int(data["generation"]),
+        num_ranks=int(data["num_ranks"]),
+        top0=top0,
+        bottom0=bottom0,
+        rule=str(data["rule"]) if "rule" in data else None,
+    )
 
 
 def _sharded_complete(dirpath: str) -> bool:
@@ -331,6 +378,7 @@ def save3d(
         rule=np.asarray(rule),
         fingerprint=np.uint32(fingerprint),
     )
+    _tmp_rename_gap()
     os.replace(tmp, path)
     return path
 
@@ -343,10 +391,11 @@ def load3d(path: str) -> Snapshot3D:
     files and wrong-format archives too — not just bad fingerprints.
     """
     import zipfile
+    import zlib
 
     try:
         data = np.load(path)
-    except (zipfile.BadZipFile, ValueError) as e:
+    except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
         raise CorruptSnapshotError(
             f"{path}: not a readable snapshot archive ({e})"
         ) from e
@@ -360,19 +409,24 @@ def load3d(path: str) -> Snapshot3D:
                 f"{sorted(missing)}; a 2-D {CKPT_SUFFIX} checkpoint "
                 "belongs to the 2-D driver)"
             )
-        vol = data["volume"].astype(np.uint8)
-        stored = int(data["fingerprint"])
+        try:
+            vol = data["volume"].astype(np.uint8)
+            generation = int(data["generation"])
+            rule = str(data["rule"])
+            stored = int(data["fingerprint"])
+        except (
+            zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
+        ) as e:
+            raise CorruptSnapshotError(
+                f"{path}: snapshot archive is corrupt ({e})"
+            ) from e
         actual = _vol_fingerprint(vol)
         if stored != actual:
             raise CorruptSnapshotError(
                 f"{path}: stored fingerprint {stored:#010x} != computed "
                 f"{actual:#010x}; the snapshot is corrupt"
             )
-        return Snapshot3D(
-            volume=vol,
-            generation=int(data["generation"]),
-            rule=str(data["rule"]),
-        )
+        return Snapshot3D(volume=vol, generation=generation, rule=rule)
 
 
 # -- sharded checkpoints (multi-host: no host materializes the board) --------
@@ -664,11 +718,13 @@ def _verify_global_stamp(dirpath: str, procs, stamp: int) -> None:
         )
 
 
-def load_sharded_meta(dirpath: str) -> ShardedMeta:
+def load_sharded_meta(dirpath: str, verify_stamp: bool = True) -> ShardedMeta:
     """Read + validate the 2-D manifest: the cover must tile the board
     exactly, and (when a global stamp is present) the per-piece
     fingerprints must add up to it — both checked without assembling any
-    board data."""
+    board data.  ``verify_stamp=False`` skips the global-stamp sweep (it
+    reads every shard file — a multi-host auto-resume validates only its
+    own process's pieces instead, see :func:`verify_snapshot`)."""
     import zipfile
 
     try:
@@ -695,12 +751,14 @@ def load_sharded_meta(dirpath: str) -> ShardedMeta:
             f"(shape {meta.shape}, rect table {meta.rects.shape})"
         )
     _validate_box_cover(dirpath, meta.shape, meta.rects)
-    if meta.fingerprint is not None:
+    if meta.fingerprint is not None and verify_stamp:
         _verify_global_stamp(dirpath, meta.procs, meta.fingerprint)
     return meta
 
 
-def load_sharded3d_meta(dirpath: str) -> Sharded3DMeta:
+def load_sharded3d_meta(
+    dirpath: str, verify_stamp: bool = True
+) -> Sharded3DMeta:
     """3-D counterpart of :func:`load_sharded_meta` (same validation)."""
     import zipfile
 
@@ -727,7 +785,7 @@ def load_sharded3d_meta(dirpath: str) -> Sharded3DMeta:
             f"(shape {meta.shape}, box table {meta.boxes.shape})"
         )
     _validate_box_cover(dirpath, meta.shape, meta.boxes)
-    if meta.fingerprint is not None:
+    if meta.fingerprint is not None and verify_stamp:
         _verify_global_stamp(dirpath, meta.procs, meta.fingerprint)
     return meta
 
@@ -827,3 +885,178 @@ def read_sharded3d_region(
     return _read_region_nd(
         dirpath, meta.shape, meta.boxes, meta.procs, "boxes", index
     )
+
+
+# -- validated snapshot discovery (the resilience tier's read side) ----------
+#
+# `latest()` answers "what is the newest complete-looking snapshot" with a
+# directory listing; the resilience layer needs the stronger question
+# "what is the newest snapshot that would actually LOAD" — a preempted or
+# kill-9'd run must fall back past a corrupt/torn newest candidate instead
+# of dying on CorruptSnapshotError at resume time.  `latest_valid` walks
+# newest→oldest, fully verifying each candidate (fingerprints included),
+# and reports what it skipped so the fallback is loggable.
+
+_GEN_RE = re.compile(r"^ckpt(?:3d)?_(\d+)\.")
+
+
+def snapshot_generation(path: str) -> Optional[int]:
+    """Generation encoded in a snapshot filename, or None."""
+    m = _GEN_RE.match(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _kind_suffixes(kind: str) -> Tuple[str, str, str]:
+    """(prefix, single-file suffix, sharded-dir suffix) for a driver kind."""
+    if kind == "2d":
+        return "ckpt_", CKPT_SUFFIX, SHARD_DIR_SUFFIX
+    if kind == "3d":
+        return "ckpt3d_", CKPT3D_SUFFIX, SHARD3D_DIR_SUFFIX
+    raise ValueError(f"unknown snapshot kind {kind!r}; expected '2d'/'3d'")
+
+
+def list_snapshots(directory: str, kind: str = "2d") -> List[str]:
+    """Every snapshot *candidate* in ``directory``, oldest→newest.
+
+    Includes torn sharded directories and corrupt files — validation is
+    the walk's job, not the listing's.  Leftover ``.tmp.npz`` files from
+    a killed writer never match (their names don't end in a snapshot
+    suffix), so they are invisible here exactly as they are to
+    :func:`latest`.
+    """
+    prefix, single, sharded = _kind_suffixes(kind)
+    if not os.path.isdir(directory):
+        return []
+    names = sorted(
+        f
+        for f in os.listdir(directory)
+        if f.startswith(prefix) and (f.endswith(single) or f.endswith(sharded))
+    )
+    return [os.path.join(directory, f) for f in names]
+
+
+def _verify_pieces_nd(
+    dirpath: str, shape, boxes, procs, box_key: str, only_process=None
+) -> None:
+    """Fingerprint-verify shard pieces without assembling the array.
+
+    ``only_process`` restricts the sweep to one writer process's file —
+    the multi-host auto-resume contract: each rank vouches for the pieces
+    *it* wrote, and the ranks then agree on min(newest valid) so nobody
+    resumes ahead of a rank whose pieces failed.
+    """
+    import zipfile
+    import zlib
+
+    per_proc: dict = {}
+    for row, proc in zip(boxes, procs):
+        proc = int(proc)
+        if only_process is not None and proc != only_process:
+            continue
+        per_proc.setdefault(proc, []).append(tuple(int(x) for x in row))
+    for proc, pboxes in sorted(per_proc.items()):
+        fpath = os.path.join(dirpath, f"shards_{proc:05d}.npz")
+        try:
+            sf = np.load(fpath)
+        except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+            raise CorruptSnapshotError(
+                f"{fpath}: not a readable shard archive ({e})"
+            ) from e
+        with sf:
+            try:
+                table = sf[box_key]
+                fps = sf["fps"]
+                for box in pboxes:
+                    hit = np.nonzero(
+                        np.all(table == np.asarray(box, np.int64), axis=1)
+                    )[0]
+                    if hit.size != 1:
+                        raise CorruptSnapshotError(
+                            f"{dirpath}: piece {box} missing from "
+                            f"shards_{proc:05d}.npz"
+                        )
+                    k = int(hit[0])
+                    data = sf[f"piece_{k}"].astype(np.uint8)
+                    ndim = len(shape)
+                    want = tuple(
+                        box[2 * a + 1] - box[2 * a] for a in range(ndim)
+                    )
+                    if data.shape != want:
+                        raise CorruptSnapshotError(
+                            f"{dirpath}: piece {box} has shape "
+                            f"{data.shape}, expected {want}"
+                        )
+                    stored = int(fps[k])
+                    actual = _piece_fp(data, box, shape)
+                    if stored != actual:
+                        raise CorruptSnapshotError(
+                            f"{dirpath}: piece {box} fingerprint "
+                            f"{actual:#010x} != stored {stored:#010x}; the "
+                            "shard file is corrupt"
+                        )
+            except CorruptSnapshotError:
+                raise
+            except (
+                zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
+            ) as e:
+                raise CorruptSnapshotError(
+                    f"{fpath}: shard archive is corrupt ({e})"
+                ) from e
+
+
+def verify_snapshot(path: str, only_process: Optional[int] = None) -> int:
+    """Fully validate one snapshot (any format); return its generation.
+
+    Single-file snapshots load + fingerprint-verify end to end; sharded
+    directories validate the manifest (cover + global stamp) and
+    fingerprint-verify every piece — or, with ``only_process``, only that
+    process's pieces and no global stamp (each rank vouches for its own
+    writes; cross-rank agreement happens at the resume-generation min).
+    Raises :class:`CorruptSnapshotError` (or ``OSError`` for a vanished
+    file) when the snapshot cannot be trusted.
+    """
+    name = os.path.basename(path)
+    if name.endswith(SHARD_DIR_SUFFIX) or name.endswith(SHARD3D_DIR_SUFFIX):
+        if not _sharded_complete(path):
+            raise CorruptSnapshotError(
+                f"{path}: torn sharded checkpoint (manifest or shard "
+                "files missing)"
+            )
+        verify_stamp = only_process is None
+        if name.endswith(SHARD3D_DIR_SUFFIX):
+            meta3 = load_sharded3d_meta(path, verify_stamp=verify_stamp)
+            _verify_pieces_nd(
+                path, meta3.shape, meta3.boxes, meta3.procs, "boxes",
+                only_process,
+            )
+            return meta3.generation
+        meta = load_sharded_meta(path, verify_stamp=verify_stamp)
+        _verify_pieces_nd(
+            path, meta.shape, meta.rects, meta.procs, "rects", only_process
+        )
+        return meta.generation
+    if name.endswith(CKPT3D_SUFFIX):
+        return load3d(path).generation
+    if name.endswith(CKPT_SUFFIX):
+        return load(path).generation
+    raise CorruptSnapshotError(f"{path}: not a snapshot path")
+
+
+def latest_valid(
+    directory: str, kind: str = "2d", only_process: Optional[int] = None
+) -> Tuple[Optional[str], List[str]]:
+    """Newest snapshot that fully verifies, walking newest→oldest.
+
+    Returns ``(path_or_None, skipped)`` where ``skipped`` lists the
+    *newer* candidates rejected as corrupt/torn (in the order they were
+    rejected) — a nonempty list is the "fallback happened" signal the
+    resume telemetry event records.
+    """
+    skipped: List[str] = []
+    for path in reversed(list_snapshots(directory, kind)):
+        try:
+            verify_snapshot(path, only_process=only_process)
+            return path, skipped
+        except (CorruptSnapshotError, OSError):
+            skipped.append(path)
+    return None, skipped

@@ -385,6 +385,17 @@ def run_guarded(
                     events=events,
                     chunk_utilization=rt.chunk_utilization,
                     checkpoint_overlapped=writer is not None,
+                    # Audited boards only ever reach the hook — a
+                    # preemption snapshot can't capture corruption the
+                    # guard would catch.
+                    preempt_hook=lambda b, g, fp, saved: rt._preempt(
+                        GolState.create(b, g),
+                        sw,
+                        writer,
+                        events,
+                        fingerprint=fp,
+                        already_saved=saved,
+                    ),
                 )
             if writer is not None:
                 with sw.phase("checkpoint"):
@@ -417,6 +428,7 @@ def guarded_loop(
     events=None,
     chunk_utilization=None,
     checkpoint_overlapped: bool = False,
+    preempt_hook=None,
 ):
     """The chunk/audit/rollback core, shared by the 2-D and 3-D drivers.
 
@@ -433,6 +445,13 @@ def guarded_loop(
     snapshot.  ``chunk_utilization(take, wall_s)`` maps a chunk to its
     roofline fraction (``None`` skips the column).  All emission is
     host-side, after the ``force_ready`` fences.
+
+    ``preempt_hook(board, generation, fingerprint, just_checkpointed)``
+    is the cooperative-preemption exit (gol_tpu/resilience/): called at
+    a chunk boundary — after the audit certified the board and any due
+    checkpoint landed — when a preemption was requested and work
+    remains.  The hook persists/fences a final snapshot and raises
+    ``Preempted``; only audited-good boards ever reach it.
     """
     import time as time_mod
 
@@ -538,6 +557,7 @@ def guarded_loop(
             # audit.fingerprint is this exact board's stamp (just computed
             # on device) — recorded for the base-integrity check above.
             last_good = (_device_copy(board), generation, audit.fingerprint)
+        just_checkpointed = False
         if next_ckpt is not None and generation >= next_ckpt:
             with telemetry_mod.trace_annotation("gol.checkpoint.save"):
                 with sw.phase("checkpoint"):
@@ -555,5 +575,13 @@ def guarded_loop(
                     overlapped=checkpoint_overlapped,
                 )
             next_ckpt = generation + checkpoint_every
+            just_checkpointed = True
+        if preempt_hook is not None and i < len(schedule) - 1:
+            from gol_tpu import resilience
+
+            if resilience.agreed_preempt_requested():
+                preempt_hook(
+                    board, generation, audit.fingerprint, just_checkpointed
+                )
         i += 1
     return board, generation

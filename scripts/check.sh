@@ -9,26 +9,29 @@
 #                       schema-validate the stream and exit 0)
 #   4. stats smoke     (same run with --stats; summarize must exit 0
 #                       and report a population row)
-#   5. tier-1 tests    (the exact ROADMAP.md command)
+#   5. resilience drill (supervised run, SIGTERM the child once;
+#                       auto-resume must finish with the same
+#                       final-grid hash as an uninterrupted run)
+#   6. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/5] lint =="
+echo "== [1/6] lint =="
 bash scripts/lint.sh
 
-echo "== [2/5] static verifier (gol_tpu.analysis) =="
+echo "== [2/6] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/5] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/6] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/5] stats smoke (in-graph simulation statistics) =="
+echo "== [4/6] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -37,7 +40,10 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/5] tier-1 tests =="
+echo "== [5/6] resilience drill (docs/RESILIENCE.md) =="
+JAX_PLATFORMS=cpu python scripts/resilience_drill.py
+
+echo "== [6/6] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

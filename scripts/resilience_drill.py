@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Resilience smoke drill (scripts/check.sh stage): preempt + auto-resume.
+
+Runs the 2-D driver twice over the same world:
+
+1. uninterrupted, recording the final rank dump's hash;
+2. under ``python -m gol_tpu.resilience supervise`` with checkpointing +
+   ``--auto-resume``, SIGTERM-ing the child once as soon as its first
+   checkpoint lands — the child must exit 75 (preempted), the supervisor
+   must relaunch it, and the resumed run must finish with a final dump
+   **hashing identically** to the uninterrupted run.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.  Pure
+stdlib + the repo (no pytest), CPU backend, a few seconds of wall clock.
+The heavier kill-9 chaos matrix lives in tests/test_resilience_drill.py
+(``-m slow``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Big enough that ~27 chunks outlast the parent's signal latency by a
+# wide margin, small enough to stay a smoke test.
+WORLD = ["4", "1024", "54", "512", "1"]
+CHUNK = "2"
+DUMP = "Rank_0_of_1.txt"
+
+
+def sha(path: str) -> str:
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def fail(msg: str) -> None:
+    print(f"resilience-drill: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    with tempfile.TemporaryDirectory() as tmp:
+        ref = os.path.join(tmp, "ref")
+        out = os.path.join(tmp, "out")
+        ck = os.path.join(tmp, "ck")
+        manifest = os.path.join(tmp, "job.manifest.json")
+        os.makedirs(ref)
+        os.makedirs(out)
+
+        print("resilience-drill: [1/3] uninterrupted reference run")
+        subprocess.run(
+            [sys.executable, "-m", "gol_tpu", *WORLD, "--outdir", ref],
+            env=env, cwd=REPO, check=True,
+        )
+        want = sha(os.path.join(ref, DUMP))
+
+        print("resilience-drill: [2/3] supervised run, SIGTERM once")
+        sup = subprocess.Popen(
+            [
+                sys.executable, "-m", "gol_tpu.resilience", "supervise",
+                "--max-restarts", "3", "--backoff-base", "0",
+                "--manifest", manifest, "--checkpoint-dir", ck, "--",
+                sys.executable, "-m", "gol_tpu", *WORLD,
+                "--outdir", out,
+                "--checkpoint-every", CHUNK, "--checkpoint-dir", ck,
+                "--auto-resume",
+            ],
+            env=env, cwd=REPO,
+        )
+        # Signal the CHILD (not the supervisor: signalling the supervisor
+        # means "stop the job") once its first checkpoint is durable.
+        deadline = time.time() + 120
+        child_pid = None
+        while time.time() < deadline:
+            if sup.poll() is not None:
+                fail(
+                    f"supervisor exited {sup.returncode} before the drill "
+                    "could signal the child"
+                )
+            has_ckpt = os.path.isdir(ck) and any(
+                n.endswith(".gol.npz") for n in os.listdir(ck)
+            )
+            if has_ckpt and os.path.exists(manifest):
+                with open(manifest) as f:
+                    m = json.load(f)
+                att = m.get("attempts") or []
+                if att and att[-1].get("pid"):
+                    child_pid = att[-1]["pid"]
+                    break
+            time.sleep(0.02)
+        if child_pid is None:
+            sup.kill()
+            fail("no checkpoint/manifest appeared within 120s")
+        try:
+            os.kill(child_pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass  # child already finished this attempt — assert below
+        rc = sup.wait(timeout=240)
+        if rc != 0:
+            fail(f"supervisor exited {rc}; see manifest {manifest}")
+
+        print("resilience-drill: [3/3] verify manifest + final-grid hash")
+        with open(manifest) as f:
+            m = json.load(f)
+        codes = [a["exit_code"] for a in m["attempts"]]
+        if 75 not in codes[:-1]:
+            fail(
+                f"expected a preempted (75) attempt before the final one, "
+                f"got exit codes {codes} — the SIGTERM raced the run; "
+                "see the manifest"
+            )
+        if codes[-1] != 0 or not m.get("finished"):
+            fail(f"final attempt did not finish cleanly: {codes}")
+        got = sha(os.path.join(out, DUMP))
+        if got != want:
+            fail(
+                f"final grid hash mismatch after preempt+resume: "
+                f"{got} != {want}"
+            )
+        print(
+            f"resilience-drill: OK — attempts {codes}, final grid "
+            f"sha256 {got[:16]}... matches uninterrupted run"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -485,3 +485,201 @@ def test_two_process_cli3d_sharded_guard_and_resume(tmp_path):
     a = np.load(out_sp / "World3D_of_1.npy")
     np.testing.assert_array_equal(np.load(out_mh / "World3D_of_1.npy"), a)
     np.testing.assert_array_equal(np.load(out_rs / "World3D_of_1.npy"), a)
+
+
+# Multi-host resume agreement (docs/RESILIENCE.md): after a 6-generation
+# run with sharded checkpoints at gens 2/4/6, rank 1 corrupts its OWN
+# piece of the newest snapshot, and both ranks --auto-resume with a
+# total target of 12.  Each rank validates only the pieces it wrote, so
+# rank 0 still trusts gen 6 — the min-generation agreement must drag
+# both ranks back to gen 4 (no rank resumes ahead of another), and the
+# resumed job's dumps must byte-match the unbroken 12-generation run.
+_WORKER_AUTORESUME = textwrap.dedent(
+    """
+    import os
+    import sys
+
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
+    from gol_tpu import cli
+    from gol_tpu.utils import checkpoint as ckpt_mod
+    pid = sys.argv[1]
+    ckdir, outdir, tmdir = sys.argv[3], sys.argv[4], sys.argv[5]
+    rc = cli.main([
+        "4", "8", "6", "16", "0",
+        "--ranks", "4", "--mesh", "1d",
+        "--coordinator", sys.argv[2],
+        "--num-processes", "2", "--process-id", pid,
+        "--checkpoint-every", "2", "--checkpoint-dir", ckdir,
+    ])
+    if rc == 0:
+        if pid == "1":
+            # Corrupt rank 1's own piece of the NEWEST snapshot (stored
+            # fingerprints untouched, so only verification catches it).
+            shards = os.path.join(
+                ckpt_mod.sharded_checkpoint_path(ckdir, 6),
+                "shards_00001.npz",
+            )
+            with np.load(shards) as data:
+                arrays = {k: data[k].copy() for k in data.files}
+            arrays["piece_0"][0, 0] ^= 1  # in-range flip
+            np.savez_compressed(shards, **arrays)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("corruption_injected")
+        rc = cli.main([
+            "4", "8", "12", "16", "1",
+            "--ranks", "4", "--mesh", "1d",
+            "--checkpoint-every", "2", "--checkpoint-dir", ckdir,
+            "--auto-resume",
+            "--outdir", outdir,
+            "--telemetry", tmdir, "--run-id", "ar",
+        ])
+    sys.exit(rc)
+    """
+)
+
+
+def test_two_process_auto_resume_min_generation_agreement(tmp_path):
+    import json
+
+    ck = tmp_path / "ck"
+    out_mh = tmp_path / "mh"
+    out_sp = tmp_path / "sp"
+    tm = tmp_path / "tm"
+    out_mh.mkdir()
+
+    outs = _run_two_workers(
+        _WORKER_AUTORESUME, [str(ck), str(out_mh), str(tm)]
+    )
+    # The coordinator logged the agreed fallback generation.
+    assert "auto-resume: generation 4" in outs[0][1]
+
+    # Unbroken single-process run of the same 12 generations.
+    from gol_tpu import cli
+
+    assert (
+        cli.main(["4", "8", "12", "16", "1", "--ranks", "4",
+                  "--outdir", str(out_sp)])
+        == 0
+    )
+    for r in range(4):
+        name = gol_io.rank_filename(r, 4)
+        assert (out_mh / name).read_bytes() == (
+            out_sp / name
+        ).read_bytes(), f"rank {r} dump differs after agreed fallback"
+
+    # Both ranks' telemetry recorded the same fallback resume decision.
+    for rank in (0, 1):
+        recs = [
+            json.loads(ln) for ln in open(tm / f"ar.rank{rank}.jsonl")
+        ]
+        res = [rec for rec in recs if rec["event"] == "resume"]
+        assert len(res) == 1, res
+        assert res[0]["generation"] == 4 and res[0]["fallback"] is True
+
+
+# Collective preemption (docs/RESILIENCE.md): SIGTERM is delivered to
+# ONE worker only.  The chunk-boundary poll is an allgathered max, so
+# BOTH ranks must preempt at the same boundary (a rank exiting alone
+# would strand its peer in the next chunk's collectives), both exit 75
+# with the sharded boundary snapshot on disk, and both then auto-resume
+# to the total target — dumps byte-equal to the unbroken run.
+_WORKER_PREEMPT = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from gol_tpu import compat as _compat
+    _compat.set_cpu_device_count(2)
+    from gol_tpu import cli
+    pid = sys.argv[1]
+    ckdir, outdir = sys.argv[3], sys.argv[4]
+    args = [
+        "4", "16", "200", "16", "1",
+        "--ranks", "4", "--mesh", "1d",
+        "--checkpoint-every", "2", "--checkpoint-dir", ckdir,
+        "--auto-resume", "--outdir", outdir,
+    ]
+    rc = cli.main(args + [
+        "--coordinator", sys.argv[2],
+        "--num-processes", "2", "--process-id", pid,
+    ])
+    print("FIRST_RC", rc, flush=True)
+    if rc == 75:
+        # Relaunch with identical argv (the supervisor contract): the
+        # already-connected topology is reused, auto-resume completes
+        # the remaining generations to the 200 target.
+        rc = cli.main(args)
+        sys.exit(rc)
+    sys.exit(rc if rc else 99)  # 99: the SIGTERM raced the whole run
+    """
+)
+
+
+def test_two_process_collective_preemption(tmp_path):
+    import time as time_mod
+
+    ck = tmp_path / "ck"
+    out_mh = tmp_path / "mh"
+    out_sp = tmp_path / "sp"
+    out_mh.mkdir()
+
+    coord = f"localhost:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER_PREEMPT, str(i), coord,
+             str(ck), str(out_mh)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            env=env,
+            cwd=repo,
+        )
+        for i in range(2)
+    ]
+    try:
+        # SIGTERM worker 0 ONLY, once its first sharded snapshot exists.
+        deadline = time_mod.time() + 180
+        while time_mod.time() < deadline:
+            if ck.is_dir() and any(
+                n.name.endswith(".gol.d") for n in ck.iterdir()
+            ):
+                break
+            if procs[0].poll() is not None:
+                break  # raced: worker finished before any signal
+            time_mod.sleep(0.01)
+        if procs[0].poll() is None:
+            procs[0].send_signal(subprocess.signal.SIGTERM)
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err}"
+    # BOTH ranks took the cooperative exit — including rank 1, which
+    # never received a signal (the allgathered flag preempted it).
+    assert "FIRST_RC 75" in outs[0][1], outs[0][1]
+    assert "FIRST_RC 75" in outs[1][1], outs[1][1]
+
+    from gol_tpu import cli
+
+    assert (
+        cli.main(["4", "16", "200", "16", "1", "--ranks", "4",
+                  "--outdir", str(out_sp)])
+        == 0
+    )
+    for r in range(4):
+        name = gol_io.rank_filename(r, 4)
+        assert (out_mh / name).read_bytes() == (
+            out_sp / name
+        ).read_bytes(), f"rank {r} dump differs after preempt+resume"

@@ -1,0 +1,693 @@
+"""Process-tier resilience tests (gol_tpu/resilience/, docs/RESILIENCE.md).
+
+What they pin:
+
+- **validated discovery**: ``latest_valid`` skips corrupt single-file
+  snapshots, torn sharded directories, and writer ``.tmp`` leftovers,
+  and reports what it skipped (the fallback signal);
+- **cooperative preemption**: a requested preemption (flag or a real
+  SIGTERM) stops ``run``/``run_guarded``/the 3-D driver at the next
+  chunk boundary with a final fingerprinted checkpoint, a ``preempt``
+  telemetry event, and exit code 75 — and the resumed run completes the
+  total-iteration target bit-exactly;
+- **retention GC**: keep-last-K valid, never the resume source, corrupt
+  files left as evidence, ``.tmp`` swept;
+- **supervisor**: restarts on crash/preemption, bounded budget, manifest
+  records attempts/exit codes/resume generations;
+- **no-op**: with resilience knobs set but nothing delivered, traced
+  programs are byte-identical (extends the PR 2/3 trace-identity pin);
+- **async-writer satellites**: a writer failure on the *final* snapshot
+  still surfaces at end of run, and a ``.tmp`` file left by a killed
+  writer is never picked up by ``latest``/``latest_valid``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu import resilience
+from gol_tpu.models.state import Geometry
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.utils import checkpoint as ckpt
+
+from tests import oracle
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _corrupt_byte(path, offset_frac=0.5):
+    with open(path, "r+b") as f:
+        f.seek(int(os.path.getsize(path) * offset_frac))
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _make_ckpts(tmp_path, gens=(4, 8, 12), size=16):
+    board = oracle.random_board(size, size, seed=1)
+    paths = []
+    for g in gens:
+        p = ckpt.checkpoint_path(str(tmp_path), g)
+        ckpt.save(p, board, g, 1)
+        paths.append(p)
+    return paths
+
+
+# -- validated discovery -----------------------------------------------------
+
+
+def test_latest_valid_skips_corrupt_newest(tmp_path):
+    p4, p8, p12 = _make_ckpts(tmp_path)
+    _corrupt_byte(p12)
+    # latest() still prefers the corrupt file (satellite: the raw listing
+    # can't know); latest_valid is the one that must not.
+    assert ckpt.latest(str(tmp_path)) == p12
+    path, skipped = ckpt.latest_valid(str(tmp_path))
+    assert path == p8
+    assert skipped == [p12]
+
+
+def test_latest_valid_walks_past_multiple_bad(tmp_path):
+    p4, p8, p12 = _make_ckpts(tmp_path)
+    board = oracle.random_board(16, 16, seed=1)
+    # Deterministic corruption: a stored fingerprint that can't match.
+    ckpt.save(p12, board, 12, 1, fingerprint=0xDEADBEEF)
+    ckpt.save(p8, board, 8, 1, fingerprint=0xDEADBEEF)
+    path, skipped = ckpt.latest_valid(str(tmp_path))
+    assert path == p4 and skipped == [p12, p8]
+    ckpt.save(p4, board, 4, 1, fingerprint=0xDEADBEEF)
+    path, skipped = ckpt.latest_valid(str(tmp_path))
+    assert path is None and len(skipped) == 3
+
+
+def test_latest_valid_ignores_tmp_files(tmp_path):
+    (p4,) = _make_ckpts(tmp_path, gens=(4,))
+    # A killed writer leaves ckpt_<g>.gol.npz.tmp.npz — never a candidate.
+    tmp = ckpt.checkpoint_path(str(tmp_path), 8) + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        f.write(b"torn half-written garbage")
+    assert ckpt.latest(str(tmp_path)) == p4
+    path, skipped = ckpt.latest_valid(str(tmp_path))
+    assert path == p4 and skipped == []
+    assert tmp not in ckpt.list_snapshots(str(tmp_path))
+
+
+def test_latest_valid_skips_torn_and_corrupt_sharded(tmp_path):
+    from tests.test_checkpoint import _sharded_board
+
+    _, arr, _ = _sharded_board(seed=11)
+    good = ckpt.sharded_checkpoint_path(str(tmp_path), 10)
+    ckpt.save_sharded(good, arr, 10, 1)
+    # Torn: manifest missing.
+    os.makedirs(ckpt.sharded_checkpoint_path(str(tmp_path), 20))
+    # Corrupt: complete dir, one piece byte-flipped (fps stay stored).
+    bad = ckpt.sharded_checkpoint_path(str(tmp_path), 30)
+    ckpt.save_sharded(bad, arr, 30, 1)
+    shards = os.path.join(bad, "shards_00000.npz")
+    with np.load(shards) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    arrays["piece_0"][0, 0] ^= 1  # in-range flip; piece fp must catch it
+    np.savez_compressed(shards, **arrays)
+    path, skipped = ckpt.latest_valid(str(tmp_path))
+    assert path == good
+    assert skipped == [bad, os.path.join(str(tmp_path), "ckpt_000000000020.gol.d")]
+
+
+def test_verify_snapshot_only_process_checks_own_pieces(tmp_path):
+    """only_process=0 must pass a dir whose *other* process's piece is
+    bad — each rank vouches only for its own writes; the min-generation
+    agreement handles the rest."""
+    from tests.test_checkpoint import _sharded_board
+
+    _, arr, _ = _sharded_board(seed=12)
+    d = ckpt.sharded_checkpoint_path(str(tmp_path), 5)
+    ckpt.save_sharded(d, arr, 5, 1)
+    # Forge a second process's shard file, then corrupt it: rewrite the
+    # manifest so one rect belongs to proc 1 with its own shards file.
+    shards0 = os.path.join(d, "shards_00000.npz")
+    with np.load(shards0) as data:
+        arrays = {k: data[k].copy() for k in data.files}
+    n = len(arrays["rects"])
+    keep, give = list(range(n - 1)), n - 1
+    moved = dict(
+        rects=arrays["rects"][[give]].copy(),
+        fps=arrays["fps"][[give]].copy(),
+        piece_0=arrays[f"piece_{give}"].copy(),
+    )
+    moved["piece_0"][0, 0] ^= 1  # corrupt proc 1's piece
+    np.savez_compressed(os.path.join(d, "shards_00001.npz"), **moved)
+    kept = dict(
+        rects=arrays["rects"][keep].copy(), fps=arrays["fps"][keep].copy()
+    )
+    for i, k in enumerate(keep):
+        kept[f"piece_{i}"] = arrays[f"piece_{k}"]
+    np.savez_compressed(shards0, **kept)
+    mpath = os.path.join(d, "manifest.npz")
+    with np.load(mpath) as data:
+        man = {k: data[k].copy() for k in data.files}
+    procs = man["procs"].copy()
+    hit = np.nonzero(np.all(man["rects"] == moved["rects"][0], axis=1))[0]
+    procs[hit] = 1
+    man["procs"] = procs
+    np.savez_compressed(mpath, **man)
+
+    assert ckpt.verify_snapshot(d, only_process=0) == 5
+    with pytest.raises(ckpt.CorruptSnapshotError):
+        ckpt.verify_snapshot(d, only_process=1)
+    with pytest.raises(ckpt.CorruptSnapshotError):
+        ckpt.verify_snapshot(d)  # full check sees every piece
+
+
+# -- cooperative preemption --------------------------------------------------
+
+
+def _final_board(iterations=12, size=32):
+    rt = GolRuntime(geometry=Geometry(size=size, num_ranks=1))
+    _, st = rt.run(pattern=4, iterations=iterations)
+    return np.asarray(st.board)
+
+
+def test_run_preempts_at_chunk_boundary_with_checkpoint(tmp_path):
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path),
+    )
+    resilience.request_preemption()
+    try:
+        with pytest.raises(resilience.Preempted) as ei:
+            rt.run(pattern=4, iterations=12)
+    finally:
+        resilience.clear_preemption()
+    assert ei.value.generation == 2
+    assert ei.value.checkpoint_dir == str(tmp_path)
+    # The boundary snapshot is durable (writer was flushed pre-raise).
+    snap = ckpt.load(ckpt.latest(str(tmp_path)))
+    assert snap.generation == 2
+
+
+def test_preempt_resume_completes_bit_exactly(tmp_path):
+    want = _final_board()
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path),
+    )
+    resilience.request_preemption()
+    try:
+        with pytest.raises(resilience.Preempted):
+            rt.run(pattern=4, iterations=12)
+    finally:
+        resilience.clear_preemption()
+    path, info = resilience.resolve_auto_resume(str(tmp_path))
+    assert path is not None and not info["fallback"]
+    rt2 = GolRuntime(geometry=Geometry(size=32, num_ranks=1))
+    _, st = rt2.run(
+        pattern=4, iterations=12 - info["generation"], resume=path
+    )
+    np.testing.assert_array_equal(np.asarray(st.board), want)
+
+
+def test_preempt_without_checkpoint_dir_reports_uncheckpointed():
+    rt = GolRuntime(geometry=Geometry(size=32, num_ranks=1))
+    # Force a multi-chunk schedule without checkpoints: use guard chunks.
+    from gol_tpu.utils.guard import GuardConfig, run_guarded
+
+    resilience.request_preemption()
+    try:
+        with pytest.raises(resilience.Preempted) as ei:
+            run_guarded(
+                rt, pattern=4, iterations=12, config=GuardConfig(check_every=3)
+            )
+    finally:
+        resilience.clear_preemption()
+    assert ei.value.generation == 3
+    assert ei.value.checkpoint_dir is None
+
+
+def test_guarded_preempt_saves_audited_checkpoint(tmp_path):
+    from gol_tpu.utils.guard import GuardConfig, run_guarded
+
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=100,  # no cadence checkpoint before the preempt
+        checkpoint_dir=str(tmp_path),
+    )
+    resilience.request_preemption()
+    try:
+        with pytest.raises(resilience.Preempted) as ei:
+            run_guarded(
+                rt, pattern=4, iterations=12, config=GuardConfig(check_every=3)
+            )
+    finally:
+        resilience.clear_preemption()
+    assert ei.value.generation == 3
+    snap = ckpt.load(ckpt.latest(str(tmp_path)))  # fingerprint re-verified
+    assert snap.generation == 3
+    board0 = np.asarray(
+        GolRuntime(geometry=Geometry(size=32, num_ranks=1))
+        .run(pattern=4, iterations=3)[1]
+        .board
+    )
+    np.testing.assert_array_equal(snap.board, board0)
+
+
+def test_cli_preempt_exit_code_and_event(tmp_path, capsys):
+    from gol_tpu import cli
+
+    resilience.request_preemption()
+    rc = cli.main(
+        ["4", "32", "12", "512", "0", "--checkpoint-every", "2",
+         "--checkpoint-dir", str(tmp_path / "ck"),
+         "--telemetry", str(tmp_path / "tm"), "--run-id", "p"]
+    )
+    assert not resilience.preempt_requested()  # guard cleared it
+    assert rc == resilience.EX_TEMPFAIL == 75
+    assert "preempted at generation 2" in capsys.readouterr().out
+    recs = [
+        json.loads(ln) for ln in open(tmp_path / "tm" / "p.rank0.jsonl")
+    ]
+    pre = [r for r in recs if r["event"] == "preempt"]
+    assert pre == [
+        {**pre[0], "generation": 2, "checkpointed": True}
+    ]
+
+
+def test_cli_sigterm_preempts(tmp_path, capsys):
+    """A real SIGTERM delivered mid-run lands on the installed handler
+    and converts to the cooperative path (in-process: the signal is sent
+    from a timer thread to our own pid)."""
+    from gol_tpu import cli
+
+    timer = threading.Timer(
+        0.15, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    timer.start()
+    try:
+        # Large enough that chunks are still running at t=0.15s.
+        rc = cli.main(
+            ["4", "512", "400", "512", "0", "--engine", "dense",
+             "--checkpoint-every", "2",
+             "--checkpoint-dir", str(tmp_path / "ck")]
+        )
+    finally:
+        timer.cancel()
+    assert rc == resilience.EX_TEMPFAIL
+    out = capsys.readouterr().out
+    assert "preempted at generation" in out
+    path, info = resilience.resolve_auto_resume(str(tmp_path / "ck"))
+    assert path is not None and info["generation"] >= 2
+
+
+def test_cli3d_preempt_and_auto_resume(tmp_path, capsys):
+    from gol_tpu import cli3d
+
+    resilience.request_preemption()
+    rc = cli3d.main(
+        ["2", "16", "9", "64", "0", "--checkpoint-every", "3",
+         "--checkpoint-dir", str(tmp_path / "ck")]
+    )
+    assert rc == 75
+    rc = cli3d.main(
+        ["2", "16", "9", "64", "1", "--checkpoint-every", "3",
+         "--checkpoint-dir", str(tmp_path / "ck"), "--auto-resume",
+         "--outdir", str(tmp_path / "out")]
+    )
+    assert rc == 0
+    assert "auto-resume: generation 3" in capsys.readouterr().out
+    rc = cli3d.main(
+        ["2", "16", "9", "64", "1", "--outdir", str(tmp_path / "ref")]
+    )
+    assert rc == 0
+    np.testing.assert_array_equal(
+        np.load(tmp_path / "out" / "World3D_of_1.npy"),
+        np.load(tmp_path / "ref" / "World3D_of_1.npy"),
+    )
+
+
+def test_auto_resume_iterations_are_total_target(tmp_path, capsys):
+    """Relaunching the IDENTICAL argv after a preemption completes the
+    remaining generations — the invariant the supervisor relies on."""
+    from gol_tpu import cli
+    from gol_tpu.utils import io as gol_io
+
+    argv = ["4", "32", "12", "512", "1", "--checkpoint-every", "2",
+            "--checkpoint-dir", str(tmp_path / "ck"), "--auto-resume",
+            "--outdir", str(tmp_path / "out")]
+    resilience.request_preemption()
+    assert cli.main(argv) == 75
+    assert cli.main(argv) == 0  # same argv, remaining 10 generations
+    rc = cli.main(
+        ["4", "32", "12", "512", "1", "--outdir", str(tmp_path / "ref")]
+    )
+    assert rc == 0
+    name = gol_io.rank_filename(0, 1)
+    assert (tmp_path / "out" / name).read_bytes() == (
+        tmp_path / "ref" / name
+    ).read_bytes()
+    # Already at the target: a third identical launch does no work and
+    # exits 0 (idempotent completion).
+    assert cli.main(argv) == 0
+
+
+def test_auto_resume_rejects_explicit_resume(capsys):
+    from gol_tpu import cli
+
+    rc = cli.main(
+        ["4", "32", "4", "512", "0", "--auto-resume", "--resume", "x.npz"]
+    )
+    assert rc == 255
+    assert "one of --resume/--auto-resume" in capsys.readouterr().out
+
+
+def test_corrupt_plain_resume_prints_fallback_hint(tmp_path, capsys):
+    from gol_tpu import cli
+
+    rc = cli.main(
+        ["4", "32", "12", "512", "0", "--checkpoint-every", "4",
+         "--checkpoint-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    latest = ckpt.latest(str(tmp_path))
+    _corrupt_byte(latest)
+    rc = cli.main(["4", "32", "2", "512", "0", "--resume", latest])
+    out = capsys.readouterr().out
+    assert rc == 255
+    assert "hint: an earlier valid snapshot exists at" in out
+    assert "ckpt_000000000008" in out
+
+
+# -- retention GC ------------------------------------------------------------
+
+
+def test_gc_keeps_last_k_valid_and_protects_resume_source(tmp_path):
+    board = oracle.random_board(16, 16, seed=2)
+    paths = {
+        g: ckpt.save(ckpt.checkpoint_path(str(tmp_path), g), board, g, 1)
+        for g in (2, 4, 6, 8, 10)
+    }
+    deleted = resilience.gc_snapshots(
+        str(tmp_path), keep=2, protect=(paths[4],)
+    )
+    left = [os.path.basename(p) for p in ckpt.list_snapshots(str(tmp_path))]
+    assert left == [
+        "ckpt_000000000004.gol.npz",  # protected resume source
+        "ckpt_000000000008.gol.npz",
+        "ckpt_000000000010.gol.npz",
+    ]
+    assert sorted(deleted) == sorted([paths[2], paths[6]])
+    # Idempotent.
+    assert resilience.gc_snapshots(
+        str(tmp_path), keep=2, protect=(paths[4],)
+    ) == []
+
+
+def test_gc_never_counts_corrupt_newest_toward_k(tmp_path):
+    board = oracle.random_board(16, 16, seed=3)
+    for g in (2, 4, 6, 8):
+        ckpt.save(ckpt.checkpoint_path(str(tmp_path), g), board, g, 1)
+    _corrupt_byte(ckpt.checkpoint_path(str(tmp_path), 8))
+    resilience.gc_snapshots(str(tmp_path), keep=2)
+    left = [os.path.basename(p) for p in ckpt.list_snapshots(str(tmp_path))]
+    # 8 is corrupt (kept as evidence, not counted); valid kept: 6, 4.
+    assert left == [
+        "ckpt_000000000004.gol.npz",
+        "ckpt_000000000006.gol.npz",
+        "ckpt_000000000008.gol.npz",
+    ]
+
+
+def test_gc_sweeps_writer_tmp_files(tmp_path):
+    board = oracle.random_board(16, 16, seed=4)
+    ckpt.save(ckpt.checkpoint_path(str(tmp_path), 2), board, 2, 1)
+    tmp = ckpt.checkpoint_path(str(tmp_path), 4) + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        f.write(b"half a snapshot")
+    deleted = resilience.gc_snapshots(str(tmp_path), keep=3)
+    assert tmp in deleted and not os.path.exists(tmp)
+
+
+def test_runtime_gc_during_run_protects_resume_source(tmp_path):
+    """keep_snapshots wired through the runtime: after a resumed run with
+    checkpointing, only the newest K + the resume source remain."""
+    seed_dir = tmp_path / "a"
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=2,
+        checkpoint_dir=str(seed_dir),
+        keep_snapshots=2,
+    )
+    rt.run(pattern=4, iterations=10)
+    names = [os.path.basename(p) for p in ckpt.list_snapshots(str(seed_dir))]
+    assert names == [
+        "ckpt_000000000008.gol.npz", "ckpt_000000000010.gol.npz"
+    ]
+    resume = ckpt.checkpoint_path(str(seed_dir), 8)
+    rt2 = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=2,
+        checkpoint_dir=str(seed_dir),
+        keep_snapshots=2,
+    )
+    _, st = rt2.run(pattern=4, iterations=10, resume=resume)
+    names = [os.path.basename(p) for p in ckpt.list_snapshots(str(seed_dir))]
+    assert names == [
+        "ckpt_000000000008.gol.npz",  # resume source survives the sweep
+        "ckpt_000000000016.gol.npz",
+        "ckpt_000000000018.gol.npz",
+    ]
+    np.testing.assert_array_equal(
+        np.asarray(st.board), _final_board(iterations=18, size=32)
+    )
+
+
+# -- supervisor --------------------------------------------------------------
+
+
+_FLAKY_CHILD = """
+import os, sys
+state = sys.argv[1]
+n = int(open(state).read()) if os.path.exists(state) else 0
+open(state, "w").write(str(n + 1))
+attempt = os.environ.get("GOL_RESTART_ATTEMPT")
+assert attempt == str(n), (attempt, n)
+sys.exit(int(sys.argv[2]) if n < int(sys.argv[3]) else 0)
+"""
+
+
+def test_supervisor_restarts_until_success(tmp_path):
+    state = str(tmp_path / "count")
+    manifest = str(tmp_path / "m.json")
+    rc = resilience.supervise(
+        [sys.executable, "-c", _FLAKY_CHILD, state, "75", "2"],
+        max_restarts=5,
+        backoff_base=0.0,
+        manifest_path=manifest,
+        run_id="job",
+    )
+    assert rc == 0
+    m = json.load(open(manifest))
+    assert m["finished"] is True and m["final_exit"] == 0
+    assert [a["exit_code"] for a in m["attempts"]] == [75, 75, 0]
+    assert [a["attempt"] for a in m["attempts"]] == [0, 1, 2]
+    assert all(a["pid"] for a in m["attempts"])
+    assert m["run_id"] == "job"
+
+
+def test_supervisor_budget_exhaustion_returns_last_code(tmp_path):
+    state = str(tmp_path / "count")
+    manifest = str(tmp_path / "m.json")
+    rc = resilience.supervise(
+        [sys.executable, "-c", _FLAKY_CHILD, state, "7", "99"],
+        max_restarts=2,
+        backoff_base=0.0,
+        manifest_path=manifest,
+    )
+    assert rc == 7
+    m = json.load(open(manifest))
+    assert m["finished"] is False and m["final_exit"] == 7
+    assert [a["exit_code"] for a in m["attempts"]] == [7, 7, 7]
+
+
+def test_supervisor_records_resume_generation(tmp_path):
+    board = oracle.random_board(8, 8, seed=5)
+    ck = tmp_path / "ck"
+    ckpt.save(ckpt.checkpoint_path(str(ck), 6), board, 6, 1)
+    manifest = str(tmp_path / "m.json")
+    rc = resilience.supervise(
+        [sys.executable, "-c", "import sys; sys.exit(0)"],
+        manifest_path=manifest,
+        checkpoint_dir=str(ck),
+    )
+    assert rc == 0
+    m = json.load(open(manifest))
+    assert m["attempts"][0]["resume_generation"] == 6
+
+
+def test_supervisor_module_cli(tmp_path):
+    manifest = str(tmp_path / "m.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu.resilience", "supervise",
+         "--max-restarts", "1", "--backoff-base", "0",
+         "--manifest", manifest, "--",
+         sys.executable, "-c", "import sys; sys.exit(0)"],
+        capture_output=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr.decode()
+    assert json.load(open(manifest))["finished"] is True
+
+
+def test_backoff_delay_grows_and_caps():
+    import random
+
+    rng = random.Random(0)
+    d1 = resilience.supervisor.backoff_delay(1, 1.0, 60.0, rng)
+    d5 = resilience.supervisor.backoff_delay(5, 1.0, 60.0, rng)
+    d99 = resilience.supervisor.backoff_delay(99, 1.0, 60.0, rng)
+    assert 0.5 <= d1 < 1.5
+    assert 8.0 <= d5 < 24.0
+    assert 30.0 <= d99 < 90.0  # capped at 60 pre-jitter
+    assert resilience.supervisor.backoff_delay(3, 0.0, 60.0, rng) == 0.0
+
+
+# -- resilience off is a true no-op ------------------------------------------
+
+
+def test_resilience_knobs_never_change_the_traced_program(tmp_path):
+    """Extends the PR 2/3 trace-identity pin: keep_snapshots,
+    restart_attempt, resume_info, and an installed (undelivered)
+    preemption guard leave every engine's chunk program byte-identical."""
+    from gol_tpu.analysis import walker
+
+    for engine in ("dense", "bitpack"):
+        kw = dict(geometry=Geometry(size=64, num_ranks=1), engine=engine)
+        rt_plain = GolRuntime(**kw)
+        rt_res = GolRuntime(
+            **kw,
+            keep_snapshots=3,
+            restart_attempt=2,
+            resume_info={"generation": 4, "path": "x", "fallback": True},
+        )
+        spec = jax.ShapeDtypeStruct((64, 64), np.uint8)
+        jaxprs = []
+        with resilience.preemption_guard():
+            for rt in (rt_plain, rt_res):
+                fn, dynamic, static = rt._evolve_fn(4)
+                jaxprs.append(
+                    str(walker.trace_jaxpr(fn, spec, *dynamic, *static))
+                )
+        assert jaxprs[0] == jaxprs[1], f"engine {engine} trace diverged"
+
+
+def test_preemption_guard_restores_handlers():
+    before = (
+        signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT)
+    )
+    with resilience.preemption_guard():
+        assert signal.getsignal(signal.SIGTERM) is not before[0]
+        resilience.request_preemption()
+        assert resilience.preempt_requested()
+    # Handlers restored, stale flag cleared.
+    after = (
+        signal.getsignal(signal.SIGTERM), signal.getsignal(signal.SIGINT)
+    )
+    assert after == before
+    assert not resilience.preempt_requested()
+
+
+# -- async-writer satellites (sticky failure + tmp hygiene) ------------------
+
+
+def test_writer_failure_on_final_snapshot_surfaces_at_flush(
+    tmp_path, monkeypatch
+):
+    """The docstring claims a writer failure surfaces on flush at end of
+    run; pin the nastiest case — the LAST snapshot fails, so no further
+    submit() exists to raise it and only the final flush can."""
+    real_save = ckpt.save
+    calls = []
+
+    def flaky(path, *a, **k):
+        calls.append(path)
+        if len(calls) == 3:  # 12 iters / every 4 -> 3rd is the final one
+            raise OSError("disk full at the worst moment")
+        real_save(path, *a, **k)
+
+    monkeypatch.setattr(ckpt, "save", flaky)
+    rt = GolRuntime(
+        geometry=Geometry(size=32, num_ranks=1),
+        checkpoint_every=4,
+        checkpoint_dir=str(tmp_path),
+    )
+    with pytest.raises(OSError, match="worst moment"):
+        rt.run(pattern=4, iterations=12)
+    assert len(calls) == 3
+    # Snapshots before the failure are intact and verify.
+    assert ckpt.verify_snapshot(ckpt.checkpoint_path(str(tmp_path), 8)) == 8
+
+
+def test_killed_writer_tmp_never_resumed(tmp_path, monkeypatch):
+    """A writer dying between tmp-write and rename (simulated by a
+    failing os.replace) leaves only a .tmp file; latest()/latest_valid()
+    must keep resolving to the previous snapshot."""
+    board = oracle.random_board(16, 16, seed=6)
+    p1 = ckpt.checkpoint_path(str(tmp_path), 4)
+    ckpt.save(p1, board, 4, 1)
+
+    def no_replace(src, dst):
+        raise OSError("killed mid-rename")
+
+    monkeypatch.setattr(ckpt.os, "replace", no_replace)
+    w = ckpt.AsyncSnapshotWriter()
+    w.submit(ckpt.save, ckpt.checkpoint_path(str(tmp_path), 8), board, 8, 1)
+    with pytest.raises(OSError, match="killed"):
+        w.flush()
+    w.close()
+    monkeypatch.undo()
+    leftovers = [n for n in os.listdir(tmp_path) if n.endswith(".tmp.npz")]
+    assert leftovers  # the torn write is on disk...
+    assert ckpt.latest(str(tmp_path)) == p1  # ...and invisible to latest
+    path, skipped = ckpt.latest_valid(str(tmp_path))
+    assert path == p1 and skipped == []
+    # GC sweeps the torn tmp.
+    resilience.gc_snapshots(str(tmp_path), keep=3)
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp.npz")]
+
+
+# -- auto-resume resolution --------------------------------------------------
+
+
+def test_resolve_auto_resume_empty_and_fresh(tmp_path):
+    path, info = resilience.resolve_auto_resume(str(tmp_path / "nothing"))
+    assert path is None
+    assert info["generation"] == -1 and info["fallback"] is False
+
+
+def test_resolve_auto_resume_fallback_info(tmp_path):
+    p4, p8, p12 = _make_ckpts(tmp_path)
+    _corrupt_byte(p12)
+    path, info = resilience.resolve_auto_resume(str(tmp_path))
+    assert path == p8
+    assert info["generation"] == 8 and info["fallback"] is True
+    assert info["skipped"] == ["ckpt_000000000012.gol.npz"]
+
+
+def test_corrupt_resume_hint(tmp_path):
+    p4, p8, p12 = _make_ckpts(tmp_path)
+    _corrupt_byte(p12)
+    assert resilience.corrupt_resume_hint(p12) == p8
+    # No valid alternative -> no hint.
+    _corrupt_byte(p8)
+    _corrupt_byte(p4)
+    assert resilience.corrupt_resume_hint(p12) is None

@@ -1,0 +1,197 @@
+"""Kill-9 chaos drill (slow tier): the acceptance test for the process
+tier, run for real against OS processes.
+
+For each config (2-D plain / guard / stats, and a 3-D run), a supervised
+child is:
+
+1. **SIGTERM'd at a random chunk** (after a random 1–3 checkpoints have
+   landed) — it must exit 75 with a final boundary checkpoint;
+2. relaunched, then **SIGKILL'd mid-checkpoint-write** — the drill holds
+   the tmp→rename window open with ``GOL_CKPT_TEST_WRITE_DELAY`` and
+   fires the moment a ``.tmp.npz`` appears, so the kill lands inside an
+   actual write and leaves a torn tmp on disk;
+3. relaunched again and left to finish.
+
+The assertion is the whole point of the tier: the final dump is
+**byte-identical** to the same run executed uninterrupted, and the torn
+tmp was never resumed from.  Marked ``slow`` (tens of seconds of real
+subprocess churn); the tier-1 gate runs the lighter
+scripts/resilience_drill.py smoke instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+
+def _env(write_delay=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    if write_delay is not None:
+        env["GOL_CKPT_TEST_WRITE_DELAY"] = str(write_delay)
+    return env
+
+
+def _read_manifest(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _wait(cond, timeout=180, interval=0.02, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _running_pid(manifest, idx):
+    m = _read_manifest(manifest)
+    if not m:
+        return None
+    att = m.get("attempts") or []
+    if len(att) > idx and att[idx].get("pid") and att[idx].get(
+        "exit_code"
+    ) is None:
+        return att[idx]["pid"]
+    return None
+
+
+def _snapshots(ck):
+    if not os.path.isdir(ck):
+        return []
+    return [
+        n for n in os.listdir(ck)
+        if n.startswith("ckpt") and not n.endswith(".tmp.npz")
+    ]
+
+
+def _tmps(ck):
+    if not os.path.isdir(ck):
+        return []
+    return [n for n in os.listdir(ck) if n.endswith(".tmp.npz")]
+
+
+def _drill(tmp_path, module, world, extra, dump_name):
+    ref = tmp_path / "ref"
+    out = tmp_path / "out"
+    ck = str(tmp_path / "ck")
+    manifest = str(tmp_path / "m.json")
+    ref.mkdir()
+    out.mkdir()
+
+    # Uninterrupted reference.
+    subprocess.run(
+        [sys.executable, "-m", module, *world, "--outdir", str(ref)],
+        env=_env(), cwd=REPO, check=True,
+    )
+
+    child = [
+        sys.executable, "-m", module, *world,
+        "--outdir", str(out),
+        "--checkpoint-every", "2", "--checkpoint-dir", ck,
+        "--auto-resume", *extra,
+    ]
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu.resilience", "supervise",
+         "--max-restarts", "4", "--backoff-base", "0",
+         "--manifest", manifest, "--checkpoint-dir", ck, "--", *child],
+        env=_env(write_delay=0.3), cwd=REPO,
+    )
+    try:
+        # Phase 1: SIGTERM at a random chunk — after 1..3 checkpoints.
+        k = random.randint(1, 3)
+        pid0 = _wait(
+            lambda: (
+                _running_pid(manifest, 0)
+                if len(_snapshots(ck)) >= k
+                else None
+            ),
+            what=f"attempt 0 with >= {k} checkpoints",
+        )
+        os.kill(pid0, signal.SIGTERM)
+
+        # Phase 2: SIGKILL attempt 1 mid-checkpoint-write (a .tmp file
+        # exists exactly while the held-open write window is live).
+        pid1 = _wait(
+            lambda: _running_pid(manifest, 1), what="attempt 1 to spawn"
+        )
+        _wait(lambda: _tmps(ck), what="an in-flight .tmp checkpoint")
+        os.kill(pid1, signal.SIGKILL)
+
+        rc = sup.wait(timeout=300)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+    assert rc == 0, f"supervisor exited {rc}; manifest: {_read_manifest(manifest)}"
+
+    m = _read_manifest(manifest)
+    codes = [a["exit_code"] for a in m["attempts"]]
+    assert codes[0] == 75, f"SIGTERM attempt should exit 75, got {codes}"
+    assert codes[1] == -signal.SIGKILL, (
+        f"SIGKILL attempt should die on signal 9, got {codes}"
+    )
+    assert codes[-1] == 0 and m["finished"]
+    # The kill landed inside a write (the drill saw the .tmp), yet every
+    # snapshot that exists at a real snapshot path fully verifies — the
+    # torn write was never promoted past its tmp name.
+    from gol_tpu.utils import checkpoint as ckpt
+
+    for name in _snapshots(ck):
+        ckpt.verify_snapshot(os.path.join(ck, name))
+
+    a = (ref / dump_name).read_bytes()
+    b = (out / dump_name).read_bytes()
+    assert a == b, "final grid differs from the uninterrupted run"
+
+
+def test_chaos_2d_plain(tmp_path):
+    _drill(
+        tmp_path, "gol_tpu", ["4", "256", "40", "512", "1"], [],
+        "Rank_0_of_1.txt",
+    )
+
+
+def test_chaos_2d_guarded(tmp_path):
+    _drill(
+        tmp_path, "gol_tpu", ["4", "256", "40", "512", "1"],
+        ["--guard-every", "2"],
+        "Rank_0_of_1.txt",
+    )
+
+
+def test_chaos_2d_stats(tmp_path):
+    tm = str(tmp_path / "tm")
+    _drill(
+        tmp_path, "gol_tpu", ["4", "256", "40", "512", "1"],
+        ["--stats", "--telemetry", tm],
+        "Rank_0_of_1.txt",
+    )
+    # Every attempt's stream landed (unique default run-ids per process).
+    import glob
+
+    assert len(glob.glob(os.path.join(tm, "*.rank0.jsonl"))) >= 1
+
+
+def test_chaos_3d(tmp_path):
+    _drill(
+        tmp_path, "gol_tpu.cli3d", ["2", "64", "24", "64", "1"], [],
+        "World3D_of_1.npy",
+    )

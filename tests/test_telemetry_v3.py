@@ -1,0 +1,212 @@
+"""Schema v3 (resilience events), v1/v2 back-compat, restart storms.
+
+Companion to tests/test_telemetry.py (v1-era pins) and
+tests/test_telemetry_v2.py (v2 pins).  Here:
+
+- the v3 additions round-trip: ``preempt``/``resume``/``restart``;
+- **back-compat**: BOTH committed fixtures — the PR 2 (schema v1) and
+  PR 3 (schema v2) streams — still load, and a directory holding v1 +
+  v2 + a freshly-written v3 stream merges and renders in one
+  ``summarize`` pass (exit 0), while a bogus schema still exits 2;
+- the restart-storm watchdog flags > N ``restart`` events per window
+  across a directory's runs (each supervised attempt is its own run)
+  and stays quiet for slow restarts;
+- ``summarize`` renders supervisor manifests next to the event streams
+  (the join the run-manifest exists for), and the resume-fallback
+  anomaly fires;
+- ``watch`` shows the supervised/resumed/preempted status lines.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pathlib
+import shutil
+
+import pytest
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+from gol_tpu.telemetry import watch as watch_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+V1_FIXTURE = DATA / "telemetry_v1" / "pr2run.rank0.jsonl"
+V2_FIXTURE = DATA / "telemetry_v2" / "pr3run.rank0.jsonl"
+
+
+# -- v3 round-trip -----------------------------------------------------------
+
+
+def test_resilience_events_roundtrip(tmp_path):
+    with telemetry.EventLog(str(tmp_path), run_id="v3", process_index=0) as ev:
+        ev.run_header({"driver": "2d"})
+        ev.restart_event(2)
+        ev.resume_event(
+            generation=8, path="/ck/ckpt_000000000008.gol.npz",
+            fallback=True, skipped=["ckpt_000000000010.gol.npz"],
+        )
+        ev.preempt_event(12, checkpointed=True)
+        path = ev.path
+    recs = [json.loads(ln) for ln in open(path)]
+    assert [r["event"] for r in recs] == [
+        "run_header", "restart", "resume", "preempt"
+    ]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION == 3
+    assert recs[1]["attempt"] == 2
+    assert recs[2]["fallback"] is True
+    assert recs[2]["skipped"] == ["ckpt_000000000010.gol.npz"]
+    assert recs[3] == {**recs[3], "generation": 12, "checkpointed": True}
+    for r in recs:
+        telemetry.validate_record(r)  # must not raise
+
+
+@pytest.mark.parametrize(
+    "rec",
+    [
+        {"event": "preempt", "t": 1.0, "generation": 4},  # no checkpointed
+        {"event": "resume", "t": 1.0, "generation": 4, "path": "x"},
+        {"event": "restart", "t": 1.0},
+    ],
+)
+def test_validate_rejects_incomplete_v3_records(rec):
+    with pytest.raises(telemetry.SchemaError):
+        telemetry.validate_record(rec)
+
+
+# -- back-compat: v1 + v2 fixtures + fresh v3 in one directory ---------------
+
+
+def test_v1_v2_v3_merge_in_one_pass(tmp_path):
+    shutil.copy(V1_FIXTURE, tmp_path / V1_FIXTURE.name)
+    shutil.copy(V2_FIXTURE, tmp_path / V2_FIXTURE.name)
+    with telemetry.EventLog(str(tmp_path), run_id="now", process_index=0) as ev:
+        ev.run_header({"driver": "2d"})
+        ev.resume_event(generation=4, path="/ck/x", fallback=False)
+    out = io.StringIO()
+    assert summ_mod.summarize(str(tmp_path), out) == 0
+    text = out.getvalue()
+    assert "run pr2run" in text and "run pr3run" in text
+    assert "run now" in text
+    assert "resume: generation 4" in text
+
+
+def test_committed_fixture_schemas_are_v1_and_v2():
+    v1 = json.loads(V1_FIXTURE.open().readline())
+    v2 = json.loads(V2_FIXTURE.open().readline())
+    assert v1["schema"] == 1 and v2["schema"] == 2
+    assert set(telemetry.SUPPORTED_SCHEMAS) == {1, 2, 3}
+
+
+def test_unknown_schema_still_exits_2(tmp_path):
+    rec = {
+        "event": "run_header", "t": 1.0, "schema": 99, "run_id": "x",
+        "process_index": 0, "process_count": 1, "config": {},
+    }
+    (tmp_path / "x.rank0.jsonl").write_text(json.dumps(rec) + "\n")
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+
+
+# -- restart-storm watchdog --------------------------------------------------
+
+
+def _runs_with_restarts(times):
+    runs = {}
+    for i, t in enumerate(times):
+        run = summ_mod.Run(f"a{i}")
+        run.ranks[0] = [{"event": "restart", "t": t, "attempt": i + 1}]
+        runs[run.run_id] = run
+    return runs
+
+
+def test_restart_storm_flagged():
+    flags = summ_mod.restart_storm_flags(
+        _runs_with_restarts([0.0, 10.0, 20.0, 30.0]),
+        max_restarts=3,
+        window_s=300.0,
+    )
+    assert len(flags) == 1 and "restart storm" in flags[0]
+
+
+def test_slow_restarts_not_flagged():
+    flags = summ_mod.restart_storm_flags(
+        _runs_with_restarts([0.0, 400.0, 800.0, 1200.0]),
+        max_restarts=3,
+        window_s=300.0,
+    )
+    assert flags == []
+
+
+def test_storm_rendered_by_summarize_and_watch(tmp_path):
+    for i in range(5):
+        with telemetry.EventLog(
+            str(tmp_path), run_id=f"a{i}", process_index=0
+        ) as ev:
+            ev.run_header({"driver": "2d"})
+            if i:
+                ev.restart_event(i)
+    out = io.StringIO()
+    assert summ_mod.summarize(str(tmp_path), out) == 0
+    assert "ANOMALY: restart storm" in out.getvalue()
+    out = io.StringIO()
+    assert watch_mod.watch(str(tmp_path), out, frames=1, interval=0) == 0
+    assert "ANOMALY: restart storm" in out.getvalue()
+
+
+# -- resume-fallback anomaly + manifest rendering ----------------------------
+
+
+def test_resume_fallback_anomaly_flagged(tmp_path):
+    with telemetry.EventLog(str(tmp_path), run_id="fb", process_index=0) as ev:
+        ev.run_header({"driver": "2d"})
+        ev.resume_event(
+            generation=8, path="/ck/x", fallback=True,
+            skipped=["ckpt_000000000010.gol.npz"],
+        )
+    out = io.StringIO()
+    assert summ_mod.summarize(str(tmp_path), out) == 0
+    text = out.getvalue()
+    assert "ANOMALY: resume fallback" in text
+    assert "ckpt_000000000010.gol.npz" in text
+
+
+def test_summarize_renders_supervisor_manifest(tmp_path):
+    with telemetry.EventLog(str(tmp_path), run_id="j", process_index=0) as ev:
+        ev.run_header({"driver": "2d"})
+    manifest = dict(
+        run_id="j",
+        child=["python", "-m", "gol_tpu"],
+        max_restarts=3,
+        checkpoint_dir="ck",
+        attempts=[
+            dict(attempt=0, pid=11, exit_code=75, resume_generation=None),
+            dict(attempt=1, pid=12, exit_code=0, resume_generation=6),
+        ],
+        finished=True,
+        final_exit=0,
+    )
+    (tmp_path / "j.manifest.json").write_text(json.dumps(manifest))
+    out = io.StringIO()
+    assert summ_mod.summarize(str(tmp_path), out) == 0
+    text = out.getvalue()
+    assert "supervisor manifest j.manifest.json (run j)" in text
+    assert "attempt 0: preempted, resumed from fresh start" in text
+    assert "attempt 1: ok, resumed from generation 6" in text
+
+
+def test_watch_renders_resilience_status(tmp_path):
+    with telemetry.EventLog(str(tmp_path), run_id="w", process_index=0) as ev:
+        ev.run_header({"driver": "2d"})
+        ev.restart_event(1)
+        ev.resume_event(generation=4, path="/ck/x", fallback=True)
+        ev.preempt_event(8, checkpointed=True)
+    out = io.StringIO()
+    assert watch_mod.watch(str(tmp_path), out, frames=1, interval=0) == 0
+    text = out.getvalue()
+    assert "supervised: attempt 1" in text
+    assert "resumed from generation 4  [FALLBACK]" in text
+    assert "PREEMPTED at generation 8 (checkpointed)" in text
