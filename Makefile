@@ -1,8 +1,9 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
-# tier-1 tests (see scripts/check.sh).
+# resilience drill + batch smoke + tier-1 tests (see scripts/check.sh).
 
-.PHONY: lint verify test check telemetry-smoke stats-smoke resilience-drill
+.PHONY: lint verify test check telemetry-smoke stats-smoke \
+	resilience-drill batch-smoke batchbench
 
 lint:
 	bash scripts/lint.sh
@@ -37,6 +38,17 @@ stats-smoke:
 # run (docs/RESILIENCE.md; the kill-9 chaos matrix is `-m slow`).
 resilience-drill:
 	JAX_PLATFORMS=cpu python scripts/resilience_drill.py
+
+# Batched multi-world smoke (docs/BATCHING.md): mixed-size batch
+# bit-equal to sequential single-world runs, and a second process hits
+# the persistent compilation cache (zero new entries).
+batch-smoke:
+	JAX_PLATFORMS=cpu python scripts/batch_smoke.py
+
+# Per-world-throughput-vs-B amortization curve -> BATCH_r{N}.json
+# (CPU: curve shape; the TPU headline is --size 256 --iters 1024).
+batchbench:
+	python benchmarks/batchbench.py --round 6
 
 check:
 	bash scripts/check.sh

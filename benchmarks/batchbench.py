@@ -1,0 +1,157 @@
+"""batchbench: per-world throughput vs batch size B (BATCH_r{N}.json).
+
+The batched engine's reason to exist is launch-overhead amortization:
+BENCH_r05's device-fit decomposition pins ~0.17–0.26 s of per-invocation
+overhead ``a``, so a small board's sequential wall is ``a + c`` with
+``c`` (device compute) tiny — and stepping B worlds in one launch costs
+``a + B·c`` instead of ``B·(a + c)``.  This harness measures exactly
+that curve:
+
+- for each B it times one compiled batched program (fresh donated
+  stacks, best-of-N, ``force_ready`` fenced) at two loop lengths so the
+  r5 measurement discipline applies: ``T(n) = a + b·n`` separates the
+  per-invocation overhead from the device rate;
+- ``per_world_speedup_vs_sequential`` is the headline:
+  ``B · wall(B=1) / wall(B)`` — how much faster each world's work
+  completes than dispatching the same worlds one launch at a time.
+  On a TPU with 256²×1024 worlds this is the ≥10× acceptance number;
+  on the CPU backend compute dominates and the curve honestly flattens
+  toward 1× (curve shape only, like every cpu_mesh artifact).
+
+Usage::
+
+    python benchmarks/batchbench.py --round 6                  # defaults
+    python benchmarks/batchbench.py --size 256 --iters 1024 --bs 1,8,64
+
+The TPU headline capture is ``--size 256 --iters 1024 --bs 1,64``.
+Writes ``BATCH_r{round:02d}.json`` (or ``--out PATH``) with the command
+pinned per row, per repo artifact convention.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Optional, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct-script invocation from anywhere
+    sys.path.insert(0, str(REPO))
+
+
+def measure(
+    size: int,
+    iters: int,
+    batch: int,
+    engine: str = "auto",
+    repeats: int = 3,
+) -> dict:
+    """One row: walls at ``iters`` and ``iters // 4`` + the overhead fit."""
+    import jax
+    import numpy as np
+
+    from gol_tpu.batch import engines as batch_engines
+    from gol_tpu.batch.runtime import Bucket, resolve_bucket_engine
+    from gol_tpu.utils.timing import fit_overhead, time_best
+
+    shapes = [(size, size)] * batch
+    bucket = Bucket(shape=(size, size), indices=tuple(range(batch)), masked=False)
+    name = resolve_bucket_engine(engine, bucket, shapes)
+    rng = np.random.default_rng(42)
+    stack_np = (rng.random((batch, size, size)) < 0.33).astype(np.uint8)
+
+    def fresh():
+        return jax.device_put(stack_np)
+
+    walls = {}
+    for n in sorted({max(1, iters // 4), iters}):
+        fn = batch_engines.compiled_batch_evolver(name, n, False, 1024, None)
+        walls[n] = time_best(fn, fresh, repeats=repeats)
+    wall = walls[iters]
+    world_updates = size * size * iters
+    row = dict(
+        B=batch,
+        engine=name,
+        wall_s=wall,
+        walls={str(n): w for n, w in walls.items()},
+        aggregate_updates_per_sec=batch * world_updates / wall,
+        per_world_updates_per_sec=world_updates / wall,
+    )
+    if len(walls) > 1:
+        a, b = fit_overhead(walls)
+        row["device_fit"] = dict(
+            overhead_s=a,
+            per_step_s=b,
+            aggregate_updates_per_sec_device=(
+                batch * size * size / b if b > 0 else None
+            ),
+        )
+    return row
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="batchbench", description=__doc__)
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=1024)
+    ap.add_argument("--bs", default="1,8,64", metavar="B1,B2,...")
+    ap.add_argument(
+        "--engine", default="auto",
+        choices=["auto", "dense", "bitpack", "pallas_bitpack"],
+    )
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--round", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    ns = ap.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    import jax
+
+    batches = [int(b) for b in ns.bs.split(",") if b]
+    rows = [
+        measure(ns.size, ns.iters, b, ns.engine, ns.repeats) for b in batches
+    ]
+    base = rows[0]["wall_s"]
+    base_b = rows[0]["B"]
+    for row in rows:
+        # B·wall(B0)/ (B0·wall(B)) — worlds completed per second, batched
+        # vs one-launch-at-a-time dispatch of the same worlds.
+        row["per_world_speedup_vs_sequential"] = (
+            row["B"] * base / (base_b * row["wall_s"])
+        )
+    payload = dict(
+        note=(
+            "batched multi-world amortization curve (docs/BATCHING.md). "
+            "wall_s = best-of-N fenced wall of one compiled batched "
+            "launch stepping all B worlds `iters` generations; "
+            "per_world_speedup_vs_sequential = B*wall(B_min)/"
+            "(B_min*wall(B)) — the launch-overhead amortization factor. "
+            "device_fit separates per-invocation overhead from device "
+            "rate (r5 discipline: never compare wall rates across "
+            "configs). CPU-backend captures are curve shape only; the "
+            "TPU headline config is --size 256 --iters 1024 --bs 1,64."
+        ),
+        backend=jax.default_backend(),
+        size=ns.size,
+        iters=ns.iters,
+        rows=rows,
+        command=(
+            f"python benchmarks/batchbench.py --size {ns.size} "
+            f"--iters {ns.iters} --bs {ns.bs} --engine {ns.engine} "
+            f"--round {ns.round}"
+        ),
+    )
+    out = ns.out or str(REPO / f"BATCH_r{ns.round:02d}.json")
+    pathlib.Path(out).write_text(json.dumps(payload, indent=1) + "\n")
+    print(f"wrote {out}")
+    for row in rows:
+        print(
+            f"  B={row['B']:>4}  wall {row['wall_s']:.4f}s  "
+            f"per-world speedup x{row['per_world_speedup_vs_sequential']:.2f}"
+            f"  ({row['engine']})"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

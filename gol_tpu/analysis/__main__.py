@@ -79,9 +79,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from gol_tpu.analysis.report import AnalysisReport
 
     matrix = select(default_matrix(), ns.engine, ns.mesh)
+    # The batched multi-world matrix (gol_tpu/batch) rides the full run
+    # only — engine/mesh filters select single-world engine cells.
+    batch_on = not ns.engine and not ns.mesh
     if ns.list:
         for cfg in matrix:
             print(cfg.name)
+        if batch_on:
+            from gol_tpu.analysis.batchcheck import default_batch_matrix
+
+            for bcfg in default_batch_matrix():
+                print(bcfg.name)
         return 0
 
     from gol_tpu.analysis.checks import run_config
@@ -89,6 +97,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     report = AnalysisReport()
     for cfg in matrix:
         report.engines.append(run_config(cfg))
+    if batch_on:
+        from gol_tpu.analysis.batchcheck import run_batch_checks
+
+        report.engines.extend(run_batch_checks())
 
     if ns.json:
         print(report.to_json())

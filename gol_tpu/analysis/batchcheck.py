@@ -1,0 +1,252 @@
+"""Static checks over the batched multi-world programs.
+
+Two invariants the batch subsystem (gol_tpu/batch, docs/BATCHING.md)
+lives or dies by, verified the same way the engine matrix is:
+
+- **batch purity** — the batched chunk programs contain no host
+  callbacks (the scan of :data:`gol_tpu.analysis.checks.
+  IMPURE_PRIMITIVES`) and, crucially, **no collectives at all** — not
+  even on the world-axis-sharded shard_map form.  Worlds are
+  independent; a single psum/ppermute in a batched program means two
+  worlds are coupled, which is the batched analog of the reference's
+  bug B1 (wrong halos) in reverse.
+- **batch invariance** — a batch of B distinct worlds stepped by the
+  batched program is bit-identical per world to B sequential
+  single-world runs of the existing engines.  Executed on small boards
+  (CPU is enough — every tier is bit-exact across backends), covering
+  the exact and the padded+masked program forms.
+
+Run as part of ``python -m gol_tpu.analysis``; one
+:class:`~gol_tpu.analysis.report.EngineReport` per batch configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gol_tpu.analysis import walker
+from gol_tpu.analysis.checks import (
+    COLLECTIVE_PRIMITIVES,
+    IMPURE_PRIMITIVES,
+    check_dtype,
+)
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+STEPS = 4  # generations per traced/executed chunk
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchConfig:
+    """One cell of the batch verification matrix."""
+
+    name: str
+    engine: str  # dense / bitpack / pallas_bitpack
+    masked: bool
+    mesh: bool  # shard the world axis over a 4-device 'worlds' ring?
+    batch: int = 4
+    shape: Tuple[int, int] = (32, 64)  # bucket (padded) shape
+
+
+def default_batch_matrix() -> List[BatchConfig]:
+    return [
+        BatchConfig("batch/dense/exact", "dense", False, False),
+        BatchConfig("batch/dense/masked", "dense", True, False),
+        BatchConfig("batch/bitpack/exact", "bitpack", False, False),
+        BatchConfig("batch/bitpack/masked", "bitpack", True, False),
+        BatchConfig(
+            "batch/pallas_bitpack/exact", "pallas_bitpack", False, False
+        ),
+        BatchConfig("batch/dense/worlds-1d", "dense", False, True),
+        BatchConfig("batch/bitpack/worlds-1d", "bitpack", False, True),
+    ]
+
+
+def _build(cfg: BatchConfig):
+    """(jitted_fn, arg_specs) exactly as GolBatchRuntime dispatches them."""
+    import jax
+
+    from gol_tpu.batch import engines as batch_engines
+    from gol_tpu.models.state import CELL_DTYPE
+
+    mesh = None
+    if cfg.mesh:
+        devices = jax.devices()
+        if len(devices) < 4:
+            raise RuntimeError(
+                f"config {cfg.name!r} needs 4 devices, have {len(devices)}"
+            )
+        mesh = batch_engines.make_batch_mesh(4, devices=devices[:4])
+    fn = batch_engines.compiled_batch_evolver(
+        cfg.engine, STEPS, cfg.masked, 512, mesh
+    )
+    B = cfg.batch
+    H, W = cfg.shape
+    if mesh is not None:
+        stack_spec = jax.ShapeDtypeStruct(
+            (B, H, W),
+            CELL_DTYPE,
+            sharding=batch_engines.batch_sharding(mesh),
+        )
+        vec_sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(batch_engines.WORLDS)
+        )
+        vec_spec = jax.ShapeDtypeStruct((B,), np.int32, sharding=vec_sharding)
+    else:
+        stack_spec = jax.ShapeDtypeStruct((B, H, W), CELL_DTYPE)
+        vec_spec = jax.ShapeDtypeStruct((B,), np.int32)
+    specs = (stack_spec, vec_spec, vec_spec) if cfg.masked else (stack_spec,)
+    return fn, specs, mesh
+
+
+def check_batch_purity(jaxpr, cfg: BatchConfig) -> CheckResult:
+    """No host callbacks AND no collectives — worlds must stay decoupled."""
+    findings: List[Finding] = []
+    for info in walker.iter_eqns(jaxpr):
+        if info.name in IMPURE_PRIMITIVES:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "batch-purity",
+                    f"host-interaction primitive {info.name!r} in the "
+                    f"batched program (path {'/'.join(info.path) or 'top'})",
+                )
+            )
+        if info.name in COLLECTIVE_PRIMITIVES:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "batch-purity",
+                    f"collective {info.name!r} in a batched program — "
+                    "worlds are independent; any collective couples them "
+                    "(the world-axis shard_map must be embarrassingly "
+                    "parallel)",
+                )
+            )
+    if not findings:
+        findings.append(
+            Finding(
+                INFO,
+                "batch-purity",
+                "batched program traced pure: no callbacks, no collectives",
+            )
+        )
+    return CheckResult.from_findings("batch-purity", findings)
+
+
+def _reference(engine: str, board, steps: int):
+    """The single-world program the batched tier must match bit-for-bit."""
+    from gol_tpu.ops import bitlife, stencil
+
+    if engine == "dense":
+        return stencil.run(board, steps)
+    if engine == "bitpack":
+        return bitlife.evolve_dense_io(board, steps)
+    from gol_tpu.ops import pallas_bitlife
+
+    return pallas_bitlife.evolve(board, steps, 512)
+
+
+def check_batch_invariance(cfg: BatchConfig, fn, mesh) -> CheckResult:
+    """B distinct worlds, batched == B sequential single-world runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.batch import engines as batch_engines
+
+    findings: List[Finding] = []
+    H, W = cfg.shape
+    rng = np.random.default_rng(2026)
+    shapes = []
+    for k in range(cfg.batch):
+        if cfg.masked and k % 2:
+            # Mixed-size members: every second world smaller than the
+            # bucket (word-aligned widths so the packed tier applies).
+            shapes.append((H - 8, W - 32))
+        else:
+            shapes.append((H, W))
+    worlds = [
+        (rng.random(s) < 0.33).astype(np.uint8) for s in shapes
+    ]
+    stack = np.zeros((cfg.batch, H, W), np.uint8)
+    for k, wld in enumerate(worlds):
+        stack[k, : wld.shape[0], : wld.shape[1]] = wld
+    hs = np.asarray([s[0] for s in shapes], np.int32)
+    ws = np.asarray([s[1] for s in shapes], np.int32)
+    if mesh is not None:
+        sharding = batch_engines.batch_sharding(mesh)
+        dev_stack = jax.device_put(stack, sharding)
+        vec = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(batch_engines.WORLDS)
+        )
+        args = (dev_stack, jax.device_put(hs, vec), jax.device_put(ws, vec))
+    else:
+        args = (jnp.asarray(stack), jnp.asarray(hs), jnp.asarray(ws))
+    out = np.asarray(fn(*args[: 3 if cfg.masked else 1]))
+    bad = []
+    for k, wld in enumerate(worlds):
+        ref = np.asarray(_reference(cfg.engine, jnp.asarray(wld), STEPS))
+        got = out[k, : wld.shape[0], : wld.shape[1]]
+        if not np.array_equal(got, ref):
+            bad.append(k)
+        pad = out[k].copy()
+        pad[: wld.shape[0], : wld.shape[1]] = 0
+        if pad.any():
+            bad.append(k)
+    if bad:
+        findings.append(
+            Finding(
+                ERROR,
+                "batch-invariance",
+                f"worlds {sorted(set(bad))} diverge from their sequential "
+                f"single-world runs (or leak live cells into padding) "
+                f"after {STEPS} generations — the batched program is not "
+                "a pure stacking of the single-world engines",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "batch-invariance",
+                f"{cfg.batch} worlds bit-equal to sequential runs "
+                f"({STEPS} gens, shapes {sorted(set(shapes))})",
+            )
+        )
+    return CheckResult.from_findings("batch-invariance", findings)
+
+
+def run_batch_config(cfg: BatchConfig) -> EngineReport:
+    report = EngineReport(config_name=cfg.name)
+    try:
+        fn, specs, mesh = _build(cfg)
+        jaxpr = walker.trace_jaxpr(fn, *specs)
+    except Exception as e:
+        from gol_tpu.analysis.report import FAIL
+
+        report.checks.append(
+            CheckResult("config", FAIL, [
+                Finding(ERROR, "config", f"batched program failed to build: {e}")
+            ])
+        )
+        return report
+    report.checks.append(check_batch_purity(jaxpr, cfg))
+    # Dtype hygiene: the batched tiers inherit the engines' integer-only
+    # contract (the checker keys on cfg.engine, which matches).
+    report.checks.append(check_dtype(jaxpr, cfg))
+    report.checks.append(check_batch_invariance(cfg, fn, mesh))
+    return report
+
+
+def run_batch_checks(
+    matrix: Optional[List[BatchConfig]] = None,
+) -> List[EngineReport]:
+    return [run_batch_config(c) for c in (matrix or default_batch_matrix())]

@@ -1,0 +1,35 @@
+"""Batched multi-world engine: B independent boards, one compiled launch.
+
+The serving-scale subsystem (docs/BATCHING.md): instead of one compiled
+program per world — which pins every small board under the ~0.2 s
+per-invocation launch overhead BENCH_r05 measured — B independent worlds
+stack on a leading ``worlds`` axis and step together:
+
+- :mod:`gol_tpu.batch.engines` — the batched tiers (vmap on dense /
+  bitpack, an extra grid dimension on the fused Pallas kernel, masked
+  padded steps for mixed-size buckets, shard_map world-axis sharding);
+- :mod:`gol_tpu.batch.runtime` — :class:`GolBatchRuntime`: size
+  bucketing, AOT warmup, the chunked loop with checkpoint/preempt/
+  telemetry reuse;
+- :mod:`gol_tpu.batch.cache` — XLA persistent compilation cache wiring
+  (``--compile-cache DIR``), so repeat invocations skip XLA entirely.
+
+CLI surface: ``python -m gol_tpu ... --batch B`` (see ``--batch-sizes``
+and ``--compile-cache`` in :mod:`gol_tpu.cli`).
+"""
+
+from gol_tpu.batch.cache import cache_entries, enable_compile_cache  # noqa: F401
+from gol_tpu.batch.engines import (  # noqa: F401
+    BATCH_ENGINES,
+    WORLDS,
+    batch_sharding,
+    compiled_batch_evolver,
+    make_batch_mesh,
+)
+from gol_tpu.batch.runtime import (  # noqa: F401
+    Bucket,
+    GolBatchRuntime,
+    bucket_shape,
+    bucketize,
+    resolve_bucket_engine,
+)

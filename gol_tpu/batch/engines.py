@@ -1,0 +1,237 @@
+"""Batched multi-world evolvers: B independent boards, one compiled program.
+
+The single-world tiers launch one compiled chunk per world per chunk —
+fine when one world fills the chip, but BENCH_r05's device-fit
+decomposition pins ~0.17–0.26 s of per-invocation overhead, so a 256²
+board runs at a tiny fraction of the hardware's rate.  Stacking B worlds
+on a leading ``worlds`` axis amortizes that overhead B-fold in exactly
+the way batched inference serving does:
+
+- **dense / bitpack** — ``jax.vmap`` over the existing single-world step
+  functions (:mod:`gol_tpu.ops.stencil`, :mod:`gol_tpu.ops.bitlife`);
+  the per-world programs are untouched, the batch axis is pure
+  data-parallel width for the VPU.
+- **pallas_bitpack** — ``jax.vmap`` over the fused kernel's evolve:
+  JAX's Pallas batching rule lowers the vmap to an extra leading *grid
+  dimension* on the kernel, so all B worlds ride one ``pallas_call``.
+- **masked buckets** — worlds smaller than their bucket shape evolve
+  under :func:`step_dense_masked` / :func:`step_packed_masked`: the
+  torus wrap is taken at each world's true ``(h, w)`` via index
+  arithmetic while the padding stays dead, so one compiled program per
+  *bucket* serves any mix of world sizes (heights/widths ride in as
+  dynamic ``int32[B]`` vectors — no recompile per shape).
+- **mesh mode** — ``shard_map`` over a 1-D ``worlds`` device mesh: each
+  device evolves its slice of the world axis with the single-device
+  batched program.  Worlds are independent, so the sharded program
+  contains **no collectives at all** — an invariant the static verifier
+  pins (:mod:`gol_tpu.analysis.batchcheck`).
+
+Every tier is pinned bit-identical per world to B sequential
+single-world runs (tests/test_batch.py, tests/test_property.py), and
+none of this touches the single-world engines — their jaxprs stay
+byte-identical (the extended trace-identity pin).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gol_tpu import compat
+from gol_tpu.ops import bitlife, stencil
+
+WORLDS = "worlds"  # mesh axis name: the batch (world) axis
+
+BATCH_ENGINES = ("auto", "dense", "bitpack", "pallas_bitpack")
+
+
+def make_batch_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D device mesh over the ``worlds`` axis (world-axis sharding)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, only {len(devices)} visible"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (WORLDS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """The canonical stack sharding: world axis split, board axes whole."""
+    return NamedSharding(mesh, P(WORLDS, None, None))
+
+
+# ---------------------------------------------------------------------------
+# masked steps: the world's torus lives in the top-left (h, w) corner of a
+# padded bucket board; wrap indices are taken at the true extent.
+# ---------------------------------------------------------------------------
+
+
+def step_dense_masked(board: jax.Array, h: jax.Array, w: jax.Array):
+    """One generation of an ``h×w`` torus padded into ``board[H, W]``.
+
+    ``h``/``w`` are traced scalars, so one compiled program serves every
+    world size that fits the bucket.  Wrap neighbors come from gathers at
+    ``(i±1) mod h`` / ``(j±1) mod w`` — for valid cells these only ever
+    read valid cells, so the padding (masked back to 0 on the way out)
+    can never leak into a world.  Bit-identical to
+    :func:`gol_tpu.ops.stencil.step` on the cropped board.
+    """
+    H, W = board.shape
+    ri = jnp.arange(H)
+    ci = jnp.arange(W)
+    up = jnp.where(ri == 0, h - 1, ri - 1)
+    down = jnp.where(ri == h - 1, 0, jnp.minimum(ri + 1, H - 1))
+    left = jnp.where(ci == 0, w - 1, ci - 1)
+    right = jnp.where(ci == w - 1, 0, jnp.minimum(ci + 1, W - 1))
+    rows3 = board[up] + board + board[down]
+    total = rows3[:, left] + rows3 + rows3[:, right]
+    nxt = stencil.life_rule(board, total - board)
+    mask = (ri[:, None] < h) & (ci[None, :] < w)
+    return jnp.where(mask, nxt, jnp.zeros_like(nxt))
+
+
+def step_packed_masked(packed: jax.Array, h: jax.Array, nw: jax.Array):
+    """Packed counterpart: ``h`` rows × ``nw`` words valid in ``[NH, NW]``.
+
+    World widths must pack into whole 32-bit words (the packed tier's
+    standing constraint), so the horizontal wrap is a word-ring at the
+    true ``nw``: the west/east carry bits come from gathers at
+    ``(j±1) mod nw``, exactly :func:`gol_tpu.ops.bitlife._west_east`
+    with the roll taken at the world's width.  Padding words are forced
+    back to 0 so they never feed a later generation.
+    """
+    NH, NW = packed.shape
+    ri = jnp.arange(NH)
+    wi = jnp.arange(NW)
+    up = jnp.where(ri == 0, h - 1, ri - 1)
+    down = jnp.where(ri == h - 1, 0, jnp.minimum(ri + 1, NH - 1))
+    prev_i = jnp.where(wi == 0, nw - 1, wi - 1)
+    next_i = jnp.where(wi == nw - 1, 0, jnp.minimum(wi + 1, NW - 1))
+    prev_word = packed[:, prev_i]
+    next_word = packed[:, next_i]
+    west = (packed << 1) | (prev_word >> (bitlife.BITS - 1))
+    east = (packed >> 1) | (next_word << (bitlife.BITS - 1))
+    s0, s1 = bitlife._full_add(west, packed, east)
+    out = bitlife._rule_from_row_sums(
+        packed, (s0[up], s1[up]), (s0, s1), (s0[down], s1[down])
+    )
+    mask = (ri[:, None] < h) & (wi[None, :] < nw)
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
+# ---------------------------------------------------------------------------
+# per-tier batched chunk programs
+# ---------------------------------------------------------------------------
+
+
+def _dense_batch(steps: int):
+    step = jax.vmap(stencil.step)
+
+    def evolve(stack):
+        return lax.fori_loop(0, steps, lambda _, s: step(s), stack)
+
+    return evolve
+
+
+def _dense_batch_masked(steps: int):
+    step = jax.vmap(step_dense_masked)
+
+    def evolve(stack, hs, ws):
+        return lax.fori_loop(0, steps, lambda _, s: step(s, hs, ws), stack)
+
+    return evolve
+
+
+def _bitpack_batch(steps: int):
+    pack = jax.vmap(bitlife.pack)
+    unpack = jax.vmap(bitlife.unpack)
+    step = jax.vmap(bitlife.step_packed)
+
+    def evolve(stack):
+        packed = pack(stack)
+        packed = lax.fori_loop(0, steps, lambda _, p: step(p), packed)
+        return unpack(packed)
+
+    return evolve
+
+
+def _bitpack_batch_masked(steps: int):
+    pack = jax.vmap(bitlife.pack)
+    unpack = jax.vmap(bitlife.unpack)
+    step = jax.vmap(step_packed_masked)
+
+    def evolve(stack, hs, ws):
+        nws = ws // bitlife.BITS
+        packed = pack(stack)
+        packed = lax.fori_loop(0, steps, lambda _, p: step(p, hs, nws), packed)
+        return unpack(packed)
+
+    return evolve
+
+
+def _pallas_batch(steps: int, tile_hint: int):
+    from gol_tpu.ops import pallas_bitlife
+
+    # vmap over the fused kernel: the Pallas batching rule adds a leading
+    # grid dimension, so one pallas_call steps every world.
+    return jax.vmap(lambda b: pallas_bitlife.evolve(b, steps, tile_hint))
+
+
+@functools.lru_cache(maxsize=256)
+def compiled_batch_evolver(
+    engine: str,
+    steps: int,
+    masked: bool,
+    tile_hint: int = 512,
+    mesh: Optional[Mesh] = None,
+):
+    """Build + jit one bucket's batched chunk program.
+
+    The call is ``fn(stack)`` (exact buckets) or ``fn(stack, hs, ws)``
+    (masked buckets; ``hs``/``ws`` int32[B] true world extents).  The
+    stack is donated (the double buffer); the extent vectors are not.
+    With a ``worlds`` mesh the program is the shard_map form — same
+    bodies per shard, no collectives.  lru_cached so repeated chunk
+    sizes reuse one program object (the retrace contract every engine
+    builder honors).
+    """
+    if engine == "dense":
+        local = _dense_batch_masked(steps) if masked else _dense_batch(steps)
+    elif engine == "bitpack":
+        local = (
+            _bitpack_batch_masked(steps) if masked else _bitpack_batch(steps)
+        )
+    elif engine == "pallas_bitpack":
+        if masked:
+            raise ValueError(
+                "the batched Pallas tier has no masked form; masked "
+                "buckets dispatch to the bitpack/dense masked programs "
+                "(gol_tpu.batch.runtime.resolve_bucket_engine)"
+            )
+        local = _pallas_batch(steps, tile_hint)
+    else:
+        raise ValueError(
+            f"unknown batch engine {engine!r}; expected one of "
+            f"{BATCH_ENGINES[1:]}"
+        )
+
+    if mesh is not None:
+        vec = P(WORLDS)
+        in_specs = (P(WORLDS, None, None),) + ((vec, vec) if masked else ())
+        local = compat.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(WORLDS, None, None),
+            check_vma=False,
+        )
+    return jax.jit(local, donate_argnums=0)

@@ -1,0 +1,532 @@
+"""GolBatchRuntime: the batched multi-world serving loop.
+
+The batched analog of :class:`gol_tpu.runtime.GolRuntime`: it owns B
+independent worlds, groups them into size buckets (padded + masked, so a
+mixed-size request set compiles **one program per bucket, not per
+shape**), AOT-compiles one chunk program per (bucket, chunk size) —
+optionally against the XLA persistent compilation cache so repeat
+invocations skip compilation entirely — and steps every bucket inside
+the same chunked loop the single-world runtime uses: chunk schedule from
+:func:`gol_tpu.runtime.chunk_schedule`, fingerprinted checkpoints
+(batched format, ``kind='batch'`` on the PR 4 validated-resume path),
+cooperative preemption at chunk boundaries, and schema-v4 telemetry
+(``chunk`` events carry a ``batch`` block: bucket shape, B, per-world
+throughput).
+
+Bit-exactness contract: the batched final grids are pinned bit-identical
+per world to B sequential single-world runs, for every tier × mesh
+(tests/test_batch.py, tests/test_property.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from gol_tpu.batch import engines as batch_engines
+from gol_tpu.models.state import CELL_DTYPE
+from gol_tpu.ops import bitlife
+from gol_tpu.runtime import chunk_schedule
+from gol_tpu.utils import checkpoint as ckpt_mod
+from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready
+
+
+def bucket_shape(h: int, w: int, quantum: int) -> Tuple[int, int]:
+    """Round a world's extents up to the bucket quantum."""
+    if quantum < 1:
+        raise ValueError(f"bucket quantum must be >= 1, got {quantum}")
+    up = lambda x: -(-x // quantum) * quantum  # noqa: E731
+    return (up(h), up(w))
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One padded shape class: the unit of compilation and dispatch."""
+
+    shape: Tuple[int, int]  # padded (H, W) every member world fits
+    indices: Tuple[int, ...]  # world ids, in submission order
+    masked: bool  # any member smaller than the bucket shape?
+
+    @property
+    def batch(self) -> int:
+        return len(self.indices)
+
+
+def bucketize(
+    shapes: Sequence[Tuple[int, int]], quantum: int
+) -> List[Bucket]:
+    """Group world shapes into padded buckets (stable within a bucket)."""
+    groups: dict = {}
+    for i, (h, w) in enumerate(shapes):
+        groups.setdefault(bucket_shape(h, w, quantum), []).append(i)
+    out = []
+    for shape in sorted(groups):
+        idx = tuple(groups[shape])
+        masked = any(tuple(shapes[i]) != shape for i in idx)
+        out.append(Bucket(shape=shape, indices=idx, masked=masked))
+    return out
+
+
+def resolve_bucket_engine(
+    engine: str, bucket: Bucket, shapes: Sequence[Tuple[int, int]]
+) -> str:
+    """Pick the tier one bucket actually runs.
+
+    Mirrors the single-world auto resolution: packed when every member
+    width packs into whole 32-bit words, the fused Pallas kernel on TPU
+    when the bucket fills whole lane tiles — with the one batched twist
+    that masked buckets have no Pallas form and fall back to the masked
+    XLA packed program (bit-exact either way; the fallback is a
+    performance choice, never a semantics one).
+    """
+    H, W = bucket.shape
+    packable = W % bitlife.BITS == 0 and all(
+        shapes[i][1] % bitlife.BITS == 0 for i in bucket.indices
+    )
+    if engine == "dense":
+        return "dense"
+    if engine == "bitpack":
+        if not packable:
+            raise ValueError(
+                f"engine 'bitpack' needs every world width in bucket "
+                f"{bucket.shape} to pack into {bitlife.BITS}-bit words"
+            )
+        return "bitpack"
+    if engine == "pallas_bitpack":
+        if bucket.masked or not packable:
+            # Documented fallback: the fused kernel has no masked form.
+            return "bitpack" if packable else "dense"
+        return "pallas_bitpack"
+    # auto
+    if not packable:
+        return "dense"
+    if (
+        not bucket.masked
+        and jax.default_backend() == "tpu"
+    ):
+        from gol_tpu.ops import pallas_bitlife
+
+        if (
+            W % (pallas_bitlife._LANE * bitlife.BITS) == 0
+            and H % pallas_bitlife._ALIGN == 0
+        ):
+            return "pallas_bitpack"
+    return "bitpack"
+
+
+@dataclasses.dataclass
+class GolBatchRuntime:
+    """Batched multi-world runtime (see module docstring).
+
+    ``worlds`` are dense uint8 0/1 grids of arbitrary (per-world)
+    shapes.  ``mesh`` (a 1-D ``worlds`` mesh from
+    :func:`gol_tpu.batch.engines.make_batch_mesh`) shards each bucket's
+    world axis across devices when the bucket's B divides the device
+    count's requirement (B % devices == 0); buckets that don't divide run
+    unsharded — a placement choice, never a semantics one.
+    """
+
+    worlds: Sequence[np.ndarray]
+    engine: str = "auto"
+    mesh: Optional[Mesh] = None
+    bucket_quantum: int = 64
+    tile_hint: int = 512
+    checkpoint_every: int = 0
+    checkpoint_dir: Optional[str] = None
+    keep_snapshots: int = 0
+    telemetry_dir: Optional[str] = None
+    run_id: Optional[str] = None
+    compile_cache: Optional[str] = None
+    restart_attempt: int = 0
+    resume_info: Optional[dict] = None
+
+    def __post_init__(self) -> None:
+        if self.engine not in batch_engines.BATCH_ENGINES:
+            raise ValueError(
+                f"unknown engine {self.engine!r}; expected "
+                f"{batch_engines.BATCH_ENGINES}"
+            )
+        if not self.worlds:
+            raise ValueError("batch runtime needs at least one world")
+        boards = []
+        for i, b in enumerate(self.worlds):
+            b = np.asarray(b, np.uint8)
+            if b.ndim != 2 or not b.size:
+                raise ValueError(
+                    f"world {i} must be a non-empty 2-D grid, got shape "
+                    f"{b.shape}"
+                )
+            boards.append(b)
+        self._boards: List[np.ndarray] = boards
+        self._shapes = [b.shape for b in boards]
+        if self.checkpoint_every and not self.checkpoint_dir:
+            self.checkpoint_dir = "checkpoints"
+        if self.keep_snapshots < 0:
+            raise ValueError(
+                f"keep_snapshots must be >= 0, got {self.keep_snapshots}"
+            )
+        if self.compile_cache:
+            from gol_tpu.batch import cache as cache_mod
+
+            cache_mod.enable_compile_cache(self.compile_cache)
+        self.buckets: List[Bucket] = bucketize(
+            self._shapes, self.bucket_quantum
+        )
+        self._engines = [
+            resolve_bucket_engine(self.engine, bk, self._shapes)
+            for bk in self.buckets
+        ]
+        self.generation = 0
+        self._ckpt_writer = None
+        self._resume_source: Optional[str] = None
+
+    # -- placement ---------------------------------------------------------
+    def _bucket_mesh(self, bucket: Bucket) -> Optional[Mesh]:
+        """The mesh a bucket shards over, or None (unsharded)."""
+        if self.mesh is None:
+            return None
+        n = self.mesh.devices.size
+        return self.mesh if bucket.batch % n == 0 else None
+
+    def _stack(self, bucket: Bucket):
+        """The bucket's padded device stack + true-extent vectors."""
+        H, W = bucket.shape
+        stack = np.zeros((bucket.batch, H, W), dtype=np.uint8)
+        hs = np.empty(bucket.batch, np.int32)
+        ws = np.empty(bucket.batch, np.int32)
+        for k, i in enumerate(bucket.indices):
+            b = self._boards[i]
+            stack[k, : b.shape[0], : b.shape[1]] = b
+            hs[k], ws[k] = b.shape
+        mesh = self._bucket_mesh(bucket)
+        if mesh is not None:
+            sharding = batch_engines.batch_sharding(mesh)
+            dev_stack = jax.device_put(stack, sharding)
+            vec = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(batch_engines.WORLDS)
+            )
+            return dev_stack, jax.device_put(hs, vec), jax.device_put(ws, vec)
+        return jax.device_put(stack), jax.device_put(hs), jax.device_put(ws)
+
+    def _unstack(self, bucket: Bucket, stack) -> None:
+        """Crop a stepped stack back into the per-world host boards."""
+        host = np.asarray(stack)
+        for k, i in enumerate(bucket.indices):
+            h, w = self._shapes[i]
+            self._boards[i] = host[k, :h, :w]
+
+    # -- compile -----------------------------------------------------------
+    def _evolver(self, bucket_id: int, take: int):
+        """(jitted_fn, masked) for one bucket's chunk program."""
+        bucket = self.buckets[bucket_id]
+        name = self._engines[bucket_id]
+        masked = bucket.masked
+        fn = batch_engines.compiled_batch_evolver(
+            name,
+            take,
+            masked,
+            self.tile_hint,
+            self._bucket_mesh(bucket),
+        )
+        return fn, masked
+
+    def compile_evolvers(self, schedule, events=None) -> dict:
+        """AOT-compile one program per (bucket, distinct chunk size).
+
+        Lowered from ShapeDtypeStructs — the warmup never steps a board —
+        and recorded as ``compile`` telemetry events carrying the bucket
+        block, so a persistent-cache hit is visible as a near-zero
+        ``compile_s`` on the second invocation.  Returns
+        ``{(bucket_id, take): (compiled, masked)}``.
+        """
+        import time as time_mod
+
+        from gol_tpu import telemetry as telemetry_mod
+
+        evolvers = {}
+        for bucket_id, bucket in enumerate(self.buckets):
+            H, W = bucket.shape
+            mesh = self._bucket_mesh(bucket)
+            if mesh is not None:
+                stack_spec = jax.ShapeDtypeStruct(
+                    (bucket.batch, H, W),
+                    CELL_DTYPE,
+                    sharding=batch_engines.batch_sharding(mesh),
+                )
+                vec_sharding = jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec(batch_engines.WORLDS)
+                )
+                vec_spec = jax.ShapeDtypeStruct(
+                    (bucket.batch,), np.int32, sharding=vec_sharding
+                )
+            else:
+                stack_spec = jax.ShapeDtypeStruct(
+                    (bucket.batch, H, W), CELL_DTYPE
+                )
+                vec_spec = jax.ShapeDtypeStruct((bucket.batch,), np.int32)
+            for take in sorted(set(schedule)):
+                fn, masked = self._evolver(bucket_id, take)
+                args = (stack_spec, vec_spec, vec_spec) if masked else (
+                    stack_spec,
+                )
+                with telemetry_mod.trace_annotation(
+                    f"gol.batch.compile.{bucket_id}.{take}"
+                ):
+                    t0 = time_mod.perf_counter()
+                    lowered = fn.lower(*args)
+                    t1 = time_mod.perf_counter()
+                    compiled = lowered.compile()
+                    t2 = time_mod.perf_counter()
+                evolvers[(bucket_id, take)] = (compiled, masked)
+                if events is not None:
+                    from gol_tpu.telemetry import stats as stats_mod
+
+                    events.compile_event(
+                        take,
+                        t1 - t0,
+                        t2 - t1,
+                        memory=stats_mod.compiled_memory(compiled),
+                        batch=self._batch_block(bucket_id),
+                    )
+        return evolvers
+
+    # -- telemetry ---------------------------------------------------------
+    def _batch_block(self, bucket_id: int) -> dict:
+        """The schema-v4 ``batch`` block for one bucket's events."""
+        bucket = self.buckets[bucket_id]
+        return dict(
+            bucket=list(bucket.shape),
+            B=bucket.batch,
+            masked=bucket.masked,
+            engine=self._engines[bucket_id],
+        )
+
+    def open_event_log(self):
+        """A fresh EventLog with the batch run header, or None."""
+        if not self.telemetry_dir:
+            return None
+        from gol_tpu import telemetry as telemetry_mod
+
+        events = telemetry_mod.EventLog(self.telemetry_dir, run_id=self.run_id)
+        events.run_header(
+            dict(
+                driver="batch",
+                engine=self.engine,
+                num_worlds=len(self._boards),
+                buckets=[
+                    dict(
+                        shape=list(bk.shape),
+                        B=bk.batch,
+                        masked=bk.masked,
+                        engine=self._engines[i],
+                        sharded=self._bucket_mesh(bk) is not None,
+                    )
+                    for i, bk in enumerate(self.buckets)
+                ],
+                bucket_quantum=self.bucket_quantum,
+                compile_cache=self.compile_cache,
+                checkpoint_every=self.checkpoint_every,
+            )
+        )
+        if self.restart_attempt > 0:
+            events.restart_event(self.restart_attempt)
+        if self.resume_info is not None and self.resume_info.get("path"):
+            events.resume_event(
+                generation=self.resume_info["generation"],
+                path=self.resume_info["path"],
+                fallback=bool(self.resume_info.get("fallback")),
+                skipped=self.resume_info.get("skipped") or [],
+            )
+        return events
+
+    # -- persistence --------------------------------------------------------
+    def _world_cells(self) -> int:
+        return sum(h * w for h, w in self._shapes)
+
+    def _save_snapshot(self) -> None:
+        from gol_tpu.utils.guard import fingerprint_np
+
+        path = ckpt_mod.batch_checkpoint_path(
+            self.checkpoint_dir, self.generation
+        )
+        boards = [b.copy() for b in self._boards]
+        generation = self.generation
+        fps = [fingerprint_np(b) for b in boards]
+
+        def write():
+            ckpt_mod.save_batch(path, boards, generation, fingerprints=fps)
+            if self.keep_snapshots > 0:
+                from gol_tpu.resilience import retention
+
+                retention.gc_snapshots(
+                    self.checkpoint_dir,
+                    self.keep_snapshots,
+                    kind="batch",
+                    protect=(self._resume_source,),
+                )
+
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.submit(write)
+        else:
+            write()
+
+    def _load_snapshot(self, resume: str) -> None:
+        snap = ckpt_mod.load_batch(resume)
+        if len(snap.boards) != len(self._boards):
+            raise ValueError(
+                f"batch checkpoint has {len(snap.boards)} worlds, run "
+                f"configured for {len(self._boards)}"
+            )
+        for i, b in enumerate(snap.boards):
+            if b.shape != self._shapes[i]:
+                raise ValueError(
+                    f"batch checkpoint world {i} is {b.shape}, run "
+                    f"configured for {self._shapes[i]}"
+                )
+            self._boards[i] = b
+        self.generation = snap.generation
+        self._resume_source = resume
+
+    # -- main entry ----------------------------------------------------------
+    def run(
+        self, iterations: int, resume: Optional[str] = None
+    ) -> Tuple[RunReport, List[np.ndarray]]:
+        """Step every world ``iterations`` generations; return the worlds.
+
+        Mirrors :meth:`gol_tpu.runtime.GolRuntime.run` phase for phase:
+        init / compile / chunked total (device execution only, fenced) /
+        checkpoint, with the preemption poll at chunk boundaries and the
+        async snapshot writer overlapping checkpoint I/O.
+        """
+        import time as time_mod
+
+        from gol_tpu import resilience
+        from gol_tpu import telemetry as telemetry_mod
+
+        sw = Stopwatch()
+        with sw.phase("init"):
+            if resume:
+                self._load_snapshot(resume)
+            stacks = {}
+            for bucket_id, bucket in enumerate(self.buckets):
+                stacks[bucket_id] = self._stack(bucket)
+
+        schedule = chunk_schedule(
+            iterations,
+            self.checkpoint_every if self.checkpoint_every > 0 else iterations,
+        )
+        events = self.open_event_log()
+        try:
+            with sw.phase("compile"):
+                evolvers = self.compile_evolvers(schedule, events)
+                for stack, _, _ in stacks.values():
+                    force_ready(stack)
+
+            writer = None
+            if self.checkpoint_every > 0:
+                writer = ckpt_mod.AsyncSnapshotWriter()
+            self._ckpt_writer = writer
+            try:
+                with telemetry_mod.trace_annotation("gol.batch.evolve"):
+                    for i, take in enumerate(schedule):
+                        with telemetry_mod.step_annotation("gol.batch.chunk", i):
+                            for bucket_id, bucket in enumerate(self.buckets):
+                                compiled, masked = evolvers[(bucket_id, take)]
+                                stack, hs, ws = stacks[bucket_id]
+                                with sw.phase("total"):
+                                    t0 = time_mod.perf_counter()
+                                    if masked:
+                                        stack = compiled(stack, hs, ws)
+                                    else:
+                                        stack = compiled(stack)
+                                    force_ready(stack)
+                                    dt = time_mod.perf_counter() - t0
+                                stacks[bucket_id] = (stack, hs, ws)
+                                if events is not None:
+                                    cells = sum(
+                                        self._shapes[j][0] * self._shapes[j][1]
+                                        for j in bucket.indices
+                                    )
+                                    block = self._batch_block(bucket_id)
+                                    block["per_world_updates_per_sec"] = (
+                                        cells * take / dt / bucket.batch
+                                        if dt > 0
+                                        else 0.0
+                                    )
+                                    events.chunk_event(
+                                        i,
+                                        take,
+                                        self.generation + take,
+                                        dt,
+                                        cells * take,
+                                        None,
+                                        batch=block,
+                                    )
+                        self.generation += take
+                        if self.checkpoint_every > 0:
+                            with sw.phase("init"):
+                                # Host crop of every stepped stack: the
+                                # donation fence (the next chunk consumes
+                                # the device buffers), outside 'total'.
+                                for bucket_id, bucket in enumerate(
+                                    self.buckets
+                                ):
+                                    self._unstack(
+                                        bucket, stacks[bucket_id][0]
+                                    )
+                                    # The donated device stack survives
+                                    # the fetch; rebuilding from host
+                                    # would double-copy.
+                            with telemetry_mod.trace_annotation(
+                                "gol.checkpoint.save"
+                            ):
+                                with sw.phase("checkpoint"):
+                                    t0 = time_mod.perf_counter()
+                                    self._save_snapshot()
+                                    dt = time_mod.perf_counter() - t0
+                            if events is not None:
+                                events.checkpoint_event(
+                                    self.generation,
+                                    dt,
+                                    self._world_cells(),
+                                    overlapped=writer is not None,
+                                )
+                        if i < len(schedule) - 1:
+                            if resilience.agreed_preempt_requested():
+                                checkpointed = self.checkpoint_every > 0
+                                if writer is not None and checkpointed:
+                                    with sw.phase("checkpoint"):
+                                        writer.flush()
+                                if events is not None:
+                                    events.preempt_event(
+                                        self.generation,
+                                        checkpointed=checkpointed,
+                                    )
+                                raise resilience.Preempted(
+                                    self.generation,
+                                    checkpoint_dir=self.checkpoint_dir
+                                    if checkpointed
+                                    else None,
+                                )
+                if writer is not None:
+                    with sw.phase("checkpoint"):
+                        writer.flush()
+            finally:
+                self._ckpt_writer = None
+                if writer is not None:
+                    writer.close()
+
+            with sw.phase("init"):
+                for bucket_id, bucket in enumerate(self.buckets):
+                    self._unstack(bucket, stacks[bucket_id][0])
+            report = sw.report(self._world_cells() * iterations)
+            if events is not None:
+                events.summary(report)
+        finally:
+            if events is not None:
+                events.close()
+        return report, list(self._boards)

@@ -91,6 +91,19 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     # --run-id so every rank's file shares one prefix.
     ext.add_argument("--telemetry", default=None, metavar="DIR")
     ext.add_argument("--run-id", default=None, metavar="NAME")
+    # Batched multi-world mode (gol_tpu/batch, docs/BATCHING.md): evolve
+    # B independent worlds in one compiled program per size bucket,
+    # amortizing the per-invocation launch overhead B-fold.  --batch-sizes
+    # gives per-world square sizes (comma list, cycled over the B worlds;
+    # default: every world uses the positional worldSize).  Mixed sizes
+    # are padded+masked into buckets — one program per bucket, not per
+    # shape.  --mesh 1d shards the world axis across devices.
+    ext.add_argument("--batch", type=int, default=0, metavar="B")
+    ext.add_argument("--batch-sizes", default=None, metavar="S1,S2,...")
+    # XLA persistent compilation cache: repeat invocations load compiled
+    # programs from DIR instead of re-running XLA (docs/BATCHING.md).
+    # Applies to every mode, not just --batch.
+    ext.add_argument("--compile-cache", default=None, metavar="DIR")
     # In-graph simulation statistics: each chunk additionally returns
     # fused device reductions (population, births/deaths, changed,
     # boundary-band populations — global via psum on sharded runs),
@@ -146,6 +159,71 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     return ns
 
 
+def _run_batch(
+    ns, sizes, resume, resume_info, iterations, restart_attempt
+) -> int:
+    """The ``--batch`` driver: B independent worlds, one launch per bucket.
+
+    Worlds are the CLI pattern at the ``--batch-sizes`` geometries
+    (cycled over the B worlds; default: every world at the positional
+    ``worldSize``).  Reuses the reference surface end to end — the
+    TOTAL DURATION line counts every world's cell updates, ``on_off=1``
+    dumps each world's rank files under ``outdir/world_<i>/``, and the
+    resilience exit codes (75 = preempted, resumable) are unchanged.
+    """
+    from gol_tpu import resilience
+    from gol_tpu.batch import GolBatchRuntime, make_batch_mesh
+    from gol_tpu.models import patterns
+
+    worlds = [
+        patterns.init_global(ns.pattern, sizes[i % len(sizes)], ns.ranks)
+        for i in range(ns.batch)
+    ]
+    try:
+        brt = GolBatchRuntime(
+            worlds=worlds,
+            engine=ns.engine,
+            mesh=make_batch_mesh() if ns.mesh == "1d" else None,
+            tile_hint=ns.threads,
+            checkpoint_every=ns.checkpoint_every,
+            checkpoint_dir=ns.checkpoint_dir,
+            keep_snapshots=ns.keep_snapshots,
+            telemetry_dir=ns.telemetry,
+            run_id=ns.run_id,
+            compile_cache=ns.compile_cache,
+            restart_attempt=restart_attempt,
+            resume_info=resume_info,
+        )
+        with resilience.preemption_guard():
+            report, boards = brt.run(iterations, resume=resume)
+    except resilience.Preempted as e:
+        print(e)
+        return resilience.EX_TEMPFAIL
+    except (ValueError, OSError) as e:
+        print(e)
+        return 255
+
+    print(report.duration_line())
+    print(
+        f"BATCH          : {ns.batch} worlds in {len(brt.buckets)} "
+        f"bucket(s), {report.updates_per_sec / max(ns.batch, 1):.4g} "
+        "cell-updates/sec per world"
+    )
+    accelerator = "GPU" if ns.compat_banner else "TPU"
+    print(
+        f"This is the Game of Life running in parallel on a {accelerator} "
+        "on multiple ranks."
+    )
+    if ns.on_off == 1:
+        from gol_tpu.utils import io as gol_io
+
+        for i, b in enumerate(boards):
+            wdir = os.path.join(ns.outdir, f"world_{i:04d}")
+            os.makedirs(wdir, exist_ok=True)
+            gol_io.write_world_dumps(np.asarray(b), ns.ranks, wdir)
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "verify":
@@ -183,7 +261,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 255
 
-    if ns.on_off == 1:
+    if ns.on_off == 1 and not ns.batch:
         # Reference lifecycle (gol-main.c:64-73): every rank's dump file is
         # fopen'd "w" right after MPI_Init, BEFORE world init/validation —
         # files exist (truncated) from startup even if the run later dies,
@@ -254,9 +332,68 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"--keep-snapshots must be >= 0, got {ns.keep_snapshots} "
                 "(0 keeps every snapshot)"
             )
+        if ns.batch < 0:
+            raise ValueError(f"--batch must be >= 0, got {ns.batch}")
+        if ns.batch_sizes and not ns.batch:
+            raise ValueError(
+                "--batch-sizes applies to batched runs; pass --batch B"
+            )
+        batch_sizes = None
+        if ns.batch:
+            # Batched multi-world mode: single-process, fresh-halo,
+            # Conway-only (the batched tiers are the B3/S23 fast paths);
+            # the guard/stats observers are single-world subsystems.
+            if topo.process_count > 1:
+                raise ValueError(
+                    "--batch is single-process (its mesh spans local "
+                    "devices); drop the multi-host flags"
+                )
+            if ns.halo != "fresh":
+                raise ValueError("--batch runs fresh halos only")
+            if ns.rule:
+                raise ValueError(
+                    "--batch runs the B3/S23 fast paths; --rule is a "
+                    "single-world feature"
+                )
+            if ns.guard_every > 0 or ns.stats:
+                raise ValueError(
+                    "--guard-every/--stats are single-world observers; "
+                    "drop them in --batch mode"
+                )
+            if ns.profile:
+                raise ValueError(
+                    "--profile applies to single-world runs; drop --batch"
+                )
+            if ns.mesh == "2d":
+                raise ValueError(
+                    "--batch shards the world axis (a 1-D ring); use "
+                    "--mesh 1d or --mesh none"
+                )
+            if ns.engine == "pallas":
+                raise ValueError(
+                    "engine 'pallas' (dense kernel) has no batched tier; "
+                    "use 'auto'/'dense'/'bitpack'/'pallas_bitpack'"
+                )
+            sizes_text = ns.batch_sizes or str(ns.world_size)
+            batch_sizes = [atoi(s) for s in sizes_text.split(",") if s]
+            if not batch_sizes or any(s <= 0 for s in batch_sizes):
+                raise ValueError(
+                    f"--batch-sizes {sizes_text!r} must be a comma list "
+                    "of positive world sizes (parses to no sizes)"
+                )
+            for s in batch_sizes:
+                Geometry(size=s, num_ranks=ns.ranks)
+                patterns.validate_pattern_size(ns.pattern, s)
     except ValueError as e:
         print(e)
         return 255
+
+    if ns.compile_cache:
+        # Persistent XLA compilation cache (docs/BATCHING.md): wire it
+        # before any program compiles so every mode benefits.
+        from gol_tpu.batch import cache as cache_mod
+
+        cache_mod.enable_compile_cache(ns.compile_cache)
 
     from gol_tpu import resilience
 
@@ -269,7 +406,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ns.checkpoint_dir = ns.checkpoint_dir or "checkpoints"
         try:
             resume, resume_info = resilience.resolve_auto_resume(
-                ns.checkpoint_dir, kind="2d"
+                ns.checkpoint_dir, kind="batch" if ns.batch else "2d"
             )
         except (ValueError, OSError) as e:
             print(e)
@@ -302,6 +439,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         restart_attempt = int(os.environ.get("GOL_RESTART_ATTEMPT", "0"))
     except ValueError:
         restart_attempt = 0
+
+    if ns.batch:
+        return _run_batch(
+            ns, batch_sizes, resume, resume_info, iterations, restart_attempt
+        )
 
     try:
         rt = GolRuntime(

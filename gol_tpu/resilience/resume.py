@@ -44,6 +44,8 @@ def _snapshot_at(directory: str, kind: str, generation: int) -> Optional[str]:
             ckpt_mod.checkpoint3d_path(directory, generation),
             ckpt_mod.sharded_checkpoint3d_path(directory, generation),
         )
+    elif kind == "batch":
+        candidates = (ckpt_mod.batch_checkpoint_path(directory, generation),)
     else:
         candidates = (
             ckpt_mod.checkpoint_path(directory, generation),

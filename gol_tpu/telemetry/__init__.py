@@ -13,6 +13,9 @@ of it:
   the sharded checkpoint format).  Record types: ``run_header``,
   ``compile``, ``chunk``, ``guard_audit``, ``checkpoint``, ``bench_row``,
   ``summary``, and (schema v2) ``stats`` — see ``REQUIRED_FIELDS``.
+  Schema v4: batched runs (:mod:`gol_tpu.batch`) stamp ``chunk`` and
+  ``compile`` events with a ``batch`` block (bucket shape, B, per-world
+  throughput — docs/BATCHING.md).
   ``--stats`` chunks carry in-graph simulation reductions
   (:mod:`gol_tpu.telemetry.stats`), ``compile`` events the compiled
   program's memory footprint, and ``python -m gol_tpu.telemetry watch``
@@ -45,14 +48,18 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 3 (this round) adds the resilience events — ``preempt``,
-# ``resume``, ``restart`` (docs/RESILIENCE.md).  Version 2 added the
-# ``stats`` event type and optional ``memory``/``cost`` blocks on
-# ``compile`` events.  Older streams stay readable: every v1/v2 event
+# Version 4 (this round) adds the batched multi-world fields
+# (docs/BATCHING.md): ``chunk`` and ``compile`` events may carry a
+# ``batch`` block — ``{bucket: [H, W], B, masked, engine,
+# per_world_updates_per_sec}`` — and a batch run's ``run_header.config``
+# records the bucket layout.  Version 3 added the resilience events —
+# ``preempt``, ``resume``, ``restart`` (docs/RESILIENCE.md); version 2
+# the ``stats`` event type and optional ``memory``/``cost`` blocks on
+# ``compile`` events.  Older streams stay readable: every v1-v3 event
 # type and field survives unchanged, so consumers only ever *gain*
-# records (back-compat pinned by the committed v1 and v2 fixture tests).
-SCHEMA_VERSION = 3
-SUPPORTED_SCHEMAS = (1, 2, 3)
+# records (back-compat pinned by the committed v1/v2/v3 fixture tests).
+SCHEMA_VERSION = 4
+SUPPORTED_SCHEMAS = (1, 2, 3, 4)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -218,13 +225,19 @@ class EventLog:
         lower_s: float,
         compile_s: float,
         memory: Optional[dict] = None,
+        batch: Optional[dict] = None,
     ) -> None:
         """``memory`` (v2, optional): the compiled program's
         ``memory_analysis``/``cost_analysis`` distillation
         (:func:`gol_tpu.telemetry.stats.compiled_memory`) — peak HBM and
         argument/output/temp bytes per chunk size, the actual scaling
-        limit compile *durations* never showed."""
+        limit compile *durations* never showed.  ``batch`` (v4,
+        optional): the bucket this program serves (``bucket`` shape,
+        ``B``, ``masked``, resolved ``engine``) — a persistent-cache hit
+        shows as near-zero ``compile_s`` on the same bucket block."""
         extra = {} if memory is None else {"memory": memory}
+        if batch is not None:
+            extra["batch"] = batch
         self.emit(
             "compile", chunk=chunk, lower_s=lower_s, compile_s=compile_s,
             **extra,

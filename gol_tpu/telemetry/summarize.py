@@ -134,11 +134,16 @@ def find_anomalies(run: Run) -> List[str]:
     rank0 = min(run.ranks, default=0)
     chunks = run.records("chunk", rank=rank0)
 
-    # Chunk-time outliers, per chunk-size class.
-    by_take: Dict[int, List[dict]] = {}
+    # Chunk-time outliers, per chunk-size class.  Batched runs (schema
+    # v4) emit one record per bucket per chunk, so the class additionally
+    # keys on the bucket — a big bucket's wall is not an outlier just
+    # because a small bucket shares its take.
+    by_take: Dict[tuple, List[dict]] = {}
     for c in chunks:
-        by_take.setdefault(c["take"], []).append(c)
-    for take, cs in sorted(by_take.items()):
+        b = c.get("batch") or {}
+        key = (c["take"], tuple(b.get("bucket", ())), b.get("B"))
+        by_take.setdefault(key, []).append(c)
+    for (take, _, _), cs in sorted(by_take.items()):
         if len(cs) < 3:
             continue  # no meaningful baseline
         med = statistics.median(c["wall_s"] for c in cs)
@@ -416,18 +421,30 @@ def render_run(run: Run, out) -> None:
 
     chunks = run.records("chunk", rank=rank0)
     if chunks:
+        batched = any(c.get("batch") for c in chunks)
         print(
             "  chunk     gens       gen      wall_s     updates/s  "
-            "roofline",
+            "roofline" + ("  batch (bucket B eng per-world/s)" if batched else ""),
             file=out,
         )
         for c in chunks:
-            print(
+            line = (
                 f"  {c['index']:>5} {c['take']:>8} {c['generation']:>9} "
                 f"{c['wall_s']:>11.4f}  {_fmt_rate(c['updates_per_sec']):>12}"
-                f"  {_fmt_util(c.get('roofline_util')):>8}",
-                file=out,
+                f"  {_fmt_util(c.get('roofline_util')):>8}"
             )
+            b = c.get("batch")
+            if b:
+                # Schema v4 (docs/BATCHING.md): one chunk record per
+                # bucket; per-world throughput is the serving metric.
+                shape = "x".join(str(x) for x in b.get("bucket", []))
+                pw = b.get("per_world_updates_per_sec")
+                line += (
+                    f"  {shape} B={b.get('B')} {b.get('engine', '?')}"
+                    + (f" {_fmt_rate(pw)}/world" if pw is not None else "")
+                    + (" masked" if b.get("masked") else "")
+                )
+            print(line, file=out)
 
     stats = run.records("stats", rank=rank0)
     if stats:

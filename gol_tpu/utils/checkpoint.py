@@ -20,6 +20,13 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 CKPT_SUFFIX = ".gol.npz"
+# Batched multi-world snapshots (gol_tpu/batch): one archive holding every
+# world of a batch run, each with its own fingerprint.  Single-file only —
+# the batch runtime is single-process (its mesh spans local devices), so
+# there is no sharded batch format; the kind's sharded suffix below exists
+# solely so the generic kind plumbing has a never-matching value.
+BCKPT_SUFFIX = ".golb.npz"
+BCKPT_SHARD_DIR_SUFFIX = ".golb.shards.d"  # reserved; never written
 
 
 @dataclasses.dataclass(frozen=True)
@@ -288,6 +295,110 @@ def _read_snapshot(path: str, data) -> Snapshot:
         bottom0=bottom0,
         rule=str(data["rule"]) if "rule" in data else None,
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchSnapshot:
+    """One batched multi-world snapshot: every world at one generation."""
+
+    boards: List[np.ndarray]  # per-world uint8 grids, heterogeneous shapes
+    generation: int
+
+
+def batch_checkpoint_path(directory: str, generation: int) -> str:
+    return os.path.join(directory, f"bckpt_{generation:012d}{BCKPT_SUFFIX}")
+
+
+def save_batch(
+    path: str,
+    boards,
+    generation: int,
+    fingerprints=None,
+) -> str:
+    """Write a batched snapshot atomically: all worlds, one archive.
+
+    Each world carries its own content fingerprint (the same
+    ``fingerprint_np`` the single-world format stamps), so :func:`load_batch`
+    verifies every world independently — one flipped byte corrupts the
+    whole snapshot loudly, exactly like the 2-D format.  ``fingerprints``
+    (device-computed, optional) skips the host-side recompute per world.
+    """
+    from gol_tpu.utils.guard import fingerprint_np
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    boards = [np.asarray(b, np.uint8) for b in boards]
+    fps = (
+        [fingerprint_np(b) for b in boards]
+        if fingerprints is None
+        else [int(f) for f in fingerprints]
+    )
+    if len(fps) != len(boards):
+        raise ValueError(
+            f"{len(fps)} fingerprints for {len(boards)} worlds"
+        )
+    arrays = dict(
+        generation=np.int64(generation),
+        num_worlds=np.int64(len(boards)),
+        fingerprints=np.asarray(fps, np.uint32),
+    )
+    for i, b in enumerate(boards):
+        arrays[f"world_{i:05d}"] = b
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(tmp, **arrays)
+    _tmp_rename_gap()
+    os.replace(tmp, path)
+    return path
+
+
+def load_batch(path: str) -> BatchSnapshot:
+    """Read a batched snapshot, verifying every world's fingerprint.
+
+    Any malformation — unreadable archive, missing world, fingerprint
+    mismatch — raises :class:`CorruptSnapshotError`, so the validated
+    auto-resume walk (``kind='batch'``) falls back past it exactly as it
+    does for the single-world formats.
+    """
+    import zipfile
+    import zlib
+
+    from gol_tpu.utils.guard import fingerprint_np
+
+    try:
+        data = np.load(path)
+    except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+        raise CorruptSnapshotError(
+            f"{path}: not a readable batch snapshot archive ({e})"
+        ) from e
+    with data:
+        try:
+            n = int(data["num_worlds"])
+            fps = data["fingerprints"]
+            if len(fps) != n:
+                raise CorruptSnapshotError(
+                    f"{path}: {len(fps)} fingerprints for {n} worlds"
+                )
+            boards = []
+            for i in range(n):
+                board = data[f"world_{i:05d}"].astype(np.uint8)
+                actual = fingerprint_np(board)
+                if int(fps[i]) != actual:
+                    raise CorruptSnapshotError(
+                        f"{path}: world {i} fingerprint {actual:#010x} != "
+                        f"stored {int(fps[i]):#010x}; the snapshot is "
+                        "corrupt"
+                    )
+                boards.append(board)
+            return BatchSnapshot(
+                boards=boards, generation=int(data["generation"])
+            )
+        except CorruptSnapshotError:
+            raise
+        except (
+            zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
+        ) as e:
+            raise CorruptSnapshotError(
+                f"{path}: batch snapshot archive is corrupt ({e})"
+            ) from e
 
 
 def _sharded_complete(dirpath: str) -> bool:
@@ -897,7 +1008,7 @@ def read_sharded3d_region(
 # newest→oldest, fully verifying each candidate (fingerprints included),
 # and reports what it skipped so the fallback is loggable.
 
-_GEN_RE = re.compile(r"^ckpt(?:3d)?_(\d+)\.")
+_GEN_RE = re.compile(r"^b?ckpt(?:3d)?_(\d+)\.")
 
 
 def snapshot_generation(path: str) -> Optional[int]:
@@ -912,7 +1023,11 @@ def _kind_suffixes(kind: str) -> Tuple[str, str, str]:
         return "ckpt_", CKPT_SUFFIX, SHARD_DIR_SUFFIX
     if kind == "3d":
         return "ckpt3d_", CKPT3D_SUFFIX, SHARD3D_DIR_SUFFIX
-    raise ValueError(f"unknown snapshot kind {kind!r}; expected '2d'/'3d'")
+    if kind == "batch":
+        return "bckpt_", BCKPT_SUFFIX, BCKPT_SHARD_DIR_SUFFIX
+    raise ValueError(
+        f"unknown snapshot kind {kind!r}; expected '2d'/'3d'/'batch'"
+    )
 
 
 def list_snapshots(directory: str, kind: str = "2d") -> List[str]:
@@ -1035,6 +1150,8 @@ def verify_snapshot(path: str, only_process: Optional[int] = None) -> int:
             path, meta.shape, meta.rects, meta.procs, "rects", only_process
         )
         return meta.generation
+    if name.endswith(BCKPT_SUFFIX):
+        return load_batch(path).generation
     if name.endswith(CKPT3D_SUFFIX):
         return load3d(path).generation
     if name.endswith(CKPT_SUFFIX):

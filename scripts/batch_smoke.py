@@ -1,0 +1,101 @@
+"""Batch-smoke gate: bit-equality vs sequential + compile-cache hits.
+
+The check.sh stage for the batched multi-world engine
+(docs/BATCHING.md).  Three assertions, all on the CPU backend:
+
+1. **bit-equality** — a batched run of B mixed-size worlds (two buckets,
+   one masked) is bit-identical per world to sequential single-world
+   runs of the existing engine;
+2. **cache population** — a CLI batch run with ``--compile-cache DIR``
+   leaves compiled-program entries in DIR;
+3. **cache hit** — a *second process* running the identical workload
+   adds zero new entries (every program served from the persistent
+   cache).
+
+Exits non-zero with a message on any failure.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def check_bit_equality() -> None:
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from gol_tpu.batch import GolBatchRuntime
+    from gol_tpu.ops import stencil
+
+    rng = np.random.default_rng(11)
+    shapes = [(64, 64), (48, 32), (64, 64), (96, 96)]
+    worlds = [(rng.random(s) < 0.35).astype(np.uint8) for s in shapes]
+    refs = [np.asarray(stencil.run(jnp.asarray(w.copy()), 12)) for w in worlds]
+    brt = GolBatchRuntime(worlds=[w.copy() for w in worlds], engine="auto")
+    _, out = brt.run(12)
+    for i, ref in enumerate(refs):
+        if not np.array_equal(out[i], ref):
+            sys.exit(
+                f"batch smoke FAILED: world {i} {shapes[i]} diverges from "
+                "its sequential single-world run"
+            )
+    print(
+        f"batch smoke: {len(worlds)} worlds in "
+        f"{len(brt.buckets)} buckets bit-equal to sequential runs"
+    )
+
+
+def check_compile_cache() -> None:
+    from gol_tpu.batch import cache as cache_mod
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cache_dir = os.path.join(tmp, "cc")
+        cmd = [
+            sys.executable, "-m", "gol_tpu", "6", "64", "8", "512", "0",
+            "--batch", "4", "--batch-sizes", "64,96",
+            "--compile-cache", cache_dir,
+        ]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+        for attempt in (1, 2):
+            subprocess.run(
+                cmd, env=env, cwd=tmp, check=True, capture_output=True
+            )
+            entries = cache_mod.cache_entries(cache_dir)
+            if attempt == 1:
+                if not entries:
+                    sys.exit(
+                        "batch smoke FAILED: --compile-cache left no "
+                        f"entries in {cache_dir}"
+                    )
+                first = entries
+            elif entries != first:
+                new = sorted(set(entries) - set(first))
+                sys.exit(
+                    "batch smoke FAILED: second run missed the persistent "
+                    f"compilation cache (new entries: {new})"
+                )
+        print(
+            f"batch smoke: compile cache populated ({len(first)} entries), "
+            "second process added none (all hits)"
+        )
+
+
+def main() -> int:
+    check_bit_equality()
+    check_compile_cache()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,26 +12,29 @@
 #   5. resilience drill (supervised run, SIGTERM the child once;
 #                       auto-resume must finish with the same
 #                       final-grid hash as an uninterrupted run)
-#   6. tier-1 tests    (the exact ROADMAP.md command)
+#   6. batch smoke     (batched multi-world run bit-equal to
+#                       sequential; --compile-cache populated on run 1,
+#                       zero new entries on run 2 — all hits)
+#   7. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/6] lint =="
+echo "== [1/7] lint =="
 bash scripts/lint.sh
 
-echo "== [2/6] static verifier (gol_tpu.analysis) =="
+echo "== [2/7] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/6] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/7] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/6] stats smoke (in-graph simulation statistics) =="
+echo "== [4/7] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -40,10 +43,13 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/6] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/7] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/6] tier-1 tests =="
+echo "== [6/7] batch smoke (docs/BATCHING.md) =="
+JAX_PLATFORMS=cpu python scripts/batch_smoke.py
+
+echo "== [7/7] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,118 @@
+"""Schema v4 (batched multi-world fields) + v1/v2/v3 back-compat.
+
+Companion to tests/test_telemetry.py (v1), test_telemetry_v2.py and
+test_telemetry_v3.py.  Here:
+
+- the v4 additions round-trip: the ``batch`` block on ``chunk`` and
+  ``compile`` events (bucket shape, B, masked, engine, per-world
+  throughput) and the batch run header;
+- **back-compat**: ALL THREE committed fixtures — PR 2 (v1), PR 3 (v2)
+  and PR 5 (v3) — still load, and a directory holding v1 + v2 + v3 + a
+  freshly-written v4 stream merges and renders in one ``summarize``
+  pass (exit 0), while a bogus schema still exits 2;
+- the chunk-outlier anomaly classes batched records per bucket, so a
+  big bucket sharing a take with a small one is not a false outlier.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+V1_FIXTURE = DATA / "telemetry_v1" / "pr2run.rank0.jsonl"
+V2_FIXTURE = DATA / "telemetry_v2" / "pr3run.rank0.jsonl"
+V3_FIXTURE = DATA / "telemetry_v3" / "pr5run.rank0.jsonl"
+
+BATCH_BLOCK = {
+    "bucket": [64, 64],
+    "B": 8,
+    "masked": True,
+    "engine": "bitpack",
+    "per_world_updates_per_sec": 1.2e7,
+}
+
+
+def _v4_stream(directory, run_id="v4"):
+    with telemetry.EventLog(str(directory), run_id=run_id, process_index=0) as ev:
+        ev.run_header(
+            {
+                "driver": "batch",
+                "num_worlds": 8,
+                "buckets": [
+                    {"shape": [64, 64], "B": 8, "masked": True,
+                     "engine": "bitpack", "sharded": False}
+                ],
+            }
+        )
+        ev.compile_event(4, 0.01, 0.09, batch=dict(BATCH_BLOCK))
+        ev.chunk_event(0, 4, 4, 0.001, 131072, None, batch=dict(BATCH_BLOCK))
+        return ev.path
+
+
+def test_v4_batch_fields_roundtrip(tmp_path):
+    path = _v4_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION == 4
+    assert set(telemetry.SUPPORTED_SCHEMAS) == {1, 2, 3, 4}
+    compile_rec = recs[1]
+    chunk_rec = recs[2]
+    assert compile_rec["batch"]["bucket"] == [64, 64]
+    assert chunk_rec["batch"]["B"] == 8
+    assert chunk_rec["batch"]["per_world_updates_per_sec"] == 1.2e7
+
+
+def test_committed_fixture_schemas_are_v1_v2_v3():
+    for fixture, want in (
+        (V1_FIXTURE, 1), (V2_FIXTURE, 2), (V3_FIXTURE, 3),
+    ):
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v1_v2_v3_v4_merge_in_one_pass(tmp_path):
+    for fixture in (V1_FIXTURE, V2_FIXTURE, V3_FIXTURE):
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v4_stream(tmp_path, run_id="now")
+    out = io.StringIO()
+    assert summ_mod.summarize(str(tmp_path), out) == 0
+    text = out.getvalue()
+    for run in ("pr2run", "pr3run", "pr5run", "now"):
+        assert f"run {run}" in text
+    assert "B=8" in text and "masked" in text
+
+
+def test_unknown_schema_still_exits_2(tmp_path):
+    rec = {
+        "event": "run_header", "t": 1.0, "schema": 99, "run_id": "x",
+        "process_index": 0, "process_count": 1, "config": {},
+    }
+    (tmp_path / "x.rank0.jsonl").write_text(json.dumps(rec) + "\n")
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+
+
+def test_chunk_outlier_classes_key_on_bucket(tmp_path):
+    """Two buckets sharing a take must not flag each other as outliers."""
+    with telemetry.EventLog(str(tmp_path), run_id="b", process_index=0) as ev:
+        ev.run_header({"driver": "batch"})
+        big = {"bucket": [256, 256], "B": 4, "masked": False,
+               "engine": "bitpack"}
+        small = {"bucket": [64, 64], "B": 4, "masked": False,
+                 "engine": "bitpack"}
+        for i in range(3):
+            ev.chunk_event(i, 4, 4 * (i + 1), 0.010, 1 << 20, None,
+                           batch=dict(big))
+            ev.chunk_event(i, 4, 4 * (i + 1), 0.001, 1 << 16, None,
+                           batch=dict(small))
+    runs = summ_mod.load_dir(str(tmp_path))
+    flags = summ_mod.find_anomalies(runs["b"])
+    assert not [f for f in flags if "outlier" in f], flags
