@@ -1,9 +1,10 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
-# resilience drill + batch smoke + tier-1 tests (see scripts/check.sh).
+# resilience drill + batch smoke + sparse smoke + tier-1 tests
+# (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
-	resilience-drill batch-smoke batchbench
+	resilience-drill batch-smoke batchbench sparse-smoke sparsebench
 
 lint:
 	bash scripts/lint.sh
@@ -49,6 +50,17 @@ batch-smoke:
 # (CPU: curve shape; the TPU headline is --size 256 --iters 1024).
 batchbench:
 	python benchmarks/batchbench.py --round 6
+
+# Activity-gated smoke (docs/SPARSE.md): glider-gun run bit-equal to
+# the dense bitpack tier while skipping most tile-generations.
+sparse-smoke:
+	JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
+
+# Dense-vs-gated speedup curve over live-cell fraction ->
+# SPARSE_r{N}.json (CPU: curve shape; the TPU headline is
+# --size 65536 --iters 256).
+sparsebench:
+	python benchmarks/sparsebench.py --tile 128 --capacity 0.125 --round 7
 
 check:
 	bash scripts/check.sh

@@ -1,0 +1,217 @@
+"""sparsebench: dense-vs-gated speedup over live-cell fraction
+(SPARSE_r{N}.json).
+
+The activity tier's reason to exist: every dense tier pays O(area) per
+generation regardless of how much of the board is alive, while real
+Life workloads (gliders, guns, methuselahs in huge arenas) are ~all
+dead space.  This harness measures exactly that curve:
+
+- **scenarios** sweep live-cell fraction downward: random soups at
+  decreasing seed densities (high-density soups stay chaotic — the
+  gated tier honestly loses there to its own gating overhead and
+  fallbacks) down to single-object seeds from the sparse pattern zoo
+  (:data:`gol_tpu.models.patterns.SPARSE_OBJECTS`) whose live fraction
+  at a big extent is ~1e-4;
+- for each scenario both programs are timed under the same discipline
+  (best-of-N, fresh donated buffers, ``force_ready`` fenced): the dense
+  bitpack tier (:func:`gol_tpu.ops.bitlife.evolve_dense_io` — the
+  repo's fastest non-Pallas O(area) engine, and the tier the acceptance
+  pin compares against) vs the activity worklist
+  (:func:`gol_tpu.sparse.engine.evolve_gated_packed` /
+  ``_dense``, matching the board's word alignment);
+- ``speedup`` is the headline: ``dense_wall / gated_wall`` per
+  scenario, alongside the run's measured active fraction and fallback
+  count so a reader can see *why* a row wins or loses.
+
+On the CPU backend this captures curve *shape* only (like every
+cpu_mesh artifact — the absolute walls mean nothing); the TPU headline
+capture for the ≥10× acceptance number on a 65536² board at <1% live is
+pinned in the note::
+
+    python benchmarks/sparsebench.py --size 65536 --iters 256 \
+        --round 7   # TPU
+
+Usage::
+
+    python benchmarks/sparsebench.py --round 7            # defaults
+    python benchmarks/sparsebench.py --size 2048 --iters 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Optional, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct-script invocation from anywhere
+    sys.path.insert(0, str(REPO))
+
+
+def scenarios(size: int):
+    """(name, board-factory) pairs, densest first."""
+    import numpy as np
+
+    from gol_tpu.models import patterns
+
+    def soup(density, seed=42):
+        def make():
+            rng = np.random.default_rng(seed)
+            return (rng.random((size, size)) < density).astype(np.uint8)
+
+        return make
+
+    def obj(name):
+        # Center-ish offset: nothing special about it (torus), but it
+        # keeps the object clear of the seam visualizations in dumps.
+        return lambda: patterns.init_sparse_world(
+            name, size, size, (size // 3, size // 3)
+        )
+
+    rows = [
+        ("soup_0.100", soup(0.100)),
+        ("soup_0.030", soup(0.030)),
+        ("soup_0.010", soup(0.010)),
+        ("soup_0.003", soup(0.003)),
+        ("soup_0.001", soup(0.001)),
+        ("acorn", obj("acorn")),
+        ("gosper_gun", obj("gosper_gun")),
+        ("lwss", obj("lwss")),
+    ]
+    return rows
+
+
+def measure(name, make_board, size: int, iters: int, tile: int,
+            capacity_frac: float, repeats: int) -> dict:
+    import jax
+    import numpy as np
+
+    from gol_tpu.ops import bitlife
+    from gol_tpu.sparse import engine as sparse_engine
+    from gol_tpu.sparse import mask as sparse_mask
+    from gol_tpu.utils.timing import time_best
+
+    board_np = make_board()
+    packed = size % bitlife.BITS == 0 and tile % bitlife.BITS == 0
+    th, tw = sparse_mask.grid_shape(size, size, tile)
+    capacity = sparse_engine.default_capacity(th, tw, capacity_frac)
+
+    def fresh_board():
+        return jax.device_put(board_np)
+
+    dense_wall = time_best(
+        lambda b: bitlife.evolve_dense_io(b, iters), fresh_board,
+        repeats=repeats,
+    )
+
+    gated = (
+        sparse_engine.evolve_gated_packed
+        if packed
+        else sparse_engine.evolve_gated_dense
+    )
+
+    def fresh_pair():
+        return (
+            jax.device_put(board_np),
+            sparse_mask.full_mask(th, tw),
+        )
+
+    def run_gated(args):
+        b, m = args
+        out, _, act = gated(b, m, iters, tile, capacity)
+        return out, act
+
+    gated_wall = time_best(run_gated, fresh_pair, repeats=repeats)
+
+    # One more (untimed) run for the bit-equality receipt + counters.
+    ref = np.asarray(bitlife.evolve_dense_io(fresh_board(), iters))
+    out, act = run_gated(fresh_pair())
+    if not np.array_equal(np.asarray(out), ref):
+        raise AssertionError(
+            f"scenario {name!r}: gated result diverges from dense — "
+            "refusing to write a benchmark row for a wrong program"
+        )
+    tile_gens = th * tw * iters
+    computed = int(act["computed_tile_gens"])
+    return dict(
+        scenario=name,
+        live_fraction_t0=float(board_np.mean()),
+        live_fraction_final=float(ref.mean()),
+        repr="packed" if packed else "dense",
+        tile=tile,
+        capacity=capacity,
+        dense_wall_s=dense_wall,
+        gated_wall_s=gated_wall,
+        speedup=dense_wall / gated_wall if gated_wall > 0 else None,
+        active_fraction=int(act["active_tile_gens"]) / tile_gens,
+        computed_fraction=computed / tile_gens,
+        fallback_gens=int(act["fallback_gens"]),
+        bit_equal=True,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="sparsebench", description=__doc__)
+    ap.add_argument("--size", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=256)
+    ap.add_argument("--tile", type=int, default=0, metavar="T")
+    ap.add_argument("--capacity", type=float, default=0.25, metavar="FRAC")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--round", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    ns = ap.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    import jax
+
+    from gol_tpu.sparse import mask as sparse_mask
+
+    tile = ns.tile or sparse_mask.pick_tile(ns.size, ns.size, packed=True)
+    rows = [
+        measure(name, make, ns.size, ns.iters, tile, ns.capacity,
+                ns.repeats)
+        for name, make in scenarios(ns.size)
+    ]
+    payload = dict(
+        note=(
+            "dense-vs-gated speedup curve over live-cell fraction "
+            "(docs/SPARSE.md). dense_wall_s = best-of-N fenced wall of "
+            "the bitpack tier's compiled O(area) loop; gated_wall_s = "
+            "the activity worklist on the same board from the all-ones "
+            "mask; speedup = dense/gated, growing as the live fraction "
+            "drops (dense soups honestly lose to gating overhead + "
+            "fallbacks). Every row is written only after a bit-equality "
+            "check of the two final grids. CPU-backend captures are "
+            "curve shape only; the TPU headline (>=10x at <1% live) is "
+            "--size 65536 --iters 256."
+        ),
+        backend=jax.default_backend(),
+        size=ns.size,
+        iters=ns.iters,
+        tile=tile,
+        rows=rows,
+        command=(
+            f"python benchmarks/sparsebench.py --size {ns.size} "
+            f"--iters {ns.iters} --tile {tile} "
+            f"--capacity {ns.capacity} --round {ns.round}"
+        ),
+    )
+    out = ns.out or str(REPO / f"SPARSE_r{ns.round:02d}.json")
+    pathlib.Path(out).write_text(json.dumps(payload, indent=1) + "\n")
+    print(f"wrote {out}")
+    for row in rows:
+        print(
+            f"  {row['scenario']:>11}  live {row['live_fraction_t0']:.4f}"
+            f"  dense {row['dense_wall_s']:.4f}s  gated "
+            f"{row['gated_wall_s']:.4f}s  x{row['speedup']:.2f}"
+            f"  (active {100 * row['active_fraction']:.1f}%"
+            + (f", fb={row['fallback_gens']}" if row["fallback_gens"]
+               else "")
+            + ")"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,17 +79,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from gol_tpu.analysis.report import AnalysisReport
 
     matrix = select(default_matrix(), ns.engine, ns.mesh)
-    # The batched multi-world matrix (gol_tpu/batch) rides the full run
-    # only — engine/mesh filters select single-world engine cells.
-    batch_on = not ns.engine and not ns.mesh
+    # The batched multi-world matrix (gol_tpu/batch) and the activity
+    # matrix (gol_tpu/sparse) ride the full run only — engine/mesh
+    # filters select single-world engine cells.
+    extras_on = not ns.engine and not ns.mesh
     if ns.list:
         for cfg in matrix:
             print(cfg.name)
-        if batch_on:
+        if extras_on:
             from gol_tpu.analysis.batchcheck import default_batch_matrix
+            from gol_tpu.analysis.sparsecheck import default_sparse_matrix
 
             for bcfg in default_batch_matrix():
                 print(bcfg.name)
+            for scfg in default_sparse_matrix():
+                print(scfg.name)
         return 0
 
     from gol_tpu.analysis.checks import run_config
@@ -97,10 +101,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     report = AnalysisReport()
     for cfg in matrix:
         report.engines.append(run_config(cfg))
-    if batch_on:
+    if extras_on:
         from gol_tpu.analysis.batchcheck import run_batch_checks
+        from gol_tpu.analysis.sparsecheck import run_sparse_checks
 
         report.engines.extend(run_batch_checks())
+        report.engines.extend(run_sparse_checks())
 
     if ns.json:
         print(report.to_json())

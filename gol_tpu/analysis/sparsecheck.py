@@ -1,0 +1,372 @@
+"""Static checks over the activity-gated programs (docs/SPARSE.md).
+
+The activity matrix — one report per engine form × mesh — proves the
+three invariants the sparse tier lives or dies by, the same way the
+engine and batch matrices do:
+
+- **activity purity** — the gated chunk programs contain no host
+  callbacks (the worklist's ``nonzero``/gather/scatter and the
+  ``lax.cond`` fallback are all in-graph; a host round-trip per
+  generation would re-create the per-step sync the repo exists to
+  avoid).  Sharded forms additionally may contain *only* ppermute/psum
+  collectives (the mask/halo exchange and the replicated counters) —
+  anything else means the gating grew an unplanned gather.
+- **gated equivalence** — executed: an activity run from the all-ones
+  mask is bit-identical to the dense reference on a moving-object board
+  (a glider, whose translation visits tiles the initial activity has
+  long left), *and* actually skips tiles while doing it.
+- **mask-soundness teeth** — the reason the equivalence check can be
+  trusted: a deliberately-broken gen that **under-dilates** (gates on
+  the raw changed mask, skipping the one-tile neighborhood) must
+  visibly diverge from the dense oracle on the same board.  If the
+  broken fixture ever matches the oracle, the soundness property has
+  lost its witness and the check fails — the broken-fixture discipline
+  of the verifier applied to the dilation invariant.
+
+Run as part of ``python -m gol_tpu.analysis``; one
+:class:`~gol_tpu.analysis.report.EngineReport` per configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from gol_tpu.analysis import walker
+from gol_tpu.analysis.checks import (
+    COLLECTIVE_PRIMITIVES,
+    IMPURE_PRIMITIVES,
+    check_dtype,
+)
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+STEPS = 24  # generations per executed check: the glider crosses tiles
+TILE = 8  # default mask tile edge (packed configs use the 32-cell word)
+CAPACITY = 24  # tiles; ample for one dilated glider, small vs the grid
+
+#: Collectives the sharded activity program may legitimately contain.
+ALLOWED_COLLECTIVES = frozenset({"ppermute", "psum"})
+
+
+@dataclasses.dataclass(frozen=True)
+class SparseConfig:
+    """One cell of the activity verification matrix."""
+
+    name: str
+    mesh: str  # none / 1d / 2d
+    packed: bool = False
+    size: int = 64  # square board edge
+    tile: int = TILE  # mask tile edge (word-quantized when packed)
+    engine: str = "activity"  # for check_dtype's packed-tier keying
+
+
+def default_sparse_matrix() -> List[SparseConfig]:
+    return [
+        SparseConfig("activity/none/dense", "none"),
+        SparseConfig("activity/none/packed", "none", packed=True,
+                     size=128, tile=32),
+        SparseConfig("activity/1d", "1d"),
+        SparseConfig("activity/2d", "2d"),
+    ]
+
+
+def _build_mesh(kind: str):
+    import jax
+
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    if kind == "none":
+        return None
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise RuntimeError(
+            f"activity config needs 4 devices, have {len(devices)}"
+        )
+    if kind == "1d":
+        return mesh_mod.make_mesh_1d(4, devices=devices[:4])
+    return mesh_mod.make_mesh_2d((2, 2), devices=devices[:4])
+
+
+def _build(cfg: SparseConfig):
+    """(jitted_fn, arg_specs, mesh) exactly as GolRuntime dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu.models.state import CELL_DTYPE
+    from gol_tpu.sparse import engine as sparse_engine
+    from gol_tpu.sparse import mask as sparse_mask
+
+    mesh = _build_mesh(cfg.mesh)
+    th, tw = sparse_mask.grid_shape(cfg.size, cfg.size, cfg.tile)
+    if mesh is None:
+        fn = (
+            sparse_engine.evolve_gated_packed
+            if cfg.packed
+            else sparse_engine.evolve_gated_dense
+        )
+        board_spec = jax.ShapeDtypeStruct((cfg.size, cfg.size), CELL_DTYPE)
+        mask_spec = jax.ShapeDtypeStruct((th, tw), jnp.bool_)
+        statics = (STEPS, cfg.tile, CAPACITY)
+        return fn, (board_spec, mask_spec), statics, mesh
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import sparse as par_sparse
+
+    fn = par_sparse.compiled_evolve_activity(mesh, STEPS, cfg.tile, CAPACITY)
+    board_spec = jax.ShapeDtypeStruct(
+        (cfg.size, cfg.size),
+        CELL_DTYPE,
+        sharding=mesh_mod.board_sharding(mesh),
+    )
+    mask_spec = jax.ShapeDtypeStruct(
+        (th, tw), jnp.bool_, sharding=par_sparse.mask_sharding(mesh)
+    )
+    return fn, (board_spec, mask_spec), (), mesh
+
+
+def check_activity_purity(jaxpr, cfg: SparseConfig) -> CheckResult:
+    """No host callbacks; collectives only where the mesh form earns
+    them (mask/halo ppermute + counter psum)."""
+    findings: List[Finding] = []
+    collectives = set()
+    for info in walker.iter_eqns(jaxpr):
+        if info.name in IMPURE_PRIMITIVES:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "activity-purity",
+                    f"host-interaction primitive {info.name!r} in the "
+                    f"gated program (path {'/'.join(info.path) or 'top'})"
+                    " — the worklist must gate in-graph, not per-step on "
+                    "host",
+                )
+            )
+        if info.name in COLLECTIVE_PRIMITIVES:
+            collectives.add(info.name)
+    if cfg.mesh == "none" and collectives:
+        findings.append(
+            Finding(
+                ERROR,
+                "activity-purity",
+                f"collectives {sorted(collectives)} in the single-device "
+                "gated program",
+            )
+        )
+    elif cfg.mesh != "none":
+        alien = collectives - ALLOWED_COLLECTIVES
+        if alien:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "activity-purity",
+                    f"unexpected collectives {sorted(alien)}; the sharded "
+                    "activity program earns ppermute (mask/halo ring) and "
+                    "psum (replicated counters) only",
+                )
+            )
+        if "ppermute" not in collectives:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "activity-purity",
+                    "no ppermute in the sharded gated program — the mask/"
+                    "halo exchange is missing; a glider crossing a shard "
+                    "seam would never reactivate the neighbor's tiles",
+                )
+            )
+    if not findings:
+        findings.append(
+            Finding(
+                INFO,
+                "activity-purity",
+                "gated program traced pure"
+                + (
+                    f"; collectives: {sorted(collectives)}"
+                    if collectives
+                    else "; no collectives"
+                ),
+            )
+        )
+    return CheckResult.from_findings("activity-purity", findings)
+
+
+def _glider_board(size: int) -> np.ndarray:
+    from gol_tpu.models import patterns
+
+    # Offset so the glider's path crosses tile AND shard seams early.
+    return patterns.init_sparse_world(
+        "glider", size, size, (size // 2 - 2, size // 2 - 2)
+    )
+
+
+def _run_activity(cfg: SparseConfig, fn, statics, mesh, board_np):
+    import jax
+
+    from gol_tpu.sparse import mask as sparse_mask
+
+    th, tw = sparse_mask.grid_shape(cfg.size, cfg.size, cfg.tile)
+    mask0 = np.ones((th, tw), bool)
+    if mesh is None:
+        return fn(board_np, mask0, *statics)
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import sparse as par_sparse
+
+    board = mesh_mod.shard_board(board_np, mesh)
+    mask = jax.device_put(mask0, par_sparse.mask_sharding(mesh))
+    return fn(board, mask)
+
+
+def check_gated_equivalence(cfg: SparseConfig, fn, statics, mesh) -> CheckResult:
+    """Executed: gated == dense on a translating glider, with skips."""
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import stencil
+    from gol_tpu.sparse import mask as sparse_mask
+
+    findings: List[Finding] = []
+    board_np = _glider_board(cfg.size)
+    ref = np.asarray(stencil.run(jnp.asarray(board_np), STEPS))
+    out, _, act = _run_activity(cfg, fn, statics, mesh, board_np)
+    th, tw = sparse_mask.grid_shape(cfg.size, cfg.size, cfg.tile)
+    tile_gens = th * tw * STEPS
+    computed = int(act["computed_tile_gens"])
+    if not np.array_equal(np.asarray(out), ref):
+        findings.append(
+            Finding(
+                ERROR,
+                "gated-equivalence",
+                f"activity run diverges from the dense reference after "
+                f"{STEPS} generations of a translating glider",
+            )
+        )
+    elif computed >= tile_gens:
+        findings.append(
+            Finding(
+                ERROR,
+                "gated-equivalence",
+                f"activity run computed {computed}/{tile_gens} tile-gens "
+                "— it never skipped anything; the gate is not gating",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "gated-equivalence",
+                f"bit-equal to dense over {STEPS} gens; computed "
+                f"{computed}/{tile_gens} tile-gens "
+                f"({100 * (1 - computed / tile_gens):.0f}% skipped)",
+            )
+        )
+    return CheckResult.from_findings("gated-equivalence", findings)
+
+
+def check_mask_soundness_teeth(cfg: SparseConfig) -> CheckResult:
+    """The deliberately-broken under-dilating step must diverge.
+
+    Runs the single-device gated loop with ``dilate`` replaced by the
+    identity (gate on the raw changed mask): the glider's leading edge
+    writes into tiles the broken gate never activates, so the boards
+    must diverge from the dense oracle within a few generations — the
+    proof that the equivalence check above would actually catch an
+    under-dilated implementation.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gol_tpu.ops import stencil
+    from gol_tpu.sparse import mask as sparse_mask
+
+    findings: List[Finding] = []
+    size = cfg.size
+    board_np = _glider_board(size)
+    # The broken fixture always gates at the default tile — the
+    # soundness witness is about the missing dilation, not the config's
+    # tile geometry.
+
+    def broken_gen(carry):
+        board, changed = carry
+        active = changed  # BROKEN: no dilation — the light cone is cut
+        cellmask = jnp.repeat(
+            jnp.repeat(active, TILE, axis=0), TILE, axis=1
+        )
+        stepped = stencil.step(board)
+        new = jnp.where(cellmask, stepped, board)
+        return new, sparse_mask.changed_tiles_dense(board, new, TILE)
+
+    @jax.jit
+    def run_broken(board, changed):
+        return lax.fori_loop(
+            0, STEPS, lambda _, c: broken_gen(c), (board, changed)
+        )
+
+    # Start from the *true* one-generation changed mask (not all-ones —
+    # all-ones would hide the missing dilation for a while).
+    b1 = stencil.step(jnp.asarray(board_np))
+    changed = sparse_mask.changed_tiles_dense(
+        jnp.asarray(board_np), b1, TILE
+    )
+    broken, _ = run_broken(b1, changed)
+    ref = np.asarray(stencil.run(jnp.array(b1, copy=True), STEPS))
+    if np.array_equal(np.asarray(broken), ref):
+        findings.append(
+            Finding(
+                ERROR,
+                "mask-soundness",
+                "the under-dilating broken fixture matched the dense "
+                "oracle — the soundness property has no witness on this "
+                "board; the equivalence check cannot be trusted to catch "
+                "a missing dilation",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "mask-soundness",
+                "under-dilated gating diverges from the dense oracle "
+                f"within {STEPS} generations, as it must — the dilation "
+                "invariant has teeth",
+            )
+        )
+    return CheckResult.from_findings("mask-soundness", findings)
+
+
+def run_sparse_config(cfg: SparseConfig) -> EngineReport:
+    report = EngineReport(config_name=cfg.name)
+    try:
+        fn, specs, statics, mesh = _build(cfg)
+        jaxpr = walker.trace_jaxpr(
+            fn, *specs, *statics,
+            static_argnums=tuple(
+                range(len(specs), len(specs) + len(statics))
+            ),
+        )
+    except Exception as e:
+        from gol_tpu.analysis.report import FAIL
+
+        report.checks.append(
+            CheckResult("config", FAIL, [
+                Finding(ERROR, "config", f"gated program failed to build: {e}")
+            ])
+        )
+        return report
+    report.checks.append(check_activity_purity(jaxpr, cfg))
+    report.checks.append(check_dtype(jaxpr, cfg))
+    report.checks.append(check_gated_equivalence(cfg, fn, statics, mesh))
+    report.checks.append(check_mask_soundness_teeth(cfg))
+    return report
+
+
+def run_sparse_checks(
+    matrix: Optional[List[SparseConfig]] = None,
+) -> List[EngineReport]:
+    return [run_sparse_config(c) for c in (matrix or default_sparse_matrix())]

@@ -70,8 +70,18 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--halo", choices=["fresh", "stale_t0"], default="fresh")
     ext.add_argument(
         "--engine",
-        choices=["auto", "dense", "bitpack", "pallas", "pallas_bitpack"],
+        choices=[
+            "auto", "dense", "bitpack", "pallas", "pallas_bitpack",
+            "activity",
+        ],
         default="auto",
+    )
+    # Activity-gated tier knobs (docs/SPARSE.md): mask tile edge (0 =
+    # auto-pick) and worklist capacity as a fraction of the per-shard
+    # tile count (overflow generations fall back to one dense step).
+    ext.add_argument("--activity-tile", type=int, default=0, metavar="T")
+    ext.add_argument(
+        "--activity-capacity", type=float, default=0.25, metavar="FRAC"
     )
     ext.add_argument("--mesh", choices=["none", "1d", "2d"], default="none")
     ext.add_argument(
@@ -322,6 +332,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--stats applies to unguarded runs; drop --guard-every "
                 "(the guard's audit already reports population per chunk)"
             )
+        if ns.engine == "activity" and ns.guard_every > 0:
+            raise ValueError(
+                "--guard-every applies to the dense/bitpack/pallas "
+                "tiers; the activity engine runs unguarded (its gated "
+                "step is bit-pinned against the dense tiers)"
+            )
+        if (ns.activity_tile or ns.activity_capacity != 0.25) \
+                and ns.engine != "activity":
+            raise ValueError(
+                "--activity-tile/--activity-capacity configure the "
+                "activity tier; pass --engine activity"
+            )
         if ns.auto_resume and ns.resume:
             raise ValueError(
                 "--auto-resume selects the snapshot itself; pass one of "
@@ -369,9 +391,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--batch shards the world axis (a 1-D ring); use "
                     "--mesh 1d or --mesh none"
                 )
-            if ns.engine == "pallas":
+            if ns.engine in ("pallas", "activity"):
                 raise ValueError(
-                    "engine 'pallas' (dense kernel) has no batched tier; "
+                    f"engine {ns.engine!r} has no batched tier; "
                     "use 'auto'/'dense'/'bitpack'/'pallas_bitpack'"
                 )
             sizes_text = ns.batch_sizes or str(ns.world_size)
@@ -463,6 +485,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             keep_snapshots=ns.keep_snapshots,
             restart_attempt=restart_attempt,
             resume_info=resume_info,
+            activity_tile=ns.activity_tile,
+            activity_capacity=ns.activity_capacity,
         )
         guard_report = None
         with resilience.preemption_guard():

@@ -48,11 +48,28 @@ PATTERN_NAMES = {
     5: "glider",  # south-east glider on rank 0; period-4 (+1,+1) translation
     6: "r_pentomino",  # methuselah centered on the global world
     7: "gosper_gun",  # emits one glider every 30 generations
+    # Sparse-scenario additions (the activity tier's workload class,
+    # docs/SPARSE.md): a fast spaceship and a long-lived methuselah —
+    # tiny live populations in arbitrarily large arenas, exactly the
+    # boards where O(area) dense work is ~100% waste.
+    8: "lwss",  # lightweight spaceship, period 4, speed c/2 eastward
+    9: "acorn",  # 7-cell methuselah, stabilizes after ~5200 generations
 }
 
 #: (row, col) cells of the capability-addition objects, top-left anchored.
 GLIDER_CELLS = ((0, 1), (1, 2), (2, 0), (2, 1), (2, 2))
 R_PENTOMINO_CELLS = ((0, 1), (0, 2), (1, 0), (1, 1), (2, 1))
+LWSS_CELLS = (
+    (0, 0), (0, 3),
+    (1, 4),
+    (2, 0), (2, 4),
+    (3, 1), (3, 2), (3, 3), (3, 4),
+)
+ACORN_CELLS = (
+    (0, 1),
+    (1, 3),
+    (2, 0), (2, 1), (2, 4), (2, 5), (2, 6),
+)
 GOSPER_GUN_CELLS = (
     (0, 24),
     (1, 22), (1, 24),
@@ -108,6 +125,16 @@ def validate_pattern_size(pattern: int, size: int) -> None:
             f"pattern 7 (Gosper gun) needs worldSize >= {GOSPER_GUN_MIN_SIZE}; "
             f"got size={size}"
         )
+    if pattern in (8, 9):
+        need = OBJECT_OFFSET + _object_extent(
+            LWSS_CELLS if pattern == 8 else ACORN_CELLS
+        )[1] + 1
+        if size < need:
+            raise ValueError(
+                f"pattern {pattern} ({PATTERN_NAMES[pattern]}) needs "
+                f"worldSize >= {need} for the object at its anchor plus "
+                f"margin; got size={size}"
+            )
 
 
 def init_local(pattern: int, size: int, rank: int, num_ranks: int) -> np.ndarray:
@@ -159,7 +186,75 @@ def init_local(pattern: int, size: int, rank: int, num_ranks: int) -> np.ndarray
         if rank == 0:
             for r, c in GOSPER_GUN_CELLS:
                 board[OBJECT_OFFSET + r, OBJECT_OFFSET + c] = 1
+    elif pattern in (8, 9):
+        if rank == 0:
+            cells = LWSS_CELLS if pattern == 8 else ACORN_CELLS
+            for r, c in cells:
+                board[OBJECT_OFFSET + r, OBJECT_OFFSET + c] = 1
     return board
+
+
+#: The named sparse-scenario objects (huge-arena seeds for the activity
+#: tier, sparsebench and the seam-crossing tests).  Distinct from the
+#: integer pattern ids: these place at *arbitrary* offsets in arbitrary
+#: (possibly non-square) extents, torus-wrapped.
+SPARSE_OBJECTS = {
+    "glider": GLIDER_CELLS,
+    "lwss": LWSS_CELLS,
+    "r_pentomino": R_PENTOMINO_CELLS,
+    "acorn": ACORN_CELLS,
+    "gosper_gun": GOSPER_GUN_CELLS,
+}
+
+
+def _object_extent(cells) -> tuple:
+    """(height, width) bounding box of a cell list."""
+    return (
+        max(r for r, _ in cells) + 1,
+        max(c for _, c in cells) + 1,
+    )
+
+
+def place_cells(
+    board: np.ndarray, cells, row: int, col: int
+) -> np.ndarray:
+    """Stamp ``cells`` onto ``board`` anchored at ``(row, col)``,
+    wrapping both axes (the torus has no special origin — translation
+    equivariance is a pinned property, so any offset is legal)."""
+    h, w = board.shape
+    for r, c in cells:
+        board[(row + r) % h, (col + c) % w] = 1
+    return board
+
+
+def init_sparse_world(
+    name: str,
+    height: int,
+    width: int,
+    offset=(0, 0),
+) -> np.ndarray:
+    """A named object alone in an arbitrary extent at an arbitrary offset.
+
+    The sparse scenario class: one :data:`SPARSE_OBJECTS` seed (a few
+    live cells) in a ``height × width`` dead arena — gliders/guns/
+    methuselahs at huge extents, where the activity tier's skipped
+    fraction approaches 1.  Offsets may be negative or past the extent
+    (torus wrap), so seeds can be placed straddling shard seams on
+    purpose.
+    """
+    if name not in SPARSE_OBJECTS:
+        raise ValueError(
+            f"unknown sparse object {name!r}; expected one of "
+            f"{sorted(SPARSE_OBJECTS)}"
+        )
+    cells = SPARSE_OBJECTS[name]
+    oh, ow = _object_extent(cells)
+    if height < oh or width < ow:
+        raise ValueError(
+            f"extent {height}x{width} too small for {name!r} ({oh}x{ow})"
+        )
+    board = np.zeros((height, width), dtype=np.uint8)
+    return place_cells(board, cells, int(offset[0]), int(offset[1]))
 
 
 def init_global(pattern: int, size: int, num_ranks: int) -> np.ndarray:

@@ -93,6 +93,36 @@ def _clamp_band(band: int, h: int, w: int) -> int:
     return max(1, min(band, h, w))
 
 
+def flip_planes_dense(prev: jax.Array, new: jax.Array, n=None):
+    """``(flips, born, died)`` uint32 planes of a dense chunk diff.
+
+    The one place the dense flip algebra lives: the ``--stats`` reducers
+    below and the activity tier's changed-tile mask
+    (:func:`gol_tpu.sparse.mask.changed_tiles_dense`) both consume these
+    planes, so the mask is a *byproduct* of the same expressions the
+    stats already emit — not a second, divergent diff pass.  The ops are
+    exactly the pre-refactor inline forms (the stats-on jaxpr identity
+    is pinned by tests/test_sparse.py::test_stats_refactor_jaxpr_identical);
+    ``n`` lets a caller that already widened ``new`` reuse that value so
+    the emitted eqn sequence stays what the inline form produced.
+    """
+    if n is None:
+        n = new.astype(jnp.uint32)
+    flips = (prev ^ new).astype(jnp.uint32)
+    born = flips * n  # changed and now alive
+    died = flips - born
+    return flips, born, died
+
+
+def flip_planes_packed(p: jax.Array, n: jax.Array):
+    """``(born, died)`` word planes of a packed chunk diff (see
+    :func:`flip_planes_dense`; ``changed = born | died``).  ``p``/``n``
+    are :func:`gol_tpu.ops.bitlife.pack`-layout uint32 boards."""
+    born = n & ~p
+    died = p & ~n
+    return born, died
+
+
 def dense_chunk_stats(prev: jax.Array, new: jax.Array, band: int) -> dict:
     """Chunk stats of a dense uint8 0/1 board pair (shard-local).
 
@@ -105,9 +135,7 @@ def dense_chunk_stats(prev: jax.Array, new: jax.Array, band: int) -> dict:
     h, w = new.shape
     band = _clamp_band(band, h, w)
     n = new.astype(jnp.uint32)
-    flips = (prev ^ new).astype(jnp.uint32)
-    born = flips * n  # changed and now alive
-    died = flips - born
+    flips, born, died = flip_planes_dense(prev, new, n)
 
     def rows(x):
         return jnp.sum(x, axis=1, dtype=jnp.uint32)
@@ -155,8 +183,7 @@ def packed_chunk_stats(prev: jax.Array, new: jax.Array, band: int) -> dict:
     band = _clamp_band(band, h, w)
     p = bitlife.pack(prev)
     n = bitlife.pack(new)
-    born = n & ~p
-    died = p & ~n
+    born, died = flip_planes_packed(p, n)
     left_mask, right_mask = _col_band_masks(n.shape[1], band)
 
     def rows(words):

@@ -1,0 +1,168 @@
+"""Sharded activity-gated engine: mask exchange across the mesh ring.
+
+The mesh form of :mod:`gol_tpu.sparse.engine`.  Each shard owns its
+block of the board *and* the matching block of the changed-tile mask
+(same ``P(rows[, cols])`` sharding, one mask cell per tile).  Per
+generation, inside one ``shard_map`` program:
+
+1. **exchange** — the board ships its one-cell halo ring and the mask
+   its one-*tile* halo ring over the same ppermute phases
+   (:func:`gol_tpu.parallel.halo.halo_extend`; on a 1-D mesh the width
+   axis wraps locally).  The mask exchange is the seam-correctness
+   move: a glider leaving shard r's edge tile sets that tile's changed
+   bit, the ppermute delivers it as shard r+1's ghost mask entry, and
+   the dilation activates r+1's edge tiles *before* the glider's cells
+   arrive — no live-region tile on any shard is ever skipped
+   (the analysis activity matrix and the seam-crossing tests pin this).
+2. **gate** — ``dilate_ext`` over the extended mask, then the same
+   static-capacity worklist gather/step/scatter as the single-device
+   engine (capacity is per *shard* here), with the ``lax.cond`` dense
+   fallback stepping the whole extended block.
+3. **byproduct mask** — changed tiles from the step's flip planes.
+
+The activity counters psum to replicated global values (the telemetry
+contract: every rank reports the same number), exactly like
+:mod:`gol_tpu.parallel.stats`.  Wire cost per generation: the board
+halo (unavoidable) plus ``perimeter/tile`` mask bytes — the mask ring
+is ~``tile×`` smaller than the board ring it rides next to.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gol_tpu import compat
+from gol_tpu.ops import stencil
+from gol_tpu.parallel.halo import halo_extend
+from gol_tpu.parallel.mesh import COLS, ROWS
+from gol_tpu.sparse import engine as sparse_engine
+from gol_tpu.sparse import mask as mask_mod
+
+
+def mask_sharding(mesh: Mesh):
+    """The changed-mask sharding: one mask cell per tile, split like the
+    board."""
+    from jax.sharding import NamedSharding
+
+    if COLS in mesh.axis_names:
+        return NamedSharding(mesh, P(ROWS, COLS))
+    return NamedSharding(mesh, P(ROWS, None))
+
+
+def validate_activity_geometry(
+    shape, mesh: Mesh, tile: int
+) -> None:
+    """The activity tile must divide every shard's extents (each shard
+    owns whole tiles, so a mask cell never straddles a seam)."""
+    h, w = shape
+    rows = mesh.shape[ROWS]
+    cols = mesh.shape.get(COLS, 1)
+    if (h // rows) % tile or (w // cols) % tile:
+        raise ValueError(
+            f"activity tile {tile} must divide the shard extents "
+            f"({h // rows}x{w // cols} for board {shape} on mesh "
+            f"{dict(mesh.shape)})"
+        )
+
+
+@functools.lru_cache(maxsize=32)
+def compiled_evolve_activity(
+    mesh: Mesh, steps: int, tile: int, capacity: int
+):
+    """Build + jit the sharded activity evolver for (mesh, steps, tile,
+    capacity).  The jitted call is ``fn(board, changed) -> (board,
+    changed, activity)`` with replicated global activity counters;
+    both inputs are donated (the double buffers).
+    """
+    two_d = COLS in mesh.axis_names
+    num_rows = mesh.shape[ROWS]
+    num_cols = mesh.shape.get(COLS, 1)
+    phases = (
+        ((0, ROWS, num_rows), (1, COLS, num_cols))
+        if two_d
+        else ((0, ROWS, num_rows),)
+    )
+    axes = tuple(mesh.axis_names)
+    spec = P(ROWS, COLS) if two_d else P(ROWS, None)
+
+    def extend(x):
+        ext = halo_extend(x, phases)
+        if not two_d:
+            # Width is unsharded on the 1-D row mesh: the column wrap is
+            # local, exactly as in the single-device engines.
+            ext = jnp.pad(ext, ((0, 0), (1, 1)), mode="wrap")
+        return ext
+
+    def gen(board, changed):
+        board_ext = extend(board)
+        # Collectives carry the mask as bytes (bool is not a wire dtype
+        # everywhere); one tiny convert per side.
+        mask_ext = extend(changed.astype(jnp.uint8)).astype(jnp.bool_)
+        active = mask_mod.dilate_ext(mask_ext)
+        count = jnp.sum(active, dtype=jnp.uint32)
+        fits = count <= jnp.uint32(capacity)
+
+        def worklist(b):
+            coords = jnp.nonzero(active, size=capacity, fill_value=0)
+            return sparse_engine._worklist_pass(
+                board_ext, b, changed.shape, coords, tile, tile,
+                stencil.step_halo_full,
+            )
+
+        def dense_fallback(b):
+            new = stencil.step_halo_full(board_ext)
+            return new, mask_mod.changed_tiles_dense(b, new, tile)
+
+        board, changed = lax.cond(fits, worklist, dense_fallback, board)
+        return board, changed, count, ~fits
+
+    def local(board, changed):
+        zero = jnp.uint32(0)
+        shard_tiles = jnp.uint32(
+            (board.shape[0] // tile) * (board.shape[1] // tile)
+        )
+
+        def body(_, carry):
+            board, changed, agens, cgens, fgens = carry
+            board, changed, count, fell = gen(board, changed)
+            computed = jnp.where(fell, shard_tiles, count)
+            return (
+                board,
+                changed,
+                agens + count,
+                cgens + computed,
+                fgens + fell.astype(jnp.uint32),
+            )
+
+        board, changed, agens, cgens, fgens = lax.fori_loop(
+            0, steps, body, (board, changed, zero, zero, zero)
+        )
+        # Replicated global counters, like gol_tpu.parallel.stats:
+        # active/computed tile-gens sum over shards; fallback counts
+        # shard-gens that overflowed (each shard gates independently).
+        return board, changed, {
+            "active_tile_gens": lax.psum(agens, axes),
+            "computed_tile_gens": lax.psum(cgens, axes),
+            "fallback_gens": lax.psum(fgens, axes),
+        }
+
+    shmapped = compat.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(
+            spec,
+            spec,
+            {
+                "active_tile_gens": P(),
+                "computed_tile_gens": P(),
+                "fallback_gens": P(),
+            },
+        ),
+    )
+    return jax.jit(shmapped, donate_argnums=(0, 1))

@@ -33,7 +33,7 @@ from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils import checkpoint as ckpt_mod
 from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready, maybe_profile
 
-ENGINES = ("auto", "dense", "bitpack", "pallas", "pallas_bitpack")
+ENGINES = ("auto", "dense", "bitpack", "pallas", "pallas_bitpack", "activity")
 MESH_CHOICES = ("none", "1d", "2d")
 
 
@@ -111,6 +111,17 @@ class GolRuntime:
     # resume_info (the dict resilience.resolve_auto_resume returns) is
     # stamped as a v3 `resume` telemetry event by open_event_log.
     resume_info: Optional[dict] = None
+    # Activity-gated tier knobs (--engine activity; gol_tpu/sparse/,
+    # docs/SPARSE.md).  activity_tile is the square tile edge of the
+    # changed mask (0 = auto: the largest candidate dividing the
+    # board/shard); activity_capacity is the worklist size as a fraction
+    # of the (per-shard) tile count — a generation whose dilated active
+    # set exceeds it falls back to one dense step (never wrong, never
+    # worse than O(area)).  The mask itself is NOT checkpointed: resume
+    # reconstructs it as all-active, which is sound and collapses to the
+    # true activity after one generation (bit-identity pinned).
+    activity_tile: int = 0
+    activity_capacity: float = 0.25
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -165,6 +176,8 @@ class GolRuntime:
                     f"{self.shard_mode!r} with engine {self._resolved!r} "
                     "is a Conway-specific program"
                 )
+        if self._resolved == "activity":
+            self._init_activity()
         if self.halo_depth > 1:
             if self.mesh is None:
                 raise ValueError(
@@ -213,12 +226,15 @@ class GolRuntime:
                     "stale_t0 (reference-compat) runs are single-device only; "
                     "its blocks evolve independently so a mesh adds nothing"
                 )
-            if self.engine not in ("auto", "dense", "bitpack", "pallas_bitpack"):
+            if self.engine not in (
+                "auto", "dense", "bitpack", "pallas_bitpack", "activity"
+            ):
                 raise ValueError(
                     f"engine {self.engine!r} has no sharded path; with a "
                     "mesh use 'dense'/'auto' (shard_map+ppermute or "
-                    "auto-SPMD), 'bitpack' (packed shard_map+ppermute), or "
-                    "'pallas_bitpack' (fused kernel per shard)"
+                    "auto-SPMD), 'bitpack' (packed shard_map+ppermute), "
+                    "'pallas_bitpack' (fused kernel per shard), or "
+                    "'activity' (gated worklist per shard)"
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
             if self._resolved == "pallas_bitpack":
@@ -324,6 +340,80 @@ class GolRuntime:
         # Host-int stats of the last run()'s chunks (--stats mode):
         # [{"index", "take", "generation", "population", ...}, ...].
         self.last_stats: list = []
+        # Host-int activity counters of the last run()'s chunks
+        # (--engine activity): [{"index", "take", "generation",
+        # "active_tile_gens", "computed_tile_gens", "fallback_gens",
+        # "skipped_tile_gens", ...}, ...].
+        self.last_activity: list = []
+
+    def _init_activity(self) -> None:
+        """Validate + resolve the activity tier's tile/capacity/repr.
+
+        Sets ``_act_tile`` (mask tile edge), ``_act_packed`` (bit-packed
+        worklist on single-device word-aligned boards), ``_act_grid``
+        (global mask grid shape) and ``_act_capacity_n`` (the per-shard
+        worklist capacity K).  See docs/SPARSE.md.
+        """
+        from gol_tpu.ops import bitlife
+        from gol_tpu.sparse import engine as sparse_engine
+        from gol_tpu.sparse import mask as sparse_mask
+
+        if self.halo_mode != "fresh":
+            raise ValueError(
+                "engine 'activity' implements fresh halos only (the "
+                "stale_t0 compat mode reproduces a reference bug the "
+                "gated tier has no analog for)"
+            )
+        if self.rule is not None and self._rule is not None:
+            raise ValueError(
+                "engine 'activity' runs the B3/S23 fast paths; use "
+                "'dense'/'bitpack' with a custom rule"
+            )
+        if self.halo_depth != 1:
+            raise ValueError(
+                "engine 'activity' exchanges one-tile mask halos per "
+                f"generation; halo_depth must be 1, got {self.halo_depth}"
+            )
+        if self.mesh is not None and self.shard_mode != "explicit":
+            raise ValueError(
+                "the sharded activity engine has the explicit ring "
+                f"program only (got shard_mode {self.shard_mode!r})"
+            )
+        h, w = self.geometry.global_height, self.geometry.global_width
+        if self.activity_tile:
+            tile = self.activity_tile
+            packed = (
+                self.mesh is None
+                and tile % bitlife.BITS == 0
+                and w % bitlife.BITS == 0
+            )
+            sparse_mask.validate_tile(h, w, tile, packed)
+        elif self.mesh is None:
+            try:
+                tile, packed = sparse_mask.pick_tile(h, w, packed=True), True
+            except ValueError:
+                tile, packed = sparse_mask.pick_tile(h, w, packed=False), False
+        else:
+            rows = self.mesh.shape[mesh_mod.ROWS]
+            cols = self.mesh.shape.get(mesh_mod.COLS, 1)
+            tile, packed = sparse_mask.pick_tile(h // rows, w // cols), False
+        if self.mesh is not None:
+            from gol_tpu.parallel import sparse as par_sparse
+
+            par_sparse.validate_activity_geometry((h, w), self.mesh, tile)
+        self._act_tile = tile
+        self._act_packed = packed
+        self._act_grid = sparse_mask.grid_shape(h, w, tile)
+        if self.mesh is not None:
+            rows = self.mesh.shape[mesh_mod.ROWS]
+            cols = self.mesh.shape.get(mesh_mod.COLS, 1)
+            shard_th = h // rows // tile
+            shard_tw = w // cols // tile
+        else:
+            shard_th, shard_tw = self._act_grid
+        self._act_capacity_n = sparse_engine.default_capacity(
+            shard_th, shard_tw, self.activity_capacity
+        )
 
     def _resolve_auto(self) -> str:
         """Pick the fastest engine this run's geometry and mode support.
@@ -450,6 +540,29 @@ class GolRuntime:
         executing a throwaway evolution.
         """
         name = self._resolved
+        if name == "activity":
+            # Activity-gated tier: the chunk program carries the changed
+            # mask — fn(board, changed) -> (board, changed, activity) —
+            # and the run loop threads it between chunks (docs/SPARSE.md).
+            if self.mesh is not None:
+                from gol_tpu.parallel import sparse as par_sparse
+
+                return (
+                    par_sparse.compiled_evolve_activity(
+                        self.mesh, steps, self._act_tile,
+                        self._act_capacity_n,
+                    ),
+                    (),
+                    (),
+                )
+            from gol_tpu.sparse import engine as sparse_engine
+
+            fn = (
+                sparse_engine.evolve_gated_packed
+                if self._act_packed
+                else sparse_engine.evolve_gated_dense
+            )
+            return fn, (), (steps, self._act_tile, self._act_capacity_n)
         if name == "pallas_bitpack" and self.mesh is not None:
             # Fused kernel per shard over the ppermute ring; a custom rule
             # rides the same program via the kernel's generic tail.
@@ -812,6 +925,23 @@ class GolRuntime:
             )
         else:
             spec = jax.ShapeDtypeStruct(board.shape, board.dtype)
+        specs = (spec,)
+        if self._resolved == "activity":
+            # The activity programs additionally take the changed-tile
+            # mask (and return it — the run loop threads it through).
+            import jax.numpy as jnp
+
+            if self.mesh is not None:
+                from gol_tpu.parallel import sparse as par_sparse
+
+                mask_spec = jax.ShapeDtypeStruct(
+                    self._act_grid,
+                    jnp.bool_,
+                    sharding=par_sparse.mask_sharding(self.mesh),
+                )
+            else:
+                mask_spec = jax.ShapeDtypeStruct(self._act_grid, jnp.bool_)
+            specs = (spec, mask_spec)
         evolvers = {}
         for take in set(schedule):
             if self.stats:
@@ -823,7 +953,7 @@ class GolRuntime:
                 fn, dynamic, static = self._evolve_fn(take)
             with telemetry_mod.trace_annotation(f"gol.compile.{take}"):
                 t0 = time_mod.perf_counter()
-                lowered = fn.lower(spec, *dynamic, *static)
+                lowered = fn.lower(*specs, *dynamic, *static)
                 t1 = time_mod.perf_counter()
                 compiled = lowered.compile()
                 t2 = time_mod.perf_counter()
@@ -877,10 +1007,49 @@ class GolRuntime:
             )
         return events
 
+    def _initial_activity_mask(self):
+        """The all-active changed mask (run start AND resume: the mask
+        is never checkpointed — all-ones is a sound superset that
+        collapses to the true activity after one generation)."""
+        import jax.numpy as jnp
+
+        if self.mesh is not None:
+            from gol_tpu.parallel import sparse as par_sparse
+
+            return jax.device_put(
+                np.ones(self._act_grid, bool),
+                par_sparse.mask_sharding(self.mesh),
+            )
+        return jnp.ones(self._act_grid, jnp.bool_)
+
+    def _activity_block(self, take: int, dev_act: dict) -> dict:
+        """One chunk's activity telemetry block (schema v5) from the
+        program's device counters."""
+        th, tw = self._act_grid
+        tiles = th * tw
+        tile_gens = tiles * take
+        active = int(dev_act["active_tile_gens"])
+        computed = int(dev_act["computed_tile_gens"])
+        return {
+            "tile": self._act_tile,
+            "tiles": tiles,
+            "tile_gens": tile_gens,
+            "active_tile_gens": active,
+            "computed_tile_gens": computed,
+            "skipped_tile_gens": tile_gens - computed,
+            "fallback_gens": int(dev_act["fallback_gens"]),
+            "active_fraction": active / tile_gens if tile_gens else 0.0,
+        }
+
     def chunk_utilization(self, take: int, wall_s: float):
         """Roofline fraction of one executed chunk (see telemetry module)."""
         from gol_tpu import telemetry as telemetry_mod
 
+        if self._resolved == "activity":
+            # The flop model predicts dense work; a program that skips
+            # an activity-dependent fraction of it has no honest static
+            # roofline — report none rather than a wrong number.
+            return None
         num_devices = 1 if self.mesh is None else self.mesh.devices.size
         cells = self.geometry.global_height * self.geometry.global_width
         return telemetry_mod.roofline_utilization(
@@ -906,9 +1075,15 @@ class GolRuntime:
 
         sw = Stopwatch()
         self.last_stats = []
+        self.last_activity = []
         with sw.phase("init"):
             state = self.initial_state(pattern, resume)
             board = state.board
+            act_mask = (
+                self._initial_activity_mask()
+                if self._resolved == "activity"
+                else None
+            )
 
         # Chunk schedule: full chunks of `checkpoint_every` plus one tail.
         schedule = self.chunk_schedule(
@@ -940,20 +1115,47 @@ class GolRuntime:
                     for i, take in enumerate(schedule):
                         compiled, dynamic = evolvers[take]
                         dev_stats = None
+                        dev_act = None
                         with telemetry_mod.step_annotation("gol.chunk", i):
                             with sw.phase("total"):
                                 t0 = time_mod.perf_counter()
-                                out = compiled(board, *dynamic)
-                                if self.stats:
-                                    board, dev_stats = out
+                                if act_mask is not None:
+                                    out = compiled(
+                                        board, act_mask, *dynamic
+                                    )
+                                    if self.stats:
+                                        (board, act_mask, dev_act,
+                                         dev_stats) = out
+                                    else:
+                                        board, act_mask, dev_act = out
                                 else:
-                                    board = out
+                                    out = compiled(board, *dynamic)
+                                    if self.stats:
+                                        board, dev_stats = out
+                                    else:
+                                        board = out
                                 force_ready(board)
                                 dt = time_mod.perf_counter() - t0
                         state = GolState.create(
                             board, int(state.generation) + take
                         )
+                        act_block = None
+                        if dev_act is not None:
+                            # Scalar fetch after the timed fence, like
+                            # the stats values below.
+                            act_block = self._activity_block(take, dev_act)
+                            self.last_activity.append(
+                                dict(
+                                    index=i,
+                                    take=take,
+                                    generation=int(state.generation),
+                                    **act_block,
+                                )
+                            )
                         if events is not None:
+                            extra = (
+                                {"activity": act_block} if act_block else {}
+                            )
                             events.chunk_event(
                                 i,
                                 take,
@@ -961,6 +1163,7 @@ class GolRuntime:
                                 dt,
                                 self.geometry.cell_updates(take),
                                 self.chunk_utilization(take, dt),
+                                **extra,
                             )
                         if dev_stats is not None:
                             # The scalar fetch happens after the timed

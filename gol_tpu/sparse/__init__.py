@@ -1,0 +1,31 @@
+"""Activity-gated sparse tier: skip the dead universe.
+
+Every dense tier does O(area) work per generation even when 99% of the
+board is static or dead — and real Life workloads (gliders, guns,
+methuselahs in huge arenas) are exactly that sparse.  This package adds
+the activity-tracking tier (``--engine activity``, docs/SPARSE.md):
+
+- :mod:`gol_tpu.sparse.mask` — the per-tile changed mask lifecycle:
+  changed tiles are a *byproduct* of the step's flip planes (the same
+  :func:`gol_tpu.ops.stats.flip_planes_dense` /
+  :func:`~gol_tpu.ops.stats.flip_planes_packed` expressions the
+  ``--stats`` reducers consume), dilated one tile-neighborhood per
+  generation (the light-cone invariant that makes skipping sound).
+- :mod:`gol_tpu.sparse.engine` — the single-device engines: a compact
+  worklist of active tiles + halos gathered/scattered inside the
+  compiled program (static capacity; `lax.cond` falls back to the dense
+  step when the worklist would overflow, so the tier is never wrong and
+  never worse than O(area)), in dense-jnp and bit-packed forms.
+- :mod:`gol_tpu.sparse.pallas` — the mask-gated grid form: a Pallas TPU
+  kernel whose row-band programs early-out (``pl.when``) on the
+  prefetched band mask.
+
+The sharded form (mask ppermute exchange so a glider crossing a shard
+seam reactivates the neighbor's edge tiles) lives in
+:mod:`gol_tpu.parallel.sparse`; the runtime dispatch in
+:class:`gol_tpu.runtime.GolRuntime`.  Every form is pinned bit-identical
+to the dense tiers (tests/test_sparse.py and the analysis suite's
+activity matrix).
+"""
+
+from gol_tpu.sparse import engine, mask  # noqa: F401

@@ -1,0 +1,295 @@
+"""Single-device activity-gated engines: compact worklists of live tiles.
+
+The structural move (the dataflow-locality framing of the Cerebras and
+Tenstorrent stencil papers in PAPERS.md): work follows the data that
+*changes*, not the allocated array.  Per generation, entirely inside the
+compiled program:
+
+1. ``active = dilate(changed)`` (:mod:`gol_tpu.sparse.mask`).
+2. ``jnp.nonzero(active, size=K)`` builds a **static-capacity worklist**
+   of active tile coordinates (K is fixed at compile time — the JAX
+   answer to dynamic shapes; slack entries are filled with tile (0, 0),
+   whose redundant step is the identity on a quiet tile and whose
+   duplicate scatter writes identical values, so padding is
+   semantically free).
+3. One mod-indexed gather pulls each listed tile *with its one-cell
+   halo* straight from the board (the wrap costs O(K · tile) index
+   arithmetic, never an O(area) pad copy); a vmapped halo-full step
+   (:func:`gol_tpu.ops.stencil.step_halo_full` /
+   :func:`gol_tpu.ops.bitlife.step_packed_halo_full`) advances all K
+   tiles; a loop of ``dynamic_update_slice`` writes the interiors back
+   in place (:func:`_scatter_tiles` — XLA's generic scatter walks
+   elements and costs more than the dense step it replaces).
+4. The new changed mask is scattered from per-tile flip flags — the
+   byproduct of the same flip planes the ``--stats`` reducers use.
+
+If a generation's true active count exceeds K, ``lax.cond`` runs the
+plain dense step for that generation instead (both branches are traced,
+one executes): the tier is **never wrong and never asymptotically worse
+than the dense tier** — overflow costs one dense generation, not
+correctness.  The wall-clock win is the executed branch: at <1% live
+cells the worklist touches O(K · tile²) cells instead of O(H · W).
+
+Two representations, both bit-identical to their dense oracles (pinned
+by tests/test_sparse.py and the analysis activity matrix):
+
+- **dense-jnp** — uint8 cells; the reference form and the oracle for
+  the masking machinery itself;
+- **bitpack** — 32 cells/word (:mod:`gol_tpu.ops.bitlife`); tiles are
+  gathered as word blocks with one ghost word per side, so the gather
+  moves 8× fewer bytes and the per-tile step is the carry-save adder.
+
+The Pallas gated-grid alternative lives in :mod:`gol_tpu.sparse.pallas`;
+the sharded (mesh) form in :mod:`gol_tpu.parallel.sparse`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gol_tpu.ops import bitlife, stencil
+from gol_tpu.sparse import mask as mask_mod
+
+#: Names of the per-chunk activity counters every activity program
+#: returns (uint32 scalars, reset each chunk — a chunk's tile-gens stay
+#: far below 2³² for every geometry the repo runs).  ``active`` is the
+#: dilated mask population per generation; ``computed`` what actually
+#: ran (= active on worklist generations, the full grid on fallback
+#: generations), so ``tiles*gens - computed`` is the honest skip count.
+ACTIVITY_FIELDS = ("active_tile_gens", "computed_tile_gens", "fallback_gens")
+
+
+def default_capacity(th: int, tw: int, fraction: float) -> int:
+    """The worklist capacity K for a mask grid: ``fraction`` of the
+    tiles, at least one, never more than all of them."""
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(
+            f"activity capacity fraction must be in (0, 1], got {fraction}"
+        )
+    return max(1, min(th * tw, int(np.ceil(th * tw * fraction))))
+
+
+def _tile_spans(coords, tile: int, halo: int):
+    """Row/col index planes of each listed tile's haloed window.
+
+    ``coords = (r, c)`` int32[K]; returns ``rows[K, tile+2*halo]`` and
+    ``cols[K, tile+2*halo]`` into an array whose origin is shifted by
+    ``halo`` (the wrap/exchange padding), so no per-index mod is needed.
+    """
+    r, c = coords
+    span = jnp.arange(tile + 2 * halo, dtype=jnp.int32)
+    return r[:, None] * tile + span[None], c[:, None] * tile + span[None]
+
+
+def _scatter_tiles(board, stepped, r, c, tile_h: int, tile_w: int):
+    """Write K stepped tile interiors back at their grid slots.
+
+    A ``fori_loop`` of ``dynamic_update_slice`` writes, NOT one big
+    ``.at[...].set`` scatter: XLA's generic scatter walks elements
+    (measured ~0.4 ms/generation on CPU for a 64-tile worklist — 2× the
+    whole dense step it was supposed to skip), while contiguous DUS
+    windows are memcpy-shaped and update the donated carry in place.
+    Duplicate slots (the worklist's fill padding) rewrite identical
+    values sequentially — deterministic by construction.
+    """
+
+    def write_one(k, b):
+        return lax.dynamic_update_slice(
+            b, stepped[k], (r[k] * tile_h, c[k] * tile_w)
+        )
+
+    return lax.fori_loop(0, r.shape[0], write_one, board)
+
+
+def _worklist_pass_mod(board, changed_shape, coords, tile_h, tile_w, step1):
+    """Gather → step → scatter one worklist of tiles, torus wrap via
+    mod-indexed gathers.
+
+    The single-device form: each listed tile's haloed window is gathered
+    straight from ``board`` with per-tile mod-H/W index planes — the
+    wrap costs O(K · tile) index arithmetic instead of the O(area)
+    wrap-pad copy a padded gather would pay per generation (measured:
+    the pad alone costs as much as the dense step it was supposed to
+    skip; a tile-major blocked layout was measured too and loses to
+    this form end-to-end — its fallback pays two full-board transposes
+    per overflow generation).  ``step1`` maps one haloed tile
+    ``[tile_h+2, tile_w+2]`` to its stepped interior.  Returns
+    ``(new_board, new_changed)``.
+    """
+    h, w = board.shape
+    r, c = coords
+    span = jnp.arange(-1, max(tile_h, tile_w) + 1, dtype=jnp.int32)
+    rows = (r[:, None] * tile_h + span[: tile_h + 2][None]) % h
+    cols = (c[:, None] * tile_w + span[: tile_w + 2][None]) % w
+    tiles = board[rows[:, :, None], cols[:, None, :]]  # [K, th+2, tw+2]
+    stepped = jax.vmap(step1)(tiles)  # [K, tile_h, tile_w]
+    orig = tiles[:, 1:-1, 1:-1]
+    flags = jnp.any(stepped != orig, axis=(1, 2))
+    new_board = _scatter_tiles(board, stepped, r, c, tile_h, tile_w)
+    new_changed = (
+        jnp.zeros(changed_shape, jnp.bool_).at[r, c].set(flags)
+    )
+    return new_board, new_changed
+
+
+def _worklist_pass(ext, board, changed_shape, coords, tile_h, tile_w, step1):
+    """Gather → step → scatter one worklist of tiles.
+
+    ``ext`` is the board padded/halo-extended by one (rows) and one
+    column quantum (cells dense, words packed); ``tile_h``/``tile_w``
+    are the tile extents in ``board``'s own units.  ``step1`` maps one
+    haloed tile ``[tile_h+2, tile_w+2]`` to its stepped interior.
+    Returns ``(new_board, new_changed, flags)``.
+    """
+    r, c = coords
+    span_r = jnp.arange(tile_h + 2, dtype=jnp.int32)
+    span_c = jnp.arange(tile_w + 2, dtype=jnp.int32)
+    rows = r[:, None] * tile_h + span_r[None]
+    cols = c[:, None] * tile_w + span_c[None]
+    tiles = ext[rows[:, :, None], cols[:, None, :]]  # [K, th+2, tw+2]
+    stepped = jax.vmap(step1)(tiles)  # [K, tile_h, tile_w]
+    orig = tiles[:, 1:-1, 1:-1]
+    flags = jnp.any(stepped != orig, axis=(1, 2))
+    new_board = _scatter_tiles(board, stepped, r, c, tile_h, tile_w)
+    new_changed = (
+        jnp.zeros(changed_shape, jnp.bool_).at[r, c].set(flags)
+    )
+    return new_board, new_changed
+
+
+def _gen_dense(board, changed, tile: int, capacity: int):
+    """One activity-gated dense generation.  Returns
+    ``(board, changed, active_count, fell_back)``."""
+    active = mask_mod.dilate(changed)
+    count = jnp.sum(active, dtype=jnp.uint32)
+    fits = count <= jnp.uint32(capacity)
+
+    def worklist(b):
+        coords = jnp.nonzero(active, size=capacity, fill_value=0)
+        return _worklist_pass_mod(
+            b, changed.shape, coords, tile, tile,
+            stencil.step_halo_full,
+        )
+
+    def dense_fallback(b):
+        new = stencil.step(b)
+        return new, mask_mod.changed_tiles_dense(b, new, tile)
+
+    board, changed = lax.cond(fits, worklist, dense_fallback, board)
+    return board, changed, count, ~fits
+
+
+def _gen_packed(packed, changed, tile: int, capacity: int):
+    """One activity-gated packed generation (word-quantized tiles).
+
+    The worklist steps its windows **transposed** — ``[K, words, rows]``
+    via :func:`gol_tpu.ops.bitlife.step_packed_vext_nowrap_t` — because
+    a packed tile is only ``tile/32 + 2`` words wide: in the natural
+    ``[rows, words]`` layout the minor axis is a handful of words and
+    the adder tree runs at a fraction of SIMD width, while transposed
+    the ``tile+2``-long row axis fills the vector lanes (the same
+    narrow-strip argument that motivated the transposed step for the
+    2-D-mesh edge strips).  The gathered ghost *words* make the no-wrap
+    step's edge-bit shrinkage irrelevant: the garbage bits live in the
+    ghost words, which only ever feed carries inward — the interior
+    words are exact, and the ghost rows/words are discarded.
+    """
+    active = mask_mod.dilate(changed)
+    count = jnp.sum(active, dtype=jnp.uint32)
+    fits = count <= jnp.uint32(capacity)
+    tw_words = tile // bitlife.BITS
+
+    def worklist(p):
+        h, nw = p.shape
+        r, c = jnp.nonzero(active, size=capacity, fill_value=0)
+        span_r = jnp.arange(-1, tile + 1, dtype=jnp.int32)
+        span_c = jnp.arange(-1, tw_words + 1, dtype=jnp.int32)
+        rows = (r[:, None] * tile + span_r[None]) % h
+        cols = (c[:, None] * tw_words + span_c[None]) % nw
+        # [K, tww+2, tile+2]: words on the (short) middle axis, rows on
+        # the (long) minor axis.
+        tiles_t = p[rows[:, None, :], cols[:, :, None]]
+        stepped_t = jax.vmap(bitlife.step_packed_vext_nowrap_t)(tiles_t)
+        interior_t = stepped_t[:, 1:-1, :]  # [K, tww, tile]
+        orig_t = tiles_t[:, 1:-1, 1:-1]
+        flags = jnp.any(interior_t != orig_t, axis=(1, 2))
+        stepped = jnp.swapaxes(interior_t, 1, 2)  # [K, tile, tww]
+        new_board = _scatter_tiles(p, stepped, r, c, tile, tw_words)
+        new_changed = (
+            jnp.zeros(changed.shape, jnp.bool_).at[r, c].set(flags)
+        )
+        return new_board, new_changed
+
+    def dense_fallback(p):
+        new = bitlife.step_packed(p)
+        return new, mask_mod.changed_tiles_packed(p, new, tile)
+
+    packed, changed = lax.cond(fits, worklist, dense_fallback, packed)
+    return packed, changed, count, ~fits
+
+
+def _evolve_loop(rep, changed, steps: int, gen):
+    zero = jnp.uint32(0)
+    ntiles = jnp.uint32(changed.shape[0] * changed.shape[1])
+
+    def body(_, carry):
+        rep, changed, agens, cgens, fgens = carry
+        rep, changed, count, fell = gen(rep, changed)
+        computed = jnp.where(fell, ntiles, count)
+        return (
+            rep,
+            changed,
+            agens + count,
+            cgens + computed,
+            fgens + fell.astype(jnp.uint32),
+        )
+
+    rep, changed, agens, cgens, fgens = lax.fori_loop(
+        0, steps, body, (rep, changed, zero, zero, zero)
+    )
+    return rep, changed, {
+        "active_tile_gens": agens,
+        "computed_tile_gens": cgens,
+        "fallback_gens": fgens,
+    }
+
+
+@functools.partial(
+    jax.jit, static_argnums=(2, 3, 4), donate_argnums=(0, 1)
+)
+def evolve_gated_dense(
+    board: jax.Array,
+    changed: jax.Array,
+    steps: int,
+    tile: int,
+    capacity: int,
+):
+    """``steps`` gated generations, dense cells.  Returns
+    ``(board, changed, activity)`` — the activity dict holds the
+    :data:`ACTIVITY_FIELDS` uint32 counters for this chunk."""
+    gen = functools.partial(_gen_dense, tile=tile, capacity=capacity)
+    return _evolve_loop(board, changed, steps, gen)
+
+
+@functools.partial(
+    jax.jit, static_argnums=(2, 3, 4), donate_argnums=(0, 1)
+)
+def evolve_gated_packed(
+    board: jax.Array,
+    changed: jax.Array,
+    steps: int,
+    tile: int,
+    capacity: int,
+):
+    """Dense-in/dense-out packed form: pack once, run the gated word
+    worklist, unpack — the activity twin of
+    :func:`gol_tpu.ops.bitlife.evolve_dense_io`."""
+    packed = bitlife.pack(board)
+    gen = functools.partial(_gen_packed, tile=tile, capacity=capacity)
+    packed, changed, act = _evolve_loop(packed, changed, steps, gen)
+    return bitlife.unpack(packed), changed, act

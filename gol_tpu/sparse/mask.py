@@ -1,0 +1,167 @@
+"""Per-tile activity mask: lifecycle and the dilation invariant.
+
+The board is partitioned into ``tile × tile`` cell tiles; the engine
+carries a boolean **changed mask** ``C[th, tw]`` — tile (i, j) is set iff
+some cell in it flipped during the *last* generation.  One generation of
+the gated step is then:
+
+1. **dilate**: ``A = dilate3x3(C)`` (torus-wrapped one-tile
+   neighborhood).  Life's light cone is one cell per generation, and a
+   tile plus its 8 neighbors covers every cell within ``tile`` cells of
+   a changed cell, so any cell whose 3×3 neighborhood saw a change last
+   generation lives in a tile of ``A``.
+2. **step only A**: cells outside ``A`` had a statically-quiet
+   neighborhood, and a cell whose 3×3 neighborhood did not change
+   between t-1 and t has the same state at t+1 as at t — skipping them
+   is exact, not approximate.
+3. **byproduct mask**: the new ``C`` comes from the same flip planes
+   (:func:`gol_tpu.ops.stats.flip_planes_dense` /
+   :func:`~gol_tpu.ops.stats.flip_planes_packed`) the ``--stats``
+   reducers consume — tiles outside ``A`` are 0 by the invariant, so
+   only stepped tiles need the reduction.
+
+Soundness (no live-region tile ever skipped) is exactly the dilation:
+the analysis suite's activity matrix proves a deliberately-broken
+under-dilating step diverges from the dense oracle on a moving glider
+(``gol_tpu.analysis.sparsecheck``), and the Hypothesis soundness family
+in tests/test_property.py checks the invariant on random soups.
+
+At t=0 (and after any resume — the mask is not checkpointed, it is
+cheaply reconstructed) the mask is **all ones**: a superset of the true
+changed set is always sound, and one generation later it has collapsed
+to the real activity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.ops import bitlife
+from gol_tpu.ops import stats as ops_stats
+
+#: Candidate tile edges for auto-selection, largest first.  Bigger tiles
+#: amortize the gather/scatter indexing and keep the mask grid tiny;
+#: smaller tiles track activity more precisely.  64 is the measured
+#: sweet spot on both backends (see docs/SPARSE.md).
+TILE_CANDIDATES = (64, 32, 16, 8, 4, 2, 1)
+
+
+#: Auto-pick wants at least this many tiles per axis: a coarse mask
+#: grid can't gate — one object plus its 3×3 dilation already covers
+#: most of a 4×4 grid, so every generation would overflow the worklist
+#: (measured on the 256² gun: a 4×4 grid falls back 64/64 generations,
+#: an 8×8 grid skips 83%).
+_MIN_GRID = 8
+
+
+def pick_tile(height: int, width: int, packed: bool = False) -> int:
+    """Largest candidate edge dividing both extents (and, packed, the
+    32-cell word quantum) that still yields a ≥8×8 mask grid; when the
+    board is too small for that, the smallest dividing candidate (the
+    finest grid available)."""
+    divisors = [
+        t
+        for t in TILE_CANDIDATES
+        if height % t == 0
+        and width % t == 0
+        and (not packed or t % bitlife.BITS == 0)
+    ]
+    if not divisors:
+        raise ValueError(
+            f"no activity tile divides board {height}x{width}"
+            + (" at the 32-cell packed quantum" if packed else "")
+        )
+    for t in divisors:  # largest first
+        if height // t >= _MIN_GRID and width // t >= _MIN_GRID:
+            return t
+    return divisors[-1]
+
+
+def validate_tile(height: int, width: int, tile: int, packed: bool) -> None:
+    if tile < 1:
+        raise ValueError(f"activity tile must be >= 1, got {tile}")
+    if height % tile or width % tile:
+        raise ValueError(
+            f"activity tile {tile} must divide the board ({height}x{width})"
+        )
+    if packed and tile % bitlife.BITS:
+        raise ValueError(
+            f"packed activity tiles are word-quantized: tile {tile} must "
+            f"be a multiple of {bitlife.BITS}"
+        )
+
+
+def grid_shape(height: int, width: int, tile: int):
+    """The mask grid ``(th, tw)`` for a board."""
+    return height // tile, width // tile
+
+
+def full_mask(th: int, tw: int) -> jax.Array:
+    """The all-active mask: the sound start/resume state."""
+    return jnp.ones((th, tw), jnp.bool_)
+
+
+def dilate(changed: jax.Array) -> jax.Array:
+    """Torus 3×3 OR — one tile-neighborhood of dilation (separable)."""
+    v = (
+        changed
+        | jnp.roll(changed, 1, axis=0)
+        | jnp.roll(changed, -1, axis=0)
+    )
+    return v | jnp.roll(v, 1, axis=1) | jnp.roll(v, -1, axis=1)
+
+
+def dilate_ext(changed_ext: jax.Array) -> jax.Array:
+    """3×3 OR over a halo-extended mask ``[th+2, tw+2]`` → ``[th, tw]``.
+
+    The sharded form: the one-tile halo ring (delivered by the mask
+    ppermute exchange, or a local wrap pad) carries all periodicity, so
+    a glider crossing a shard seam reactivates the neighbor shard's
+    edge tiles through its ghost mask entries.
+    """
+    v = changed_ext[:-2] | changed_ext[1:-1] | changed_ext[2:]
+    return v[:, :-2] | v[:, 1:-1] | v[:, 2:]
+
+
+def tile_any_dense(plane: jax.Array, tile: int) -> jax.Array:
+    """Per-tile any-nonzero of a cell plane ``[h, w]`` → bool ``[th, tw]``."""
+    h, w = plane.shape
+    return (
+        plane.reshape(h // tile, tile, w // tile, tile)
+        .astype(jnp.bool_)
+        .any(axis=(1, 3))
+    )
+
+
+def tile_any_packed(words: jax.Array, tile: int) -> jax.Array:
+    """Per-tile any-set-bit of a packed word plane ``[h, nw]``.
+
+    Tile width in words is ``tile // 32`` (validated); the reduce tree
+    sees words, 32× fewer elements than the dense form — the packed
+    tiers' native idiom.
+    """
+    h, nw = words.shape
+    tw_words = tile // bitlife.BITS
+    return (
+        words.reshape(h // tile, tile, nw // tw_words, tw_words) != 0
+    ).any(axis=(1, 3))
+
+
+def changed_tiles_dense(prev: jax.Array, new: jax.Array, tile: int) -> jax.Array:
+    """Changed-tile mask as a byproduct of the stats flip planes."""
+    flips, _, _ = ops_stats.flip_planes_dense(prev, new)
+    return tile_any_dense(flips, tile)
+
+
+def changed_tiles_packed(p: jax.Array, n: jax.Array, tile: int) -> jax.Array:
+    """Packed counterpart (``p``/``n`` already in word layout)."""
+    born, died = ops_stats.flip_planes_packed(p, n)
+    return tile_any_packed(born | died, tile)
+
+
+def band_mask(active: jax.Array) -> jax.Array:
+    """Row-band gate for the Pallas gated-grid form: band i is live iff
+    any tile in mask row i is active.  int32 (SMEM scalar-prefetch
+    operands are word-sized)."""
+    return active.any(axis=1).astype(jnp.int32)

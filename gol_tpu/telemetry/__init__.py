@@ -48,18 +48,24 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 4 (this round) adds the batched multi-world fields
+# Version 5 (this round) adds the activity-gated tier fields
+# (docs/SPARSE.md): ``chunk`` events of an ``--engine activity`` run
+# carry an ``activity`` block — ``{tile, tiles, tile_gens,
+# active_tile_gens, computed_tile_gens, skipped_tile_gens,
+# fallback_gens, active_fraction}`` — the skip accounting of the sparse
+# worklist.  Version 4 added the batched multi-world fields
 # (docs/BATCHING.md): ``chunk`` and ``compile`` events may carry a
 # ``batch`` block — ``{bucket: [H, W], B, masked, engine,
 # per_world_updates_per_sec}`` — and a batch run's ``run_header.config``
 # records the bucket layout.  Version 3 added the resilience events —
 # ``preempt``, ``resume``, ``restart`` (docs/RESILIENCE.md); version 2
 # the ``stats`` event type and optional ``memory``/``cost`` blocks on
-# ``compile`` events.  Older streams stay readable: every v1-v3 event
+# ``compile`` events.  Older streams stay readable: every v1-v4 event
 # type and field survives unchanged, so consumers only ever *gain*
-# records (back-compat pinned by the committed v1/v2/v3 fixture tests).
-SCHEMA_VERSION = 4
-SUPPORTED_SCHEMAS = (1, 2, 3, 4)
+# records (back-compat pinned by the committed v1/v2/v3/v4 fixture
+# tests).
+SCHEMA_VERSION = 5
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may

@@ -65,9 +65,11 @@ def build_stats_evolver(rt, steps: int):
     """
     fn, dynamic, static = rt._evolve_fn(steps)
     band = max(1, rt.halo_depth)
+    activity = rt._resolved == "activity"
     local = (
         ops_stats.packed_chunk_stats
         if rt._resolved in _PACKED_TIERS
+        or (activity and getattr(rt, "_act_packed", False))
         else ops_stats.dense_chunk_stats
     )
     if rt.mesh is not None and rt.shard_mode != "auto":
@@ -81,6 +83,20 @@ def build_stats_evolver(rt, steps: int):
         stats_fn = lambda p, n: ops_stats.dense_chunk_stats(p, n, band)
     else:
         stats_fn = lambda p, n: local(p, n, band)
+
+    if activity:
+        # The activity chunk program carries the changed mask and its
+        # counters; stats ride as a fourth output.  The chunk-level
+        # births/deaths diff still compares chunk-start vs chunk-end
+        # boards (the per-generation changed *mask* is tile-granular —
+        # it gates compute, the stats need exact cell counts), but both
+        # consume the same flip planes (ops.stats.flip_planes_*): the
+        # mask is a byproduct of the step, not a second diff pass.
+        def evolve_with_stats(board, changed, *dyn):
+            new, new_changed, act = fn(board, changed, *dyn, *static)
+            return new, new_changed, act, stats_fn(board, new)
+
+        return jax.jit(evolve_with_stats), dynamic
 
     def evolve_with_stats(board, *dyn):
         new = fn(board, *dyn, *static)

@@ -156,6 +156,23 @@ def find_anomalies(run: Run) -> List[str]:
                     "its size class"
                 )
 
+    # Activity engine falling back every generation: the worklist
+    # capacity is too small for this workload's active set, so the run
+    # pays dense compute *plus* the gating overhead (schema v5,
+    # docs/SPARSE.md — raise --activity-capacity or the tile).
+    acts = [c["activity"] for c in chunks if c.get("activity")]
+    if acts:
+        gens = sum(c["take"] for c in chunks if c.get("activity"))
+        fallbacks = sum(a.get("fallback_gens", 0) for a in acts)
+        if gens and fallbacks == gens:
+            flags.append(
+                f"activity fallback storm: all {gens} generations "
+                "overflowed the worklist capacity — the gated tier is "
+                "paying dense compute plus gating overhead; raise "
+                "--activity-capacity or use a dense tier for this "
+                "workload"
+            )
+
     # Utilization cliffs.
     utils = [
         (c["index"], c["roofline_util"])
@@ -422,9 +439,12 @@ def render_run(run: Run, out) -> None:
     chunks = run.records("chunk", rank=rank0)
     if chunks:
         batched = any(c.get("batch") for c in chunks)
+        gated = any(c.get("activity") for c in chunks)
         print(
             "  chunk     gens       gen      wall_s     updates/s  "
-            "roofline" + ("  batch (bucket B eng per-world/s)" if batched else ""),
+            "roofline"
+            + ("  batch (bucket B eng per-world/s)" if batched else "")
+            + ("  activity (active% skipped fallbacks)" if gated else ""),
             file=out,
         )
         for c in chunks:
@@ -433,6 +453,18 @@ def render_run(run: Run, out) -> None:
                 f"{c['wall_s']:>11.4f}  {_fmt_rate(c['updates_per_sec']):>12}"
                 f"  {_fmt_util(c.get('roofline_util')):>8}"
             )
+            a = c.get("activity")
+            if a:
+                # Schema v5 (docs/SPARSE.md): the sparse tier's skip
+                # accounting — what fraction of tile-generations were
+                # active, how many the worklist skipped outright, and
+                # how often it overflowed to the dense fallback.
+                line += (
+                    f"  act {100 * a['active_fraction']:.1f}%"
+                    f" skip {a['skipped_tile_gens']}/{a['tile_gens']}"
+                )
+                if a.get("fallback_gens"):
+                    line += f" fb={a['fallback_gens']}"
             b = c.get("batch")
             if b:
                 # Schema v4 (docs/BATCHING.md): one chunk record per

@@ -341,6 +341,14 @@ def run_guarded(
             "replay consumes the evolvers' donated buffers that stats "
             "mode must keep alive"
         )
+    if getattr(rt, "_resolved", None) == "activity":
+        raise ValueError(
+            "--guard-every applies to the dense/bitpack/pallas tiers: "
+            "the activity engine's chunk programs carry the changed-tile "
+            "mask, which the guard's rollback-replay does not thread; "
+            "run it unguarded (the gated step is bit-pinned against the "
+            "dense tiers)"
+        )
     sw = Stopwatch()
     guard = GuardReport()
     with sw.phase("init"):

@@ -1,0 +1,77 @@
+"""Sparse smoke: the activity tier's two contracts, end to end.
+
+check.sh stage [7/8] (docs/SPARSE.md).  A Gosper-gun run in a 256²
+arena through the real runtime dispatch must be (1) bit-identical to
+the dense bitpack tier — the gate may only skip work, never change it —
+and (2) actually *skip* a majority of tile-generations, with the
+telemetry stream carrying the schema-v5 activity blocks that say so.
+A smoke that only checked equality would pass for an engine that gates
+nothing; one that only checked skipping would pass for an engine that
+skips wrongly.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    kw = dict(geometry=Geometry(size=256, num_ranks=1))
+    _, ref = GolRuntime(**kw, engine="bitpack").run(pattern=7, iterations=64)
+
+    with tempfile.TemporaryDirectory() as tdir:
+        rt = GolRuntime(
+            **kw,
+            engine="activity",
+            telemetry_dir=tdir,
+            run_id="sparsesmoke",
+        )
+        _, got = rt.run(pattern=7, iterations=64)
+
+        if not np.array_equal(np.asarray(ref.board), np.asarray(got.board)):
+            print("FAIL: activity run diverges from the dense bitpack tier")
+            return 1
+
+        skipped = sum(a["skipped_tile_gens"] for a in rt.last_activity)
+        tile_gens = sum(a["tile_gens"] for a in rt.last_activity)
+        if skipped <= 0:
+            print("FAIL: activity run skipped zero tile-generations")
+            return 1
+
+        recs = [
+            json.loads(ln)
+            for ln in open(
+                pathlib.Path(tdir) / "sparsesmoke.rank0.jsonl"
+            )
+        ]
+        chunks = [r for r in recs if r["event"] == "chunk"]
+        if not chunks or any("activity" not in c for c in chunks):
+            print("FAIL: chunk events missing the v5 activity block")
+            return 1
+
+    print(
+        f"sparse smoke OK: gun bit-equal to bitpack, skipped "
+        f"{skipped}/{tile_gens} tile-gens "
+        f"({100 * skipped / tile_gens:.0f}%), tile "
+        f"{rt.last_activity[0]['tile']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -237,7 +237,9 @@ def _read_events(path):
 def test_batch_telemetry_v4_events(tmp_path):
     from gol_tpu import telemetry
 
-    assert telemetry.SCHEMA_VERSION == 4
+    # v4 introduced the batch fields; the current schema (v5 at this
+    # round) keeps them additive-forever.
+    assert telemetry.SCHEMA_VERSION >= 4
     worlds = _worlds([(64, 64), (48, 32), (64, 64)])
     brt = GolBatchRuntime(
         worlds=[w.copy() for w in worlds],
@@ -250,7 +252,7 @@ def test_batch_telemetry_v4_events(tmp_path):
     report, _ = brt.run(8)
     recs = _read_events(tmp_path / "tl" / "b4.rank0.jsonl")
     head = recs[0]
-    assert head["schema"] == 4
+    assert head["schema"] == telemetry.SCHEMA_VERSION
     assert head["config"]["driver"] == "batch"
     assert head["config"]["buckets"][0]["B"] == 3
     compiles = [r for r in recs if r["event"] == "compile"]

@@ -34,7 +34,8 @@ def test_atoi_semantics():
 
 
 def test_unknown_pattern_rejected(capsys, tmp_path):
-    rc = run_cli(["9", "32", "1", "64", "0"], tmp_path)
+    # 10 is the first unassigned id (8/9 became the sparse-zoo seeds).
+    rc = run_cli(["10", "32", "1", "64", "0"], tmp_path)
     assert rc == 255
     assert "not been implemented" in capsys.readouterr().out
 
@@ -128,7 +129,7 @@ def test_rank_files_created_at_startup_before_validation(capsys, tmp_path):
     stale dump from an earlier run is truncated at startup."""
     stale = tmp_path / "Rank_0_of_1.txt"
     stale.write_bytes(b"stale dump from an earlier run\n")
-    rc = run_cli(["9", "32", "1", "64", "1"], tmp_path)  # unknown pattern
+    rc = run_cli(["10", "32", "1", "64", "1"], tmp_path)  # unknown pattern
     assert rc == 255
     assert "not been implemented" in capsys.readouterr().out
     assert stale.exists() and stale.read_bytes() == b""  # created+truncated

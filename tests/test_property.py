@@ -437,3 +437,73 @@ def test_masked_dense_step_matches_oracle_any_geometry(seed, h, w, n):
     pad = got.copy()
     pad[:h, :w] = 0
     assert not pad.any()
+
+
+# -- activity-gated tier families (gol_tpu/sparse, docs/SPARSE.md) -----------
+
+activity_dims = st.sampled_from([16, 24, 32, 48])
+activity_caps = st.integers(1, 16)
+
+
+@given(h=activity_dims, w=activity_dims, seed=seeds, n=steps,
+       cap=activity_caps)
+@settings(**_SETTINGS)
+def test_activity_gated_matches_oracle_random_soups(h, w, seed, n, cap):
+    """The gated worklist — any capacity, overflow fallback included —
+    equals the oracle on random soups of any density."""
+    from gol_tpu.sparse import engine as sparse_engine
+    from gol_tpu.sparse import mask as sparse_mask
+
+    board = _board(h, w, seed)
+    th, tw = sparse_mask.grid_shape(h, w, 8)
+    out, _, _ = sparse_engine.evolve_gated_dense(
+        jnp.asarray(board), sparse_mask.full_mask(th, tw), n, 8, cap
+    )
+    np.testing.assert_array_equal(np.asarray(out), oracle.run_torus(board, n))
+
+
+@given(h=activity_dims, w=activity_dims, seed=seeds)
+@settings(**_SETTINGS)
+def test_activity_mask_soundness_invariant(h, w, seed):
+    """No live-region tile is ever outside the dilated mask: the tiles
+    that change in generation t+1 are a subset of dilate(tiles that
+    changed in generation t) — the invariant that makes skipping exact
+    rather than approximate."""
+    from gol_tpu.sparse import mask as sparse_mask
+
+    b0 = jnp.asarray(_board(h, w, seed))
+    b1 = stencil.step(b0)
+    b2 = stencil.step(b1)
+    changed01 = np.asarray(sparse_mask.changed_tiles_dense(b0, b1, 8))
+    changed12 = np.asarray(sparse_mask.changed_tiles_dense(b1, b2, 8))
+    allowed = np.asarray(sparse_mask.dilate(jnp.asarray(changed01)))
+    assert not (changed12 & ~allowed).any(), (
+        "a tile changed outside the dilated active set — the light-cone "
+        "invariant is broken"
+    )
+
+
+@given(
+    dy=st.integers(0, 63),
+    dx=st.integers(0, 63),
+    n=st.integers(1, 48),
+)
+@settings(max_examples=15, deadline=None)
+def test_activity_sharded_glider_any_offset(dy, dx, n):
+    """A glider at ANY torus offset — wrapping edges, straddling shard
+    seams — evolves bit-identically under the sharded activity engine
+    (the compiled program is cached across examples; only data varies)."""
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import sparse as par_sparse
+    from gol_tpu.models import patterns
+
+    mesh = mesh_mod.make_mesh_1d(4)
+    board0 = patterns.init_sparse_world("glider", 64, 64, (dy, dx))
+    ref = oracle.run_torus(board0, n)
+    fn = par_sparse.compiled_evolve_activity(mesh, n, 8, 24)
+    board = mesh_mod.shard_board(jnp.asarray(board0), mesh)
+    mask = jax.device_put(
+        np.ones((8, 8), bool), par_sparse.mask_sharding(mesh)
+    )
+    out, _, _ = fn(board, mask)
+    np.testing.assert_array_equal(np.asarray(out), ref)

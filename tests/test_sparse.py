@@ -1,0 +1,409 @@
+"""Activity-gated sparse tier (``--engine activity``, docs/SPARSE.md).
+
+The acceptance pins:
+
+- **bit-identity**: activity runs equal the dense bitpack tier's final
+  grid for every form (dense-jnp / packed worklist, Pallas gated grid)
+  × mesh none/1d/2d × the sparse pattern zoo (glider, gun, LWSS,
+  acorn) — the gate may only skip work, never change it;
+- **soundness machinery**: the worklist-overflow ``lax.cond`` fallback
+  is exercised and still bit-exact; the mask is reconstructed (all
+  ones) on resume and the resumed run matches an uninterrupted one;
+- **it actually skips**: sparse scenarios report skipped_tile_gens > 0
+  (the whole point of the tier);
+- **stats refactor**: the flip-plane helpers emit byte-identical jaxprs
+  to the pre-refactor inline forms, and --stats + --engine activity
+  agree with the NumPy model;
+- **mode hygiene**: clean rejections for stale_t0 / custom rules /
+  halo_depth / non-explicit shard modes / the guard / --batch.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.models import patterns
+from gol_tpu.models.state import Geometry
+from gol_tpu.ops import stencil
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.sparse import engine as sparse_engine
+from gol_tpu.sparse import mask as sparse_mask
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _mesh(kind):
+    if kind == "none":
+        return None
+    if kind == "1d":
+        return mesh_mod.make_mesh_1d(4)
+    return mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+
+
+# -- bit-identity: form × mesh × sparse pattern zoo --------------------------
+
+
+@pytest.mark.parametrize("pattern", [5, 7, 8, 9])
+@pytest.mark.parametrize(
+    "mesh_kind,tile",
+    [
+        ("none", 16),  # dense-jnp worklist (16 is not word-aligned)
+        ("none", 32),  # packed worklist
+        ("1d", 8),
+        ("2d", 16),
+    ],
+)
+def test_activity_bit_equal_to_dense_bitpack(pattern, mesh_kind, tile):
+    kw = dict(geometry=Geometry(size=128, num_ranks=1))
+    _, ref = GolRuntime(**kw, engine="bitpack").run(
+        pattern=pattern, iterations=48
+    )
+    rt = GolRuntime(
+        **kw,
+        engine="activity",
+        mesh=_mesh(mesh_kind),
+        activity_tile=tile,
+    )
+    _, got = rt.run(pattern=pattern, iterations=48)
+    np.testing.assert_array_equal(
+        np.asarray(ref.board), np.asarray(got.board)
+    )
+    assert rt._act_packed == (mesh_kind == "none" and tile % 32 == 0)
+    assert rt.last_activity, "activity run recorded no counters"
+
+
+def test_activity_sparse_scenarios_actually_skip():
+    """Gun in a 256² arena: most tile-generations must be skipped."""
+    kw = dict(geometry=Geometry(size=256, num_ranks=1))
+    _, ref = GolRuntime(**kw, engine="bitpack").run(pattern=7, iterations=64)
+    rt = GolRuntime(**kw, engine="activity")
+    _, got = rt.run(pattern=7, iterations=64)
+    np.testing.assert_array_equal(
+        np.asarray(ref.board), np.asarray(got.board)
+    )
+    skipped = sum(a["skipped_tile_gens"] for a in rt.last_activity)
+    tile_gens = sum(a["tile_gens"] for a in rt.last_activity)
+    assert skipped > 0, "sparse scenario skipped nothing"
+    assert skipped > tile_gens // 2, (
+        f"only {skipped}/{tile_gens} skipped on a mostly-dead arena"
+    )
+    # Generation 0 may fall back (the all-ones start mask dilates to
+    # everything — sound by construction); after that, never.
+    assert sum(a["fallback_gens"] for a in rt.last_activity) <= 1
+
+
+def test_activity_overflow_falls_back_and_stays_exact():
+    """A dense soup overflows any small worklist: the cond must take
+    the dense branch (recorded) and the result must still be exact."""
+    rng = np.random.default_rng(7)
+    soup = jnp.asarray((rng.random((64, 64)) < 0.35).astype(np.uint8))
+    ref = np.asarray(stencil.run(jnp.array(soup, copy=True), 12))
+    th, tw = sparse_mask.grid_shape(64, 64, 8)
+    out, _, act = sparse_engine.evolve_gated_dense(
+        jnp.array(soup, copy=True), sparse_mask.full_mask(th, tw), 12, 8, 4
+    )
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert int(act["fallback_gens"]) > 0
+    # Fallback generations compute the full grid — the honest counter.
+    assert int(act["computed_tile_gens"]) >= int(
+        act["fallback_gens"]
+    ) * th * tw
+
+
+def test_activity_seam_crossing_glider_1d():
+    """A glider seeded right at a shard seam (and wrapping the torus)
+    must reactivate the neighbor shard's tiles through the mask
+    exchange — bit-equality over a transit across the whole board."""
+    from gol_tpu.parallel import sparse as par_sparse
+
+    mesh = _mesh("1d")
+    # Shard height 16 on a 64² board; seed straddling the rank-0/rank-1
+    # seam AND the torus wrap in columns.
+    board0 = patterns.init_sparse_world("glider", 64, 64, (14, 62))
+    ref = np.asarray(stencil.run(jnp.asarray(board0), 96))
+    fn = par_sparse.compiled_evolve_activity(mesh, 96, 8, 24)
+    board = mesh_mod.shard_board(jnp.asarray(board0), mesh)
+    mask = jax.device_put(
+        np.ones((8, 8), bool), par_sparse.mask_sharding(mesh)
+    )
+    out, _, act = fn(board, mask)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert int(act["computed_tile_gens"]) < 8 * 8 * 96
+
+
+def test_activity_resume_reconstructs_mask(tmp_path):
+    """Kill at gen 16, resume to 48: the mask restarts all-active and
+    the final grid is byte-identical to the uninterrupted run."""
+    kw = dict(geometry=Geometry(size=128, num_ranks=1))
+    _, ref = GolRuntime(**kw, engine="activity").run(
+        pattern=7, iterations=48
+    )
+    d = str(tmp_path / "ck")
+    GolRuntime(
+        **kw, engine="activity", checkpoint_every=16, checkpoint_dir=d
+    ).run(pattern=7, iterations=16)
+    import os
+
+    ck = os.path.join(d, sorted(os.listdir(d))[-1])
+    _, resumed = GolRuntime(**kw, engine="activity").run(
+        pattern=7, iterations=32, resume=ck
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.board), np.asarray(resumed.board)
+    )
+
+
+# -- Pallas gated grid (interpret mode off-TPU) ------------------------------
+
+
+def test_pallas_gated_grid_bit_equal_and_gates():
+    from gol_tpu.sparse import pallas as sparse_pallas
+
+    board0 = patterns.init_sparse_world("gosper_gun", 128, 128, (40, 8))
+    ref = np.asarray(stencil.run(jnp.asarray(board0), 30))
+    out, _, act = sparse_pallas.evolve_gated_pallas(
+        jnp.asarray(board0), sparse_mask.full_mask(4, 4), 30, 32
+    )
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    # Band gating: some bands were off for some generations.
+    assert int(act["computed_tile_gens"]) < 4 * 4 * 30
+    assert int(act["fallback_gens"]) == 0
+
+
+def test_pallas_gated_grid_rejects_bad_tile():
+    from gol_tpu.sparse import pallas as sparse_pallas
+
+    with pytest.raises(ValueError, match="multiple of 32"):
+        sparse_pallas.evolve_gated_pallas(
+            jnp.zeros((64, 64), jnp.uint8),
+            sparse_mask.full_mask(4, 4),
+            4,
+            16,
+        )
+
+
+# -- stats refactor satellite ------------------------------------------------
+
+
+def test_stats_refactor_jaxpr_identical():
+    """The flip-plane helpers must emit byte-for-byte the jaxpr of the
+    pre-refactor inline forms — the trace-identity pin extended to the
+    ops/stats refactor."""
+    from gol_tpu.ops import stats as ops_stats
+
+    def inline_dense(prev, new, band):
+        h, w = new.shape
+        band = max(1, min(band, h, w))
+        n = new.astype(jnp.uint32)
+        flips = (prev ^ new).astype(jnp.uint32)
+        born = flips * n
+        died = flips - born
+
+        def rows(x):
+            return jnp.sum(x, axis=1, dtype=jnp.uint32)
+
+        return {
+            "population": ops_stats.sum_pair(rows(n)),
+            "births": ops_stats.sum_pair(rows(born)),
+            "deaths": ops_stats.sum_pair(rows(died)),
+            "changed": ops_stats.sum_pair(rows(flips)),
+            "face_top": ops_stats.sum_pair(rows(n[:band])),
+            "face_bottom": ops_stats.sum_pair(rows(n[-band:])),
+            "face_left": ops_stats.sum_pair(rows(n[:, :band])),
+            "face_right": ops_stats.sum_pair(rows(n[:, -band:])),
+        }
+
+    spec = jax.ShapeDtypeStruct((64, 64), jnp.uint8)
+    got = jax.make_jaxpr(
+        lambda p, n: ops_stats.dense_chunk_stats(p, n, 1)
+    )(spec, spec)
+    want = jax.make_jaxpr(lambda p, n: inline_dense(p, n, 1))(spec, spec)
+    assert str(got) == str(want)
+
+
+def test_stats_with_activity_engine_matches_numpy_model(tmp_path):
+    from tests.test_stats import _np_chunk_stats
+
+    geom = Geometry(size=128, num_ranks=1)
+    rt = GolRuntime(
+        geometry=geom,
+        engine="activity",
+        stats=True,
+        telemetry_dir=str(tmp_path),
+        run_id="actstats",
+    )
+    _, state = rt.run(pattern=7, iterations=24)
+    board0 = patterns.init_global(7, 128, 1)
+    expected = _np_chunk_stats(board0, np.asarray(state.board))
+    (chunk_stats,) = rt.last_stats
+    assert {k: chunk_stats[k] for k in expected} == expected
+    # The same run also produced activity counters.
+    assert rt.last_activity and rt.last_activity[0]["tile_gens"] > 0
+
+
+def test_activity_knobs_leave_other_tiers_traced_identically():
+    """The new runtime fields must not perturb non-activity programs —
+    the PR 2 trace-identity discipline extended to this round's knobs."""
+    geom = Geometry(size=64, num_ranks=1)
+    a = GolRuntime(geometry=geom, engine="bitpack")
+    b = GolRuntime(
+        geometry=geom, engine="bitpack",
+        activity_tile=16, activity_capacity=0.5,
+    )
+    fa, da, sa = a._evolve_fn(8)
+    fb, db, sb = b._evolve_fn(8)
+    spec = jax.ShapeDtypeStruct((64, 64), jnp.uint8)
+    assert str(fa.trace(spec, *da, *sa).jaxpr) == str(
+        fb.trace(spec, *db, *sb).jaxpr
+    )
+
+
+# -- telemetry / CLI ---------------------------------------------------------
+
+
+def test_cli_activity_end_to_end_with_telemetry(tmp_path, capsys):
+    from gol_tpu import cli
+    from gol_tpu.telemetry import summarize as summ_mod
+
+    d = tmp_path / "t"
+    rc = cli.main(
+        ["7", "128", "24", "512", "0", "--engine", "activity",
+         "--telemetry", str(d), "--run-id", "cliact"]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    recs = [json.loads(ln) for ln in open(d / "cliact.rank0.jsonl")]
+    assert recs[0]["schema"] == 5
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert chunks and all("activity" in c for c in chunks)
+    blk = chunks[0]["activity"]
+    assert blk["tile_gens"] == blk["computed_tile_gens"] + blk[
+        "skipped_tile_gens"
+    ]
+    # The activity tier has no honest static roofline — None, not a lie.
+    assert all(c["roofline_util"] is None for c in chunks)
+    assert summ_mod.main(["summarize", str(d)]) == 0
+    assert "act " in capsys.readouterr().out
+
+
+def test_cli_activity_flag_validation(capsys):
+    from gol_tpu import cli
+
+    assert (
+        cli.main(["0", "64", "8", "512", "0", "--activity-tile", "16"])
+        == 255
+    )
+    assert "--engine activity" in capsys.readouterr().out
+    assert (
+        cli.main(
+            ["0", "64", "8", "512", "0", "--engine", "activity",
+             "--guard-every", "4"]
+        )
+        == 255
+    )
+    assert "unguarded" in capsys.readouterr().out
+    assert (
+        cli.main(
+            ["0", "64", "8", "512", "0", "--engine", "activity",
+             "--batch", "2"]
+        )
+        == 255
+    )
+    assert "no batched tier" in capsys.readouterr().out
+
+
+# -- mode hygiene ------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kw,msg",
+    [
+        (dict(halo_mode="stale_t0"), "fresh halos only"),
+        (dict(rule="B36/S23"), "B3/S23 fast paths"),
+        (dict(halo_depth=2), "halo_depth must be 1"),
+        (dict(activity_tile=24), "must divide"),
+        (dict(activity_tile=-3), ">= 1"),
+        (dict(activity_capacity=0.0), "capacity fraction"),
+    ],
+)
+def test_activity_runtime_rejections(kw, msg):
+    with pytest.raises(ValueError, match=msg):
+        GolRuntime(
+            geometry=Geometry(size=64, num_ranks=1),
+            engine="activity",
+            **kw,
+        )
+
+
+def test_activity_sharded_rejections():
+    with pytest.raises(ValueError, match="explicit ring program only"):
+        GolRuntime(
+            geometry=Geometry(size=64, num_ranks=1),
+            engine="activity",
+            mesh=_mesh("1d"),
+            shard_mode="overlap",
+        )
+    # The tile must divide the *shard*, not just the board.
+    with pytest.raises(ValueError, match="shard extents"):
+        GolRuntime(
+            geometry=Geometry(size=64, num_ranks=1),
+            engine="activity",
+            mesh=_mesh("1d"),
+            activity_tile=32,  # shard height is 16
+        )
+
+
+def test_guard_rejects_activity_runtime():
+    from gol_tpu.utils import guard as guard_mod
+
+    rt = GolRuntime(geometry=Geometry(size=64, num_ranks=1), engine="activity")
+    with pytest.raises(ValueError, match="unguarded"):
+        guard_mod.run_guarded(
+            rt, pattern=4, iterations=8,
+            config=guard_mod.GuardConfig(check_every=4),
+        )
+
+
+# -- mask unit properties ----------------------------------------------------
+
+
+def test_dilate_wraps_the_torus():
+    m = np.zeros((5, 7), bool)
+    m[0, 0] = True
+    got = np.asarray(sparse_mask.dilate(jnp.asarray(m)))
+    expect = {(0, 0), (0, 1), (1, 0), (1, 1), (4, 0), (4, 1), (0, 6),
+              (1, 6), (4, 6)}
+    assert {tuple(i) for i in np.argwhere(got)} == expect
+
+
+def test_changed_tiles_dense_packed_agree():
+    rng = np.random.default_rng(3)
+    a = (rng.random((64, 64)) < 0.3).astype(np.uint8)
+    b = np.asarray(stencil.step(jnp.asarray(a)))
+    from gol_tpu.ops import bitlife
+
+    dense = np.asarray(
+        sparse_mask.changed_tiles_dense(jnp.asarray(a), jnp.asarray(b), 32)
+    )
+    packed = np.asarray(
+        sparse_mask.changed_tiles_packed(
+            bitlife.pack(jnp.asarray(a)), bitlife.pack(jnp.asarray(b)), 32
+        )
+    )
+    np.testing.assert_array_equal(dense, packed)
+
+
+def test_pick_tile_prefers_gating_granularity():
+    assert sparse_mask.pick_tile(1024, 1024) == 64
+    assert sparse_mask.pick_tile(128, 128) == 16  # 8x8 grid beats 2x2
+    assert sparse_mask.pick_tile(256, 256, packed=True) == 32
+    assert sparse_mask.pick_tile(64, 64, packed=True) == 32  # finest
+    with pytest.raises(ValueError, match="no activity tile"):
+        sparse_mask.pick_tile(7, 64, packed=True)

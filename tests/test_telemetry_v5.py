@@ -1,0 +1,126 @@
+"""Schema v5 (activity-gated tier fields) + v1–v4 back-compat.
+
+Companion to tests/test_telemetry.py (v1), test_telemetry_v2.py,
+test_telemetry_v3.py and test_telemetry_v4.py.  Here:
+
+- the v5 additions round-trip: the ``activity`` block on ``chunk``
+  events (tile geometry, active/computed/skipped tile-generations,
+  fallback count, active fraction — docs/SPARSE.md);
+- **back-compat**: ALL FOUR committed fixtures — PR 2 (v1), PR 3 (v2),
+  PR 5 (v3) and PR 6 (v4) — still load, and a directory holding
+  v1 + v2 + v3 + v4 + a freshly-written v5 stream merges and renders in
+  one ``summarize`` pass (exit 0), while a bogus schema still exits 2;
+- the activity fallback-storm anomaly flags a run whose every
+  generation overflowed the worklist, and stays quiet otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+}
+
+ACTIVITY_BLOCK = {
+    "tile": 64,
+    "tiles": 256,
+    "tile_gens": 2048,
+    "active_tile_gens": 180,
+    "computed_tile_gens": 180,
+    "skipped_tile_gens": 1868,
+    "fallback_gens": 0,
+    "active_fraction": 180 / 2048,
+}
+
+
+def _v5_stream(directory, run_id="v5", fallback_storm=False):
+    block = dict(ACTIVITY_BLOCK)
+    if fallback_storm:
+        block.update(
+            fallback_gens=8,
+            computed_tile_gens=2048,
+            skipped_tile_gens=0,
+        )
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "2d", "engine": "activity", "resolved_engine":
+             "activity", "height": 1024, "width": 1024}
+        )
+        ev.compile_event(8, 0.01, 0.11)
+        ev.chunk_event(0, 8, 8, 0.002, 8388608, None, activity=dict(block))
+        return ev.path
+
+
+def test_v5_activity_fields_roundtrip(tmp_path):
+    path = _v5_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION == 5
+    assert set(telemetry.SUPPORTED_SCHEMAS) == {1, 2, 3, 4, 5}
+    chunk = recs[2]
+    assert chunk["activity"]["tile"] == 64
+    assert chunk["activity"]["skipped_tile_gens"] == 1868
+    assert (
+        chunk["activity"]["tile_gens"]
+        == chunk["activity"]["computed_tile_gens"]
+        + chunk["activity"]["skipped_tile_gens"]
+    )
+
+
+def test_committed_fixture_schemas_are_v1_to_v4():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v1_to_v5_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v5_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    # One run section per fixture + the fresh v5 stream.
+    for run_id in ("pr2run", "pr3run", "pr5run", "pr6run", "v5"):
+        assert run_id in out
+    # The v5 stream is newest, so its chunk table (with the activity
+    # column) is the one rendered in detail.
+    assert "act 8.8% skip 1868/2048" in out
+
+
+def test_bogus_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": 99, "run_id": "bad",
+             "process_index": 0, "process_count": 1, "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+
+
+def test_fallback_storm_anomaly(tmp_path, capsys):
+    _v5_stream(tmp_path, run_id="storm", fallback_storm=True)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "activity fallback storm" in out
+
+
+def test_quiet_run_has_no_fallback_storm_flag(tmp_path, capsys):
+    _v5_stream(tmp_path, run_id="quiet")
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    assert "fallback storm" not in capsys.readouterr().out
