@@ -1,10 +1,11 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
-# resilience drill + batch smoke + sparse smoke + tier-1 tests
-# (see scripts/check.sh).
+# resilience drill + batch smoke + sparse smoke + obs smoke + tier-1
+# tests (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
-	resilience-drill batch-smoke batchbench sparse-smoke sparsebench
+	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
+	obs-smoke ledger-check
 
 lint:
 	bash scripts/lint.sh
@@ -61,6 +62,18 @@ sparse-smoke:
 # --size 65536 --iters 256).
 sparsebench:
 	python benchmarks/sparsebench.py --tile 128 --capacity 0.125 --round 7
+
+# Continuous-observability smoke (docs/OBSERVABILITY.md): live run with
+# --metrics-port scraped mid-run + reconciled with the JSONL, v6 spans
+# on every chunk, summarize's span table, and the ledger gate.
+obs-smoke:
+	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# The cross-run perf regression gate alone: newest record per config
+# fingerprint vs the best on the committed PERF_LEDGER.jsonl.
+ledger-check:
+	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry ledger check \
+	    --ledger PERF_LEDGER.jsonl
 
 check:
 	bash scripts/check.sh

@@ -119,7 +119,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         row["per_world_speedup_vs_sequential"] = (
             row["B"] * base / (base_b * row["wall_s"])
         )
+    from gol_tpu.telemetry import ledger as ledger_mod
+
     payload = dict(
+        # Common artifact header (docs/OBSERVABILITY.md): the perf
+        # ledger routes ingestion by header.tool, no filename sniffing.
+        header=ledger_mod.artifact_header("batchbench"),
         note=(
             "batched multi-world amortization curve (docs/BATCHING.md). "
             "wall_s = best-of-N fenced wall of one compiled batched "

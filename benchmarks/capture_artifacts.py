@@ -300,6 +300,14 @@ def main() -> None:
         ["gol_tpu.utils.scalebench", "512", "32", "dense"]
     )
 
+    # Common artifact header (docs/OBSERVABILITY.md): the perf ledger
+    # routes ingestion by header.tool — the committed legacy files keep
+    # their structural sniffers.  Sections still carry their own
+    # tpu_/cpu_ backend prefixes (a capture mixes both).
+    from gol_tpu.telemetry import ledger as ledger_mod
+
+    halo["header"] = ledger_mod.artifact_header("halobench")
+    scale["header"] = ledger_mod.artifact_header("scalebench")
     for name, payload in (("HALO", halo), ("SCALE", scale)):
         path = REPO / f"{name}_r{rnd:02d}.json"
         path.write_text(json.dumps(payload, indent=1) + "\n")

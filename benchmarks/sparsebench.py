@@ -173,7 +173,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 ns.repeats)
         for name, make in scenarios(ns.size)
     ]
+    from gol_tpu.telemetry import ledger as ledger_mod
+
     payload = dict(
+        # Common artifact header (docs/OBSERVABILITY.md): the perf
+        # ledger routes ingestion by header.tool, no filename sniffing.
+        header=ledger_mod.artifact_header("sparsebench"),
         note=(
             "dense-vs-gated speedup curve over live-cell fraction "
             "(docs/SPARSE.md). dense_wall_s = best-of-N fenced wall of "

@@ -143,6 +143,10 @@ class GolBatchRuntime:
     compile_cache: Optional[str] = None
     restart_attempt: int = 0
     resume_info: Optional[dict] = None
+    # Live metrics endpoint (--metrics-port; docs/OBSERVABILITY.md) —
+    # same contract as GolRuntime: Prometheus text fed by the event
+    # stream, requires telemetry.
+    metrics_port: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.engine not in batch_engines.BATCH_ENGINES:
@@ -183,6 +187,13 @@ class GolBatchRuntime:
         self.generation = 0
         self._ckpt_writer = None
         self._resume_source: Optional[str] = None
+        if self.metrics_port is not None and not self.telemetry_dir:
+            raise ValueError(
+                "metrics_port serves the in-process event stream, so it "
+                "requires telemetry_dir (--telemetry)"
+            )
+        self.last_metrics = None
+        self._metrics_server = None
 
     # -- placement ---------------------------------------------------------
     def _bucket_mesh(self, bucket: Bucket) -> Optional[Mesh]:
@@ -312,6 +323,14 @@ class GolBatchRuntime:
         from gol_tpu import telemetry as telemetry_mod
 
         events = telemetry_mod.EventLog(self.telemetry_dir, run_id=self.run_id)
+        if self.metrics_port is not None:
+            # Single-process by CLI validation; attach before the header
+            # emits so the registry sees every record.
+            from gol_tpu.telemetry import metrics as metrics_mod
+
+            self.last_metrics, self._metrics_server = (
+                metrics_mod.serve_event_metrics(events, self.metrics_port)
+            )
         events.run_header(
             dict(
                 driver="batch",
@@ -420,6 +439,11 @@ class GolBatchRuntime:
             self.checkpoint_every if self.checkpoint_every > 0 else iterations,
         )
         events = self.open_event_log()
+        # Span attribution (schema v6): with several buckets per chunk
+        # index, each bucket's event carries its own dispatch/ready and
+        # the clock's accumulated boundary phases drain into whichever
+        # event is emitted next — aggregate per-phase totals stay exact.
+        sc = telemetry_mod.SpanClock() if events is not None else None
         try:
             with sw.phase("compile"):
                 evolvers = self.compile_evolvers(schedule, events)
@@ -443,10 +467,13 @@ class GolBatchRuntime:
                                         stack = compiled(stack, hs, ws)
                                     else:
                                         stack = compiled(stack)
+                                    t1 = time_mod.perf_counter()
                                     force_ready(stack)
                                     dt = time_mod.perf_counter() - t0
                                 stacks[bucket_id] = (stack, hs, ws)
                                 if events is not None:
+                                    sc.add("dispatch", t1 - t0)
+                                    sc.add("ready", dt - (t1 - t0))
                                     cells = sum(
                                         self._shapes[j][0] * self._shapes[j][1]
                                         for j in bucket.indices
@@ -457,18 +484,22 @@ class GolBatchRuntime:
                                         if dt > 0
                                         else 0.0
                                     )
-                                    events.chunk_event(
-                                        i,
-                                        take,
-                                        self.generation + take,
-                                        dt,
-                                        cells * take,
-                                        None,
-                                        batch=block,
-                                    )
+                                    spans = sc.take()
+                                    with sc.span("telemetry"):
+                                        events.chunk_event(
+                                            i,
+                                            take,
+                                            self.generation + take,
+                                            dt,
+                                            cells * take,
+                                            None,
+                                            batch=block,
+                                            spans=spans,
+                                        )
                         self.generation += take
                         if self.checkpoint_every > 0:
                             with sw.phase("init"):
+                                t0 = time_mod.perf_counter()
                                 # Host crop of every stepped stack: the
                                 # donation fence (the next chunk consumes
                                 # the device buffers), outside 'total'.
@@ -481,6 +512,11 @@ class GolBatchRuntime:
                                     # The donated device stack survives
                                     # the fetch; rebuilding from host
                                     # would double-copy.
+                                if sc is not None:
+                                    sc.add(
+                                        "host_fetch",
+                                        time_mod.perf_counter() - t0,
+                                    )
                             with telemetry_mod.trace_annotation(
                                 "gol.checkpoint.save"
                             ):
@@ -488,15 +524,27 @@ class GolBatchRuntime:
                                     t0 = time_mod.perf_counter()
                                     self._save_snapshot()
                                     dt = time_mod.perf_counter() - t0
+                            if sc is not None:
+                                sc.add("checkpoint", dt)
                             if events is not None:
-                                events.checkpoint_event(
-                                    self.generation,
-                                    dt,
-                                    self._world_cells(),
-                                    overlapped=writer is not None,
-                                )
+                                with sc.span("telemetry"):
+                                    events.checkpoint_event(
+                                        self.generation,
+                                        dt,
+                                        self._world_cells(),
+                                        overlapped=writer is not None,
+                                    )
                         if i < len(schedule) - 1:
-                            if resilience.agreed_preempt_requested():
+                            if sc is None:
+                                preempt_now = (
+                                    resilience.agreed_preempt_requested()
+                                )
+                            else:
+                                with sc.span("preempt_poll"):
+                                    preempt_now = (
+                                        resilience.agreed_preempt_requested()
+                                    )
+                            if preempt_now:
                                 checkpointed = self.checkpoint_every > 0
                                 if writer is not None and checkpointed:
                                     with sw.phase("checkpoint"):

@@ -101,6 +101,11 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     # --run-id so every rank's file shares one prefix.
     ext.add_argument("--telemetry", default=None, metavar="DIR")
     ext.add_argument("--run-id", default=None, metavar="NAME")
+    # Live metrics endpoint (docs/OBSERVABILITY.md): rank 0 serves
+    # Prometheus text on 127.0.0.1:<P>/metrics (0 = ephemeral port,
+    # printed at startup), fed by the same in-process event stream as
+    # the JSONL files.  Requires --telemetry.
+    ext.add_argument("--metrics-port", type=int, default=None, metavar="P")
     # Batched multi-world mode (gol_tpu/batch, docs/BATCHING.md): evolve
     # B independent worlds in one compiled program per size bucket,
     # amortizing the per-invocation launch overhead B-fold.  --batch-sizes
@@ -203,6 +208,7 @@ def _run_batch(
             compile_cache=ns.compile_cache,
             restart_attempt=restart_attempt,
             resume_info=resume_info,
+            metrics_port=ns.metrics_port,
         )
         with resilience.preemption_guard():
             report, boards = brt.run(iterations, resume=resume)
@@ -326,6 +332,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 "--stats emits schema-v2 stats events, so it requires "
                 "--telemetry DIR"
+            )
+        if ns.metrics_port is not None and not ns.telemetry:
+            raise ValueError(
+                "--metrics-port serves the in-process event stream, so "
+                "it requires --telemetry DIR"
+            )
+        if ns.metrics_port is not None and not (
+            0 <= ns.metrics_port <= 65535
+        ):
+            raise ValueError(
+                f"--metrics-port must be 0..65535 (0 = ephemeral), got "
+                f"{ns.metrics_port}"
             )
         if ns.stats and ns.guard_every > 0:
             raise ValueError(
@@ -487,6 +505,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             resume_info=resume_info,
             activity_tile=ns.activity_tile,
             activity_capacity=ns.activity_capacity,
+            metrics_port=ns.metrics_port,
         )
         guard_report = None
         with resilience.preemption_guard():

@@ -276,6 +276,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # driver (docs/OBSERVABILITY.md).
     ext.add_argument("--telemetry", default=None, metavar="DIR")
     ext.add_argument("--run-id", default=None, metavar="NAME")
+    # Live metrics endpoint, same surface as the 2-D driver
+    # (docs/OBSERVABILITY.md): rank 0 serves Prometheus text fed by the
+    # in-process event stream.  Requires --telemetry.
+    ext.add_argument("--metrics-port", type=int, default=None, metavar="P")
     # In-graph volume statistics per chunk (schema-v2 `stats` events):
     # population/births/deaths/changed fused onto the chunk program —
     # same surface and constraints as the 2-D driver's --stats.
@@ -343,6 +347,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise ValueError(
                 "--stats emits schema-v2 stats events, so it requires "
                 "--telemetry DIR"
+            )
+        if ns.metrics_port is not None and not ns.telemetry:
+            raise ValueError(
+                "--metrics-port serves the in-process event stream, so "
+                "it requires --telemetry DIR"
+            )
+        if ns.metrics_port is not None and not (
+            0 <= ns.metrics_port <= 65535
+        ):
+            raise ValueError(
+                f"--metrics-port must be 0..65535 (0 = ephemeral), got "
+                f"{ns.metrics_port}"
             )
         if ns.stats and ns.guard_every > 0:
             raise ValueError(
@@ -487,6 +503,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             restart_attempt = 0
         if ns.telemetry:
             events = telemetry_mod.EventLog(ns.telemetry, run_id=ns.run_id)
+            if ns.metrics_port is not None and topo.is_coordinator:
+                # Rank 0 only: one scrape surface per job, attached
+                # before the header emits (main's finally closes the
+                # server with the event log).
+                from gol_tpu.telemetry import metrics as metrics_mod
+
+                metrics_mod.serve_event_metrics(events, ns.metrics_port)
             events.run_header(
                 dict(
                     driver="3d",
@@ -684,6 +707,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             else:
                 from gol_tpu.utils.timing import maybe_profile
 
+                # Span attribution (schema v6), same shape as the 2-D
+                # runtime loop: telemetry-off never builds the clock.
+                sc = (
+                    telemetry_mod.SpanClock()
+                    if events is not None
+                    else None
+                )
                 with resilience.preemption_guard(), maybe_profile(
                     ns.profile
                 ), telemetry_mod.trace_annotation(
@@ -695,33 +725,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         with telemetry_mod.step_annotation("gol.chunk", i):
                             with sw.phase("total"):
                                 t0 = time_mod.perf_counter()
+                                out3 = compiled(board)
+                                t1 = time_mod.perf_counter()
                                 if ns.stats:
-                                    board, dev_stats = compiled(board)
+                                    board, dev_stats = out3
                                 else:
-                                    board = compiled(board)
+                                    board = out3
                                 force_ready(board)
                                 dt = time_mod.perf_counter() - t0
                         generation += take
                         if events is not None:
-                            events.chunk_event(
-                                i,
-                                take,
-                                generation,
-                                dt,
-                                size**3 * take,
-                                util3d(take, dt),
-                            )
+                            sc.add("dispatch", t1 - t0)
+                            sc.add("ready", dt - (t1 - t0))
+                            spans = sc.take()
+                            with sc.span("telemetry"):
+                                events.chunk_event(
+                                    i,
+                                    take,
+                                    generation,
+                                    dt,
+                                    size**3 * take,
+                                    util3d(take, dt),
+                                    spans=spans,
+                                )
                         if dev_stats is not None and events is not None:
                             from gol_tpu.telemetry import (
                                 stats as stats_mod,
                             )
 
-                            events.stats_event(
-                                i,
-                                take,
-                                generation,
-                                stats_mod.stats_values(dev_stats),
-                            )
+                            with sc.span("telemetry"):
+                                events.stats_event(
+                                    i,
+                                    take,
+                                    generation,
+                                    stats_mod.stats_values(dev_stats),
+                                )
                         if ns.checkpoint_every > 0:
                             with telemetry_mod.trace_annotation(
                                 "gol.checkpoint.save"
@@ -729,23 +767,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                 t0 = time_mod.perf_counter()
                                 save_snapshot(board, generation)
                                 dt = time_mod.perf_counter() - t0
+                            if sc is not None:
+                                sc.add("checkpoint", dt)
                             if events is not None:
-                                events.checkpoint_event(
-                                    generation,
-                                    dt,
-                                    size**3,
-                                    overlapped=ckpt_writer is not None,
+                                with sc.span("telemetry"):
+                                    events.checkpoint_event(
+                                        generation,
+                                        dt,
+                                        size**3,
+                                        overlapped=ckpt_writer is not None,
+                                    )
+                        if i < len(schedule) - 1:
+                            if sc is None:
+                                preempt_now = (
+                                    resilience.agreed_preempt_requested()
                                 )
-                        if i < len(schedule) - 1 and (
-                            resilience.agreed_preempt_requested()
-                        ):
-                            # Chunk-boundary preemption poll (host-side
-                            # only; the compiled programs never see it).
-                            preempt_exit(
-                                board,
-                                generation,
-                                just_saved=ns.checkpoint_every > 0,
-                            )
+                            else:
+                                with sc.span("preempt_poll"):
+                                    preempt_now = (
+                                        resilience.agreed_preempt_requested()
+                                    )
+                            if preempt_now:
+                                # Chunk-boundary preemption poll (host-
+                                # side only; the compiled programs never
+                                # see it).
+                                preempt_exit(
+                                    board,
+                                    generation,
+                                    just_saved=ns.checkpoint_every > 0,
+                                )
             if ckpt_writer is not None:
                 # Completion fence only; main's finally owns the close.
                 with sw.phase("checkpoint"):

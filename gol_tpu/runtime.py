@@ -122,6 +122,13 @@ class GolRuntime:
     # true activity after one generation (bit-identity pinned).
     activity_tile: int = 0
     activity_capacity: float = 0.25
+    # Live metrics endpoint (--metrics-port; docs/OBSERVABILITY.md):
+    # rank 0 serves Prometheus text on 127.0.0.1:<port> (0 = ephemeral),
+    # fed by the same in-process event stream the rank files get — so
+    # the scrape counters can never disagree with the JSONL.  Requires
+    # telemetry (the stream is the feed); host-side only, trace-
+    # identity-pinned like every other observability knob.
+    metrics_port: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.engine not in ENGINES:
@@ -345,6 +352,16 @@ class GolRuntime:
         # "active_tile_gens", "computed_tile_gens", "fallback_gens",
         # "skipped_tile_gens", ...}, ...].
         self.last_activity: list = []
+        if self.metrics_port is not None and not self.telemetry_dir:
+            raise ValueError(
+                "metrics_port serves the in-process event stream, so it "
+                "requires telemetry_dir (--telemetry)"
+            )
+        # The live run's MetricsRegistry/MetricsServer (--metrics-port);
+        # the registry outlives the run for reconciliation tests, the
+        # server dies with the event log.
+        self.last_metrics = None
+        self._metrics_server = None
 
     def _init_activity(self) -> None:
         """Validate + resolve the activity tier's tile/capacity/repr.
@@ -979,6 +996,15 @@ class GolRuntime:
         from gol_tpu import telemetry as telemetry_mod
 
         events = telemetry_mod.EventLog(self.telemetry_dir, run_id=self.run_id)
+        if self.metrics_port is not None and jax.process_index() == 0:
+            # Attach before the header emits so the registry sees every
+            # record; the server rides events.close() (rank 0 only — the
+            # scrape surface is one endpoint, like the printed report).
+            from gol_tpu.telemetry import metrics as metrics_mod
+
+            self.last_metrics, self._metrics_server = (
+                metrics_mod.serve_event_metrics(events, self.metrics_port)
+            )
         mesh_shape = None if self.mesh is None else dict(self.mesh.shape)
         events.run_header(
             dict(
@@ -1095,6 +1121,11 @@ class GolRuntime:
             board = mesh_mod.shard_board(board, self.mesh)
 
         events = self.open_event_log()
+        # Span attribution (schema v6): host-phase seconds between
+        # force_ready fences, emitted as the `spans` block on each chunk
+        # event.  Telemetry-off runs never construct the clock, so the
+        # off path stays byte-for-byte the old one.
+        sc = telemetry_mod.SpanClock() if events is not None else None
         try:
             with sw.phase("compile"):
                 evolvers = self.compile_evolvers(board, schedule, events)
@@ -1123,19 +1154,28 @@ class GolRuntime:
                                     out = compiled(
                                         board, act_mask, *dynamic
                                     )
+                                else:
+                                    out = compiled(board, *dynamic)
+                                t1 = time_mod.perf_counter()
+                                if act_mask is not None:
                                     if self.stats:
                                         (board, act_mask, dev_act,
                                          dev_stats) = out
                                     else:
                                         board, act_mask, dev_act = out
                                 else:
-                                    out = compiled(board, *dynamic)
                                     if self.stats:
                                         board, dev_stats = out
                                     else:
                                         board = out
                                 force_ready(board)
                                 dt = time_mod.perf_counter() - t0
+                        if sc is not None:
+                            # dispatch = enqueue until the async call
+                            # returns; ready = the block_until_ready
+                            # fence.  Together they partition wall_s.
+                            sc.add("dispatch", t1 - t0)
+                            sc.add("ready", dt - (t1 - t0))
                         state = GolState.create(
                             board, int(state.generation) + take
                         )
@@ -1156,15 +1196,24 @@ class GolRuntime:
                             extra = (
                                 {"activity": act_block} if act_block else {}
                             )
-                            events.chunk_event(
-                                i,
-                                take,
-                                int(state.generation),
-                                dt,
-                                self.geometry.cell_updates(take),
-                                self.chunk_utilization(take, dt),
-                                **extra,
-                            )
+                            # The drained spans cover this chunk's
+                            # dispatch/ready plus the boundary phases
+                            # since the previous chunk's event; writing
+                            # the event itself is timed into the NEXT
+                            # chunk's block.
+                            spans = sc.take()
+                            if spans:
+                                extra["spans"] = spans
+                            with sc.span("telemetry"):
+                                events.chunk_event(
+                                    i,
+                                    take,
+                                    int(state.generation),
+                                    dt,
+                                    self.geometry.cell_updates(take),
+                                    self.chunk_utilization(take, dt),
+                                    **extra,
+                                )
                         if dev_stats is not None:
                             # The scalar fetch happens after the timed
                             # fence (the same program already produced
@@ -1183,9 +1232,10 @@ class GolRuntime:
                                 )
                             )
                             if events is not None:
-                                events.stats_event(
-                                    i, take, int(state.generation), vals
-                                )
+                                with sc.span("telemetry"):
+                                    events.stats_event(
+                                        i, take, int(state.generation), vals
+                                    )
                         if self.checkpoint_every > 0:
                             with telemetry_mod.trace_annotation(
                                 "gol.checkpoint.save"
@@ -1194,13 +1244,16 @@ class GolRuntime:
                                     t0 = time_mod.perf_counter()
                                     self._save_snapshot(state)
                                     dt = time_mod.perf_counter() - t0
+                            if sc is not None:
+                                sc.add("checkpoint", dt)
                             if events is not None:
-                                events.checkpoint_event(
-                                    int(state.generation),
-                                    dt,
-                                    int(state.board.size),
-                                    overlapped=writer is not None,
-                                )
+                                with sc.span("telemetry"):
+                                    events.checkpoint_event(
+                                        int(state.generation),
+                                        dt,
+                                        int(state.board.size),
+                                        overlapped=writer is not None,
+                                    )
                         if i < len(schedule) - 1:
                             # Chunk-boundary preemption poll: host-side
                             # only (the compiled programs never see it).
@@ -1210,7 +1263,16 @@ class GolRuntime:
                             # now.
                             from gol_tpu import resilience
 
-                            if resilience.agreed_preempt_requested():
+                            if sc is None:
+                                preempt_now = (
+                                    resilience.agreed_preempt_requested()
+                                )
+                            else:
+                                with sc.span("preempt_poll"):
+                                    preempt_now = (
+                                        resilience.agreed_preempt_requested()
+                                    )
+                            if preempt_now:
                                 self._preempt(
                                     state,
                                     sw,

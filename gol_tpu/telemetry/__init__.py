@@ -32,6 +32,13 @@ of it:
   renders per-phase/per-chunk tables with the roofline column, and flags
   anomalies; ``diff`` compares two runs (:mod:`gol_tpu.telemetry.
   summarize`).
+- Schema v6: ``chunk`` events carry a ``spans`` block decomposing the
+  host wall between force_ready fences (:class:`SpanClock`); ``python
+  -m gol_tpu.telemetry ledger ingest|show|check`` maintains the
+  cross-run perf ledger (:mod:`gol_tpu.telemetry.ledger`,
+  ``PERF_LEDGER.jsonl``) with a >N%-regression CI gate; and
+  ``--metrics-port`` serves the same in-process event stream as
+  Prometheus text (:mod:`gol_tpu.telemetry.metrics`).
 
 Purity invariant: everything here is host-side Python running strictly
 outside compiled code, after the ``force_ready`` fences — emission can
@@ -48,24 +55,30 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 5 (this round) adds the activity-gated tier fields
-# (docs/SPARSE.md): ``chunk`` events of an ``--engine activity`` run
-# carry an ``activity`` block — ``{tile, tiles, tile_gens,
-# active_tile_gens, computed_tile_gens, skipped_tile_gens,
-# fallback_gens, active_fraction}`` — the skip accounting of the sparse
-# worklist.  Version 4 added the batched multi-world fields
-# (docs/BATCHING.md): ``chunk`` and ``compile`` events may carry a
-# ``batch`` block — ``{bucket: [H, W], B, masked, engine,
-# per_world_updates_per_sec}`` — and a batch run's ``run_header.config``
-# records the bucket layout.  Version 3 added the resilience events —
-# ``preempt``, ``resume``, ``restart`` (docs/RESILIENCE.md); version 2
-# the ``stats`` event type and optional ``memory``/``cost`` blocks on
-# ``compile`` events.  Older streams stay readable: every v1-v4 event
-# type and field survives unchanged, so consumers only ever *gain*
-# records (back-compat pinned by the committed v1/v2/v3/v4 fixture
-# tests).
-SCHEMA_VERSION = 5
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5)
+# Version 6 (this round) adds host-side span attribution
+# (docs/OBSERVABILITY.md): ``chunk`` events carry a ``spans`` block —
+# ``{phase: seconds, ...}`` with phases like ``dispatch``, ``ready``,
+# ``checkpoint``, ``telemetry``, ``preempt_poll`` (the guard adds
+# ``audit``/``redundant``/``snapshot``/``restore``) — decomposing the
+# host wall between consecutive force_ready fences, so "where does the
+# non-MFU time go" is answerable from the JSONL alone.  Version 5 added
+# the activity-gated tier fields (docs/SPARSE.md): ``chunk`` events of
+# an ``--engine activity`` run carry an ``activity`` block — ``{tile,
+# tiles, tile_gens, active_tile_gens, computed_tile_gens,
+# skipped_tile_gens, fallback_gens, active_fraction}`` — the skip
+# accounting of the sparse worklist.  Version 4 added the batched
+# multi-world fields (docs/BATCHING.md): ``chunk`` and ``compile``
+# events may carry a ``batch`` block — ``{bucket: [H, W], B, masked,
+# engine, per_world_updates_per_sec}`` — and a batch run's
+# ``run_header.config`` records the bucket layout.  Version 3 added the
+# resilience events — ``preempt``, ``resume``, ``restart``
+# (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
+# ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
+# readable: every v1-v5 event type and field survives unchanged, so
+# consumers only ever *gain* records (back-compat pinned by the
+# committed v1/v2/v3/v4/v5 fixture tests).
+SCHEMA_VERSION = 6
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -163,6 +176,13 @@ class EventLog:
     times, and there is deliberately no cross-host coordination here).
     Lines are flushed per record so a killed run keeps everything emitted
     up to the failure — telemetry exists precisely for runs that die.
+
+    ``observer`` (settable after construction) is called with every
+    validated record *after* it is written — the in-process tap the live
+    metrics endpoint feeds from (:mod:`gol_tpu.telemetry.metrics`); a
+    :class:`~gol_tpu.telemetry.metrics.MetricsServer` assigned to
+    ``metrics_server`` is shut down by :meth:`close`, so the scrape
+    surface lives exactly as long as the event stream.
     """
 
     def __init__(
@@ -191,6 +211,8 @@ class EventLog:
                 n += 1
             os.replace(self.path, f"{self.path}.{n}")
         self._f = open(self.path, "w")
+        self.observer = None
+        self.metrics_server = None
 
     # -- envelope -----------------------------------------------------------
     def emit(self, event: str, **fields) -> None:
@@ -198,10 +220,15 @@ class EventLog:
         validate_record(rec)
         self._f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._f.flush()
+        if self.observer is not None:
+            self.observer(rec)
 
     def close(self) -> None:
         if not self._f.closed:
             self._f.close()
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
     def __enter__(self) -> "EventLog":
         return self
@@ -367,6 +394,41 @@ class EventLog:
             updates_per_sec=report.updates_per_sec,
             phases=dict(report.phases),
         )
+
+
+class SpanClock:
+    """Accumulates named host-side phase seconds for ``spans`` blocks (v6).
+
+    The chunk loops time their host phases with this: ``add`` for spans
+    whose endpoints were already captured (dispatch / block-until-ready),
+    ``span`` as a context manager for everything else (checkpoint save,
+    telemetry write, preempt poll, guard audit...).  ``take`` drains the
+    accumulator into one dict — the ``spans`` block of the next emitted
+    ``chunk`` event — so the block for chunk *i* decomposes the host wall
+    between the (i-1)-th and i-th ``force_ready`` fences: chunk i's own
+    dispatch/ready plus the boundary phases that ran after chunk i-1's
+    event was written (chunk 0 carries dispatch/ready only).  Purely
+    host-side — a traced program can never see it (the trace-identity
+    pin covers the spans-on path).
+    """
+
+    def __init__(self) -> None:
+        self._acc: Dict[str, float] = {}
+
+    def add(self, phase: str, seconds: float) -> None:
+        self._acc[phase] = self._acc.get(phase, 0.0) + seconds
+
+    @contextlib.contextmanager
+    def span(self, phase: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(phase, time.perf_counter() - t0)
+
+    def take(self) -> Dict[str, float]:
+        out, self._acc = self._acc, {}
+        return out
 
 
 def roofline_utilization(

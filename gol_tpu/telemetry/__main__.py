@@ -1,5 +1,6 @@
 """``python -m gol_tpu.telemetry
-{summarize <dir> | diff <a> <b> | watch <dir>}``."""
+{summarize <dir> | diff <a> <b> | watch <dir> |
+ ledger ingest|show|check}``."""
 
 import sys
 

@@ -1,0 +1,684 @@
+"""Cross-run perf ledger: ``PERF_LEDGER.jsonl`` + the regression gate.
+
+Every round of this repo committed its perf evidence as a bespoke JSON
+artifact — ``BENCH_r*.json`` (a captured bench.py tail), ``BATCH_r*``
+(amortization rows), ``SPARSE_r*`` (speedup rows), ``HALO_r*``
+(exchange-vs-compute sections), ``SCALE_r*`` (weak-scaling sections),
+``MULTICHIP_r*`` (equivalence dryruns) — and nothing ever ingested them,
+so the project's perf *trajectory* was invisible and unguarded: a 30%
+regression in the 1.93e12 cell-updates/s flagship would ship silently as
+one more artifact nobody diffs.  This module normalizes all of them (and
+any ``--telemetry`` run directory) into one append-only record stream:
+
+    python -m gol_tpu.telemetry ledger ingest *.json runs/exp1
+    python -m gol_tpu.telemetry ledger show
+    python -m gol_tpu.telemetry ledger check          # the CI gate
+
+**Record schema** (one JSON object per line, ``LEDGER_SCHEMA`` = 1)::
+
+    {"ledger": 1, "fingerprint": "bench:tpu:flagship_2d:16384^2x10240",
+     "kind": "throughput", "backend": "tpu", "value": 1.93e12,
+     "unit": "cell-updates/s", "direction": "higher", "mfu": 0.663,
+     "source": "BENCH_r05.json", "tool": "bench", "round": 5,
+     "ingested_t": ..., "extra": {...}}
+
+``fingerprint`` is the config identity records trend over — it embeds
+the backend, geometry, engine and workload knobs, so only genuinely
+comparable measurements ever compare.  ``kind`` partitions the gate:
+
+- ``throughput`` — a headline rate (higher is better): gated;
+- ``equivalence`` — a pass/fail dryrun (1.0/0.0): gated (a flip to
+  0 is a 100% regression);
+- ``attribution`` — a phase breakdown (halobench seconds/gen): shown in
+  trends, **never gated** — its measurement method legitimately evolves
+  between rounds (the r5 anti-DCE rework changed ``exchange_s``
+  semantics), so gating it would punish better instrumentation.
+
+**Regression policy** (``check``): for each fingerprint, the *newest*
+record is compared against the *best* record of the same fingerprint;
+the gate fails when the newest is worse by more than ``--threshold``
+(default 20%).  Only TPU-backend records are gated by default — the
+CPU-backend artifacts are "curve shape only" by their own notes
+(shared-host walls are not reproducible numbers), so gating them would
+make CI flaky; ``--backend all`` opts in.  Dips *between* best and
+newest don't fail (history is history); only the current state of a
+config can regress.
+
+``ingest`` is idempotent: a record whose ``(source, fingerprint)`` is
+already in the ledger is skipped, so re-running ingestion over the same
+artifacts appends nothing.
+
+``summarize --ledger PATH`` wires the same comparison into the anomaly
+scan: a run whose summary throughput sits >threshold below the ledger's
+best for its config fingerprint gets a ``regression`` ANOMALY line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from gol_tpu.telemetry import SchemaError
+
+LEDGER_SCHEMA = 1
+DEFAULT_LEDGER = "PERF_LEDGER.jsonl"
+DEFAULT_THRESHOLD = 0.20
+
+# Satellite: the common header block new benchmark emitters stamp so
+# future artifacts ingest without bespoke sniffing (the committed
+# legacy files keep their adapters below).
+ARTIFACT_SCHEMA = "gol-artifact/1"
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def artifact_header(tool: str) -> dict:
+    """The common ``header`` block every benchmark emitter stamps.
+
+    ``tool`` routes the ledger's ingestion (no filename sniffing),
+    ``backend`` scopes the regression gate, ``argv`` makes the artifact
+    re-runnable from its own bytes.
+    """
+    import jax
+
+    return {
+        "schema": ARTIFACT_SCHEMA,
+        "tool": tool,
+        "backend": jax.default_backend(),
+        "argv": list(sys.argv),
+    }
+
+
+def _record(
+    fingerprint: str,
+    value: float,
+    unit: str,
+    source: str,
+    tool: str,
+    backend: str,
+    kind: str = "throughput",
+    direction: str = "higher",
+    mfu: Optional[float] = None,
+    round_: Optional[int] = None,
+    extra: Optional[dict] = None,
+) -> dict:
+    rec = {
+        "ledger": LEDGER_SCHEMA,
+        "fingerprint": fingerprint,
+        "kind": kind,
+        "backend": backend,
+        "value": float(value),
+        "unit": unit,
+        "direction": direction,
+        "mfu": mfu,
+        "source": source,
+        "tool": tool,
+        "round": round_,
+        "ingested_t": time.time(),
+    }
+    if extra:
+        rec["extra"] = extra
+    return rec
+
+
+def validate_ledger_record(rec: dict) -> None:
+    if not isinstance(rec, dict):
+        raise SchemaError(f"ledger record is {type(rec).__name__}, not an object")
+    missing = {
+        "ledger", "fingerprint", "kind", "backend", "value", "unit",
+        "direction", "source", "tool",
+    } - rec.keys()
+    if missing:
+        raise SchemaError(f"ledger record missing fields {sorted(missing)}")
+    if rec["ledger"] != LEDGER_SCHEMA:
+        raise SchemaError(
+            f"ledger schema {rec['ledger']!r} != supported {LEDGER_SCHEMA}"
+        )
+    if rec["direction"] not in ("higher", "lower"):
+        raise SchemaError(f"bad direction {rec['direction']!r}")
+    if not isinstance(rec["value"], (int, float)):
+        raise SchemaError(f"non-numeric value {rec['value']!r}")
+
+
+def read_ledger(path: str) -> List[dict]:
+    """Parse + validate every ledger line, preserving file order (the
+    order IS the history — the newest record per fingerprint is the
+    config's current state)."""
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SchemaError(f"{path}:{lineno}: bad JSON ({e})")
+            try:
+                validate_ledger_record(rec)
+            except SchemaError as e:
+                raise SchemaError(f"{path}:{lineno}: {e}")
+            records.append(rec)
+    return records
+
+
+def append_records(path: str, records: List[dict]) -> Tuple[int, int]:
+    """Append records not already present; returns (added, skipped).
+
+    Identity is ``(source, fingerprint)`` — one artifact contributes one
+    record per config, so re-ingesting the same files is a no-op.
+    """
+    seen = set()
+    if os.path.exists(path):
+        for rec in read_ledger(path):
+            seen.add((rec["source"], rec["fingerprint"]))
+    added = skipped = 0
+    with open(path, "a") as f:
+        for rec in records:
+            key = (rec["source"], rec["fingerprint"])
+            if key in seen:
+                skipped += 1
+                continue
+            seen.add(key)
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+            added += 1
+    return added, skipped
+
+
+# -- artifact adapters -------------------------------------------------------
+
+
+def _artifact_round(path: str) -> Optional[int]:
+    m = _ROUND_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _bench_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    """BENCH_r*.json: a captured ``bench.py`` stdout tail whose last JSON
+    lines are the metric report (TPU runs by construction — bench.py is
+    the flagship capture).  r4+ adds a ``claims`` list with per-claim
+    roofline MFU and device fits."""
+    out: List[dict] = []
+    payloads: List[dict] = []
+    claims: List[dict] = []
+    tail = data.get("tail") or ""
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                payloads.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    if not payloads and isinstance(data.get("parsed"), dict):
+        payloads = [data["parsed"]]
+    if not payloads:
+        # Salvage pass: the captured stdout tail can be truncated
+        # mid-line (BENCH_r05's opening ``{"metric": ...`` is cut off),
+        # but the embedded per-claim objects are intact — scan for any
+        # decodable object and keep the metric/claim-shaped ones.
+        dec = json.JSONDecoder()
+        idx = 0
+        while True:
+            start = tail.find("{", idx)
+            if start < 0:
+                break
+            try:
+                obj, end = dec.raw_decode(tail[start:])
+            except json.JSONDecodeError:
+                idx = start + 1
+                continue
+            idx = start + end
+            if not isinstance(obj, dict) or "value" not in obj:
+                continue
+            if "name" in obj and "metric" in obj:
+                claims.append(obj)
+            elif "metric" in obj:
+                payloads.append(obj)
+
+    def claim_record(claim: dict) -> dict:
+        fit = claim.get("device_fit") or {}
+        return _record(
+            f"bench:tpu:{claim['name']}:{claim['metric']}",
+            claim["value"],
+            claim.get("unit", "cell-updates/s"),
+            source,
+            "bench",
+            "tpu",
+            mfu=(claim.get("roofline") or {}).get("mfu"),
+            round_=round_,
+            extra={
+                "mfu_vpu_device": fit.get("mfu_vpu_device"),
+                "overhead_s_per_invocation": fit.get(
+                    "overhead_s_per_invocation"
+                ),
+            },
+        )
+
+    for p in payloads:
+        if "metric" not in p or "value" not in p:
+            continue
+        mfu = (p.get("mfu_vpu") or {}).get("mfu")
+        out.append(
+            _record(
+                f"bench:tpu:{p['metric']}",
+                p["value"],
+                p.get("unit", "cell-updates/s"),
+                source,
+                "bench",
+                "tpu",
+                mfu=mfu,
+                round_=round_,
+                extra={"vs_baseline": p.get("vs_baseline")},
+            )
+        )
+        out.extend(claim_record(c) for c in p.get("claims") or [])
+    out.extend(claim_record(c) for c in claims)
+    return out
+
+
+def _batch_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    backend = data.get("backend", "cpu")
+    size, iters = data.get("size"), data.get("iters")
+    out = []
+    for row in data.get("rows") or []:
+        out.append(
+            _record(
+                f"batch:{backend}:{size}^2x{iters}:B{row['B']}:{row['engine']}",
+                row["aggregate_updates_per_sec"],
+                "cell-updates/s",
+                source,
+                "batchbench",
+                backend,
+                round_=round_,
+                extra={
+                    "per_world_speedup_vs_sequential": row.get(
+                        "per_world_speedup_vs_sequential"
+                    ),
+                    "per_world_updates_per_sec": row.get(
+                        "per_world_updates_per_sec"
+                    ),
+                },
+            )
+        )
+    return out
+
+
+def _sparse_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    backend = data.get("backend", "cpu")
+    size, iters, tile = data.get("size"), data.get("iters"), data.get("tile")
+    cells = (size or 0) * (size or 0) * (iters or 0)
+    out = []
+    for row in data.get("rows") or []:
+        gated = row.get("gated_wall_s") or 0.0
+        out.append(
+            _record(
+                f"sparse:{backend}:{size}^2x{iters}:tile{tile}:"
+                f"{row['scenario']}",
+                cells / gated if gated > 0 else 0.0,
+                "cell-updates/s",
+                source,
+                "sparsebench",
+                backend,
+                round_=round_,
+                extra={
+                    "speedup_vs_dense": row.get("speedup"),
+                    "active_fraction": row.get("active_fraction"),
+                    "fallback_gens": row.get("fallback_gens"),
+                    "live_fraction_t0": row.get("live_fraction_t0"),
+                },
+            )
+        )
+    return out
+
+
+def _halo_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    """HALO_r*.json: named sections of seconds-per-generation columns.
+    Attribution records — kept for the trend tables, never gated (the
+    measurement method itself evolves between rounds)."""
+    out = []
+    for section, body in data.items():
+        if not isinstance(body, dict) or "step_s" not in body:
+            continue
+        backend = "tpu" if section.startswith("tpu") else "cpu"
+        out.append(
+            _record(
+                f"halo:{backend}:{section}",
+                body["step_s"],
+                "s/gen",
+                source,
+                "halobench",
+                backend,
+                kind="attribution",
+                direction="lower",
+                round_=round_,
+                extra={
+                    "exchange_s": body.get("exchange_s"),
+                    "stencil_s": body.get("stencil_s"),
+                    "exposed_exchange_s": body.get("exposed_exchange_s"),
+                },
+            )
+        )
+    return out
+
+
+def _scale_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    out = []
+    for section, body in data.items():
+        if not isinstance(body, dict) or "rows" not in body:
+            continue
+        backend = body.get(
+            "platform", "tpu" if section.startswith("tpu") else "cpu"
+        )
+        for row in body["rows"]:
+            if "per_chip" not in row:
+                continue
+            out.append(
+                _record(
+                    f"scale:{backend}:{section}:{row['devices']}dev",
+                    row["per_chip"],
+                    "cell-updates/s/chip",
+                    source,
+                    "scalebench",
+                    backend,
+                    round_=round_,
+                    extra={"efficiency": row.get("efficiency")},
+                )
+            )
+    return out
+
+
+def _multichip_records(
+    data: dict, source: str, round_: Optional[int]
+) -> List[dict]:
+    ok = bool(data.get("ok")) and not data.get("skipped")
+    return [
+        _record(
+            f"multichip:tpu:{data.get('n_devices')}dev",
+            1.0 if ok else 0.0,
+            "ok",
+            source,
+            "dryrun_multichip",
+            "tpu",
+            kind="equivalence",
+            round_=round_,
+        )
+    ]
+
+
+_TOOL_ADAPTERS = {
+    "bench": _bench_records,
+    "batchbench": _batch_records,
+    "sparsebench": _sparse_records,
+    "halobench": _halo_records,
+    "scalebench": _scale_records,
+    "dryrun_multichip": _multichip_records,
+}
+
+
+def normalize_artifact(path: str) -> List[dict]:
+    """One committed artifact JSON -> ledger records.
+
+    New-format artifacts route by their ``header.tool`` stamp
+    (:func:`artifact_header`); the already-committed legacy files are
+    sniffed by structure (``tail``+``cmd`` = bench wrapper, rows with
+    ``B`` = batchbench, ...), with the filename as a tie-break.
+    """
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise SchemaError(f"{path}: bad JSON ({e})")
+    if not isinstance(data, dict):
+        raise SchemaError(f"{path}: artifact root is not an object")
+    source = os.path.basename(path)
+    round_ = _artifact_round(path)
+
+    header = data.get("header")
+    if isinstance(header, dict) and header.get("tool") in _TOOL_ADAPTERS:
+        return _TOOL_ADAPTERS[header["tool"]](data, source, round_)
+
+    rows = data.get("rows")
+    first_row = rows[0] if isinstance(rows, list) and rows else {}
+    if "tail" in data and "cmd" in data:
+        return _bench_records(data, source, round_)
+    if "n_devices" in data and "ok" in data:
+        return _multichip_records(data, source, round_)
+    if "scenario" in first_row:
+        return _sparse_records(data, source, round_)
+    if "B" in first_row:
+        return _batch_records(data, source, round_)
+    if any(
+        isinstance(v, dict) and "step_s" in v for v in data.values()
+    ):
+        return _halo_records(data, source, round_)
+    if any(
+        isinstance(v, dict) and "rows" in v for v in data.values()
+    ):
+        return _scale_records(data, source, round_)
+    raise SchemaError(
+        f"{path}: unrecognized artifact format (no header.tool stamp and "
+        "no known legacy structure)"
+    )
+
+
+# -- telemetry-directory adapter ---------------------------------------------
+
+
+def run_fingerprint(run) -> Optional[str]:
+    """The config fingerprint of one telemetry run (None without a
+    header).  Embeds backend + driver + resolved engine + geometry +
+    mesh, so only identically-configured runs ever trend together."""
+    head = run.header
+    if head is None:
+        return None
+    cfg = head.get("config") or {}
+    backend = head.get("backend", "?")
+    driver = cfg.get("driver", "?")
+    engine = cfg.get("resolved_engine", cfg.get("engine", "?"))
+    if driver == "3d":
+        geom = f"{cfg.get('size')}^3"
+    elif driver == "batch":
+        geom = f"{cfg.get('num_worlds')}worlds"
+    else:
+        geom = f"{cfg.get('height')}x{cfg.get('width')}"
+    mesh = cfg.get("mesh")
+    mesh_s = "none" if not mesh else "x".join(
+        str(v) for v in mesh.values()
+    )
+    return f"telemetry:{backend}:{driver}:{engine}:{geom}:mesh{mesh_s}"
+
+
+def normalize_telemetry_dir(directory: str) -> List[dict]:
+    """Every finished run in a ``--telemetry`` directory -> one ledger
+    record (headline = the summary's updates/s; MFU = the run's best
+    per-chunk roofline fraction)."""
+    from gol_tpu.telemetry import summarize as summ_mod
+
+    out = []
+    for run_id, run in sorted(summ_mod.load_dir(directory).items()):
+        summ = run.summary_record
+        fp = run_fingerprint(run)
+        if summ is None or fp is None:
+            continue
+        head = run.header or {}
+        rank0 = min(run.ranks, default=0)
+        utils = [
+            c["roofline_util"]
+            for c in run.records("chunk", rank=rank0)
+            if c.get("roofline_util") is not None
+        ]
+        out.append(
+            _record(
+                fp,
+                summ["updates_per_sec"],
+                "cell-updates/s",
+                f"{os.path.basename(os.path.abspath(directory))}/{run_id}",
+                "telemetry",
+                head.get("backend", "?"),
+                mfu=max(utils) if utils else None,
+                extra={"duration_s": summ["duration_s"]},
+            )
+        )
+    return out
+
+
+def normalize(path: str) -> List[dict]:
+    if os.path.isdir(path):
+        return normalize_telemetry_dir(path)
+    return normalize_artifact(path)
+
+
+# -- the gate ----------------------------------------------------------------
+
+
+def _worse(newest: dict, best: dict, threshold: float) -> bool:
+    if newest["direction"] == "lower":
+        return newest["value"] > best["value"] * (1.0 + threshold)
+    return newest["value"] < best["value"] * (1.0 - threshold)
+
+
+def _best(records: List[dict]) -> dict:
+    if records[0]["direction"] == "lower":
+        return min(records, key=lambda r: r["value"])
+    return max(records, key=lambda r: r["value"])
+
+
+def check_records(
+    records: List[dict],
+    threshold: float = DEFAULT_THRESHOLD,
+    backends: Tuple[str, ...] = ("tpu",),
+) -> List[str]:
+    """Regression messages (empty = gate passes).
+
+    Gated population: ``throughput`` + ``equivalence`` records on the
+    gated backends (TPU by default — CPU walls are curve shape only).
+    Per fingerprint, newest (last in file order) vs best.
+    """
+    by_fp: Dict[str, List[dict]] = {}
+    for rec in records:
+        if rec["kind"] == "attribution":
+            continue
+        if "all" not in backends and rec["backend"] not in backends:
+            continue
+        by_fp.setdefault(rec["fingerprint"], []).append(rec)
+    flags = []
+    for fp, recs in sorted(by_fp.items()):
+        newest, best = recs[-1], _best(recs)
+        if _worse(newest, best, threshold):
+            sign = "-" if newest["direction"] == "higher" else "+"
+            pct = 100.0 * abs(newest["value"] - best["value"]) / best["value"]
+            flags.append(
+                f"regression: {fp}: newest {newest['value']:.4g} "
+                f"{newest['unit']} ({newest['source']}) is {sign}{pct:.1f}% "
+                f"vs best {best['value']:.4g} ({best['source']}) — "
+                f"threshold {100 * threshold:.0f}%"
+            )
+    return flags
+
+
+def ledger_regression_flags(
+    run,
+    records: List[dict],
+    threshold: float = DEFAULT_THRESHOLD,
+) -> List[str]:
+    """``summarize --ledger``'s anomaly: this run's summary throughput vs
+    the ledger's best for the same config fingerprint (any backend — the
+    fingerprint embeds it, so a CPU run only ever compares to CPU
+    history)."""
+    fp = run_fingerprint(run)
+    summ = run.summary_record
+    if fp is None or summ is None:
+        return []
+    history = [
+        r for r in records
+        if r["fingerprint"] == fp and r["kind"] == "throughput"
+    ]
+    if not history:
+        return []
+    best = _best(history)
+    mine = summ["updates_per_sec"]
+    if mine < best["value"] * (1.0 - threshold):
+        pct = 100.0 * (best["value"] - mine) / best["value"]
+        return [
+            f"regression: run {run.run_id} at {mine:.4g} cell-updates/s is "
+            f"-{pct:.1f}% vs the ledger best {best['value']:.4g} for "
+            f"{fp} ({best['source']}) — threshold {100 * threshold:.0f}%"
+        ]
+    return []
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def show(records: List[dict], out) -> None:
+    """Per-fingerprint trend tables, file order preserved."""
+    by_fp: Dict[str, List[dict]] = {}
+    for rec in records:
+        by_fp.setdefault(rec["fingerprint"], []).append(rec)
+    for fp, recs in sorted(by_fp.items()):
+        best = _best(recs)
+        print(f"{fp}  [{recs[0]['kind']}, {recs[0]['unit']}]", file=out)
+        for rec in recs:
+            if best["value"] != 0:
+                rel = (rec["value"] - best["value"]) / best["value"]
+                if rec["direction"] == "lower":
+                    rel = -rel
+                delta = "   best" if rec is best else f"{100 * rel:+6.1f}%"
+            else:
+                delta = "    n/a"
+            mfu = "" if rec.get("mfu") is None else f"  mfu {rec['mfu']:.3f}"
+            rnd = "" if rec.get("round") is None else f"r{rec['round']:02d}  "
+            print(
+                f"  {rnd}{rec['value']:>12.4g}  {delta}{mfu}  "
+                f"<- {rec['source']}",
+                file=out,
+            )
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def main_ingest(paths: List[str], ledger_path: str, out) -> int:
+    records: List[dict] = []
+    for path in paths:
+        recs = normalize(path)
+        print(f"{path}: {len(recs)} record(s)", file=out)
+        records.extend(recs)
+    added, skipped = append_records(ledger_path, records)
+    print(
+        f"{ledger_path}: appended {added} record(s), {skipped} already "
+        "present",
+        file=out,
+    )
+    return 0
+
+
+def main_show(ledger_path: str, out) -> int:
+    show(read_ledger(ledger_path), out)
+    return 0
+
+
+def main_check(
+    ledger_path: str, threshold: float, backends: Tuple[str, ...], out
+) -> int:
+    records = read_ledger(ledger_path)
+    flags = check_records(records, threshold=threshold, backends=backends)
+    for flag in flags:
+        print(f"REGRESSION: {flag}", file=out)
+    if flags:
+        return 1
+    gated = sum(
+        1 for r in records
+        if r["kind"] != "attribution"
+        and ("all" in backends or r["backend"] in backends)
+    )
+    print(
+        f"ledger check: {gated} gated record(s) across "
+        f"{len({r['fingerprint'] for r in records})} fingerprint(s), no "
+        f"regression > {100 * threshold:.0f}%",
+        file=out,
+    )
+    return 0

@@ -1,0 +1,259 @@
+"""Live Prometheus metrics endpoint (``--metrics-port``).
+
+The JSONL stream is great for post-mortems and ``watch``, but a serving
+tier (ROADMAP item 1) needs a *scrape surface*: a long-lived process a
+Prometheus/alerting stack polls, not files someone tails.  This module
+ships that surface now, fed by the exact same in-process event stream
+the rank files get — :class:`MetricsRegistry` is an
+:attr:`~gol_tpu.telemetry.EventLog.observer`, so the counters can never
+disagree with the JSONL (one emission feeds both, asserted by the
+reconciliation tests).
+
+Everything is stdlib: :class:`MetricsServer` runs an
+``http.server.ThreadingHTTPServer`` on a daemon thread (rank 0 only —
+callers gate on ``jax.process_index()``), serving ``GET /metrics`` in
+Prometheus text exposition format (version 0.0.4).  Port 0 binds an
+ephemeral port (tests, parallel smokes); the bound port is printed and
+available as :attr:`MetricsServer.port`.
+
+Exported metrics (all ``gol_``-prefixed)::
+
+    gol_generation                current generation (gauge)
+    gol_chunks_total              executed chunks (counter)
+    gol_generations_total         generations stepped (counter)
+    gol_generations_per_sec       last chunk's take/wall (gauge)
+    gol_updates_per_sec           last chunk's cell-updates/s (gauge)
+    gol_population                last --stats population (gauge)
+    gol_activity_fraction         last activity block's fraction (gauge)
+    gol_checkpoints_total         snapshots written (counter)
+    gol_checkpoint_seconds_total  fenced checkpoint seconds (counter)
+    gol_span_seconds_total{phase} per-phase host span sums (counter, v6)
+    gol_preempts_total / gol_resumes_total / gol_restart_attempt
+    gol_run_finished              1 after the summary record (gauge)
+    gol_updates_per_sec_final     the summary's headline (gauge)
+
+Purity: the registry runs strictly host-side inside the emission path,
+which itself runs after the ``force_ready`` fences — the trace-identity
+pin covers metrics-on vs -off (tests/test_metrics.py).
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Dict, Optional
+
+
+class MetricsRegistry:
+    """Event-stream consumer maintaining the scrape counters.
+
+    Thread-safe: ``observe`` runs on the run loop's thread, ``render``
+    on HTTP handler threads.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.generation = 0
+        self.chunks_total = 0
+        self.generations_total = 0
+        self.generations_per_sec = 0.0
+        self.updates_per_sec = 0.0
+        self.population: Optional[int] = None
+        self.activity_fraction: Optional[float] = None
+        self.checkpoints_total = 0
+        self.checkpoint_seconds_total = 0.0
+        self.span_seconds: Dict[str, float] = {}
+        self.preempts_total = 0
+        self.resumes_total = 0
+        self.restart_attempt = 0
+        self.finished = False
+        self.updates_per_sec_final: Optional[float] = None
+
+    # -- write side (EventLog observer) -------------------------------------
+    def observe(self, rec: dict) -> None:
+        with self._lock:
+            event = rec.get("event")
+            if event == "chunk":
+                self.chunks_total += 1
+                self.generations_total += rec["take"]
+                self.generation = max(self.generation, rec["generation"])
+                self.updates_per_sec = rec["updates_per_sec"]
+                if rec["wall_s"] > 0:
+                    self.generations_per_sec = rec["take"] / rec["wall_s"]
+                act = rec.get("activity")
+                if act:
+                    self.activity_fraction = act.get("active_fraction")
+                for phase, secs in (rec.get("spans") or {}).items():
+                    self.span_seconds[phase] = (
+                        self.span_seconds.get(phase, 0.0) + secs
+                    )
+            elif event == "stats":
+                self.population = rec["population"]
+            elif event == "checkpoint":
+                self.checkpoints_total += 1
+                self.checkpoint_seconds_total += rec["wall_s"]
+            elif event == "preempt":
+                self.preempts_total += 1
+            elif event == "resume":
+                self.resumes_total += 1
+            elif event == "restart":
+                self.restart_attempt = rec["attempt"]
+            elif event == "summary":
+                self.finished = True
+                self.updates_per_sec_final = rec["updates_per_sec"]
+
+    # -- read side (HTTP) ----------------------------------------------------
+    def render(self) -> str:
+        """Prometheus text exposition format, one scrape's worth."""
+        with self._lock:
+            lines = []
+
+            def metric(name, mtype, help_, value):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {mtype}")
+                lines.append(f"{name} {value}")
+
+            metric(
+                "gol_generation", "gauge",
+                "Current generation of the run.", self.generation,
+            )
+            metric(
+                "gol_chunks_total", "counter",
+                "Executed chunks (guard replays included).",
+                self.chunks_total,
+            )
+            metric(
+                "gol_generations_total", "counter",
+                "Generations stepped.", self.generations_total,
+            )
+            metric(
+                "gol_generations_per_sec", "gauge",
+                "Last chunk's generations per second.",
+                self.generations_per_sec,
+            )
+            metric(
+                "gol_updates_per_sec", "gauge",
+                "Last chunk's cell updates per second.",
+                self.updates_per_sec,
+            )
+            if self.population is not None:
+                metric(
+                    "gol_population", "gauge",
+                    "Live cells at the last --stats chunk.",
+                    self.population,
+                )
+            if self.activity_fraction is not None:
+                metric(
+                    "gol_activity_fraction", "gauge",
+                    "Active tile-generation fraction of the last chunk.",
+                    self.activity_fraction,
+                )
+            metric(
+                "gol_checkpoints_total", "counter",
+                "Snapshots written.", self.checkpoints_total,
+            )
+            metric(
+                "gol_checkpoint_seconds_total", "counter",
+                "Fenced checkpoint seconds.",
+                self.checkpoint_seconds_total,
+            )
+            if self.span_seconds:
+                lines.append(
+                    "# HELP gol_span_seconds_total Host-side span seconds "
+                    "per phase (schema v6)."
+                )
+                lines.append("# TYPE gol_span_seconds_total counter")
+                for phase, secs in sorted(self.span_seconds.items()):
+                    lines.append(
+                        f'gol_span_seconds_total{{phase="{phase}"}} {secs}'
+                    )
+            metric(
+                "gol_preempts_total", "counter",
+                "Cooperative preemptions.", self.preempts_total,
+            )
+            metric(
+                "gol_resumes_total", "counter",
+                "Snapshot resumes.", self.resumes_total,
+            )
+            metric(
+                "gol_restart_attempt", "gauge",
+                "Supervised restart attempt number.", self.restart_attempt,
+            )
+            metric(
+                "gol_run_finished", "gauge",
+                "1 once the summary record landed.",
+                1 if self.finished else 0,
+            )
+            if self.updates_per_sec_final is not None:
+                metric(
+                    "gol_updates_per_sec_final", "gauge",
+                    "The run summary's headline cell-updates/s.",
+                    self.updates_per_sec_final,
+                )
+            return "\n".join(lines) + "\n"
+
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    registry: MetricsRegistry  # set by MetricsServer on the class copy
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.rstrip("/") not in ("", "/metrics"):
+            self.send_error(404, "only /metrics is served")
+            return
+        body = self.registry.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class MetricsServer:
+    """Threaded HTTP server bound to 127.0.0.1, serving one registry."""
+
+    def __init__(self, registry: MetricsRegistry, port: int) -> None:
+        handler = type("BoundHandler", (_Handler,), {"registry": registry})
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="gol-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+def serve_event_metrics(events, port: int, quiet: bool = False):
+    """Attach a registry + HTTP server to an :class:`EventLog`.
+
+    The server's lifetime is the event stream's: ``events.close()``
+    shuts it down.  Returns the registry (callers keep it for
+    reconciliation even after the server is gone).
+    """
+    registry = MetricsRegistry()
+    server = MetricsServer(registry, port)
+    events.observer = registry.observe
+    events.metrics_server = server
+    if not quiet:
+        print(
+            f"metrics: serving http://127.0.0.1:{server.port}/metrics",
+            flush=True,
+        )
+    return registry, server

@@ -183,6 +183,20 @@ def render_frame(w: Watcher, out) -> None:
                 f"over the last {len(recent)}",
                 file=out,
             )
+        spans = last.get("spans")
+        if spans:
+            # Schema-v6 span attribution, live: where the last chunk's
+            # host time went, as per-phase shares (same numbers
+            # summarize totals post-mortem).
+            total = sum(spans.values())
+            if total > 0:
+                parts = "  ".join(
+                    f"{phase} {100 * secs / total:.0f}%"
+                    for phase, secs in sorted(
+                        spans.items(), key=lambda kv: -kv[1]
+                    )
+                )
+                print(f"  spans: {parts}", file=out)
 
     stats = run.records("stats", rank=rank0)
     if stats:

@@ -450,7 +450,10 @@ def guarded_loop(
     ``chunk`` record per *executed* chunk — replays included, so the
     stream shows recovery work the phase totals hide — plus one
     ``guard_audit`` record per audit and one ``checkpoint`` record per
-    snapshot.  ``chunk_utilization(take, wall_s)`` maps a chunk to its
+    snapshot.  Chunk records carry a schema-v6 ``spans`` block:
+    dispatch/ready for the chunk itself plus the guard's boundary
+    phases (audit/redundant/snapshot/restore/checkpoint/telemetry/
+    preempt_poll) since the previous chunk event.  ``chunk_utilization(take, wall_s)`` maps a chunk to its
     roofline fraction (``None`` skips the column).  All emission is
     host-side, after the ``force_ready`` fences.
 
@@ -473,6 +476,15 @@ def guarded_loop(
     next_ckpt = (
         generation + checkpoint_every if checkpoint_every > 0 else None
     )
+    # Span attribution (schema v6): the guard adds its own phases
+    # (audit/redundant/snapshot/restore) to the chunk-loop spans; off
+    # (no events) the clock is never built.
+    import contextlib
+
+    sc = telemetry_mod.SpanClock() if events is not None else None
+
+    def _span(phase):
+        return sc.span(phase) if sc is not None else contextlib.nullcontext()
     i = 0
     restores_this_chunk = 0
     while i < len(schedule):
@@ -482,24 +494,30 @@ def guarded_loop(
             with sw.phase("total"):
                 t0 = time_mod.perf_counter()
                 candidate = compiled(board, *dynamic)
+                t1 = time_mod.perf_counter()
                 force_ready(candidate)
                 chunk_dt = time_mod.perf_counter() - t0
         if events is not None:
-            events.chunk_event(
-                i,
-                take,
-                generation + take,
-                chunk_dt,
-                int(candidate.size) * take,
-                None
-                if chunk_utilization is None
-                else chunk_utilization(take, chunk_dt),
-                restores_this_chunk=restores_this_chunk,
-            )
+            sc.add("dispatch", t1 - t0)
+            sc.add("ready", chunk_dt - (t1 - t0))
+            spans = sc.take()
+            with sc.span("telemetry"):
+                events.chunk_event(
+                    i,
+                    take,
+                    generation + take,
+                    chunk_dt,
+                    int(candidate.size) * take,
+                    None
+                    if chunk_utilization is None
+                    else chunk_utilization(take, chunk_dt),
+                    restores_this_chunk=restores_this_chunk,
+                    spans=spans,
+                )
         if config.fault_hook is not None:
             candidate = config.fault_hook(candidate, generation + take)
         with telemetry_mod.trace_annotation("gol.guard.audit"):
-            with sw.phase("audit"):
+            with sw.phase("audit"), _span("audit"):
                 audit = audit_board(candidate, generation + take)
         # Sampling keys on the stable chunk index, so a sampled chunk's
         # replays — after either a cheap-audit or a recompute failure —
@@ -513,7 +531,7 @@ def guarded_loop(
             # only agree if neither run was corrupted.
             comp2, dyn2 = checker_evolvers[take]
             with telemetry_mod.trace_annotation("gol.guard.redundant"):
-                with sw.phase("redundant"):
+                with sw.phase("redundant"), _span("redundant"):
                     reference = comp2(_device_copy(last_good[0]), *dyn2)
                     audit2 = audit_board(reference, generation + take)
             audit = dataclasses.replace(
@@ -523,7 +541,8 @@ def guarded_loop(
             )
         guard.audits.append(audit)
         if events is not None:
-            events.guard_event(audit)
+            with _span("telemetry"):
+                events.guard_event(audit)
         if not audit.ok:
             guard.failures += 1
             restores_this_chunk += 1
@@ -544,7 +563,7 @@ def guarded_loop(
             guard.restores += 1
             with telemetry_mod.trace_annotation(
                 "gol.guard.restore"
-            ), sw.phase("restore"):
+            ), sw.phase("restore"), _span("restore"):
                 # Copy again: the replayed chunk donates its input, and
                 # the last-good buffer must survive for further replays.
                 board = _device_copy(last_good[0])
@@ -561,7 +580,7 @@ def guarded_loop(
         restores_this_chunk = 0
         board = candidate
         generation += take
-        with sw.phase("snapshot"):
+        with sw.phase("snapshot"), _span("snapshot"):
             # audit.fingerprint is this exact board's stamp (just computed
             # on device) — recorded for the base-integrity check above.
             last_good = (_device_copy(board), generation, audit.fingerprint)
@@ -575,19 +594,24 @@ def guarded_loop(
                     t0 = time_mod.perf_counter()
                     save_snapshot(board, generation, audit.fingerprint)
                     ckpt_dt = time_mod.perf_counter() - t0
+            if sc is not None:
+                sc.add("checkpoint", ckpt_dt)
             if events is not None:
-                events.checkpoint_event(
-                    generation,
-                    ckpt_dt,
-                    int(board.size),
-                    overlapped=checkpoint_overlapped,
-                )
+                with _span("telemetry"):
+                    events.checkpoint_event(
+                        generation,
+                        ckpt_dt,
+                        int(board.size),
+                        overlapped=checkpoint_overlapped,
+                    )
             next_ckpt = generation + checkpoint_every
             just_checkpointed = True
         if preempt_hook is not None and i < len(schedule) - 1:
             from gol_tpu import resilience
 
-            if resilience.agreed_preempt_requested():
+            with _span("preempt_poll"):
+                preempt_now = resilience.agreed_preempt_requested()
+            if preempt_now:
                 preempt_hook(
                     board, generation, audit.fingerprint, just_checkpointed
                 )

@@ -18,26 +18,31 @@
 #   7. sparse smoke    (activity-gated glider-gun run bit-equal to the
 #                       dense bitpack tier AND skipping >0 tiles, with
 #                       v5 activity telemetry present)
-#   8. tier-1 tests    (the exact ROADMAP.md command)
+#   8. obs smoke       (run with --metrics-port + v6 spans: scrape the
+#                       live Prometheus endpoint mid-run, reconcile it
+#                       with the JSONL, summarize the span table, and
+#                       run `ledger check` against the committed
+#                       PERF_LEDGER.jsonl regression gate)
+#   9. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/8] lint =="
+echo "== [1/9] lint =="
 bash scripts/lint.sh
 
-echo "== [2/8] static verifier (gol_tpu.analysis) =="
+echo "== [2/9] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/8] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/9] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/8] stats smoke (in-graph simulation statistics) =="
+echo "== [4/9] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -46,16 +51,19 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/8] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/9] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/8] batch smoke (docs/BATCHING.md) =="
+echo "== [6/9] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/8] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/9] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/8] tier-1 tests =="
+echo "== [8/9] obs smoke (docs/OBSERVABILITY.md) =="
+JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+echo "== [9/9] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

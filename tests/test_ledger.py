@@ -1,0 +1,279 @@
+"""Cross-run perf ledger: ingestion adapters, the regression gate, and
+the ``summarize --ledger`` anomaly (docs/OBSERVABILITY.md).
+
+The committed ``PERF_LEDGER.jsonl`` is itself a fixture here: the gate
+must pass on it at HEAD (the acceptance baseline) and must fail on a
+copy with an injected >20% slow record — a gate that has never fired is
+a gate that does not work.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import ledger as ledger_mod
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+LEDGER = REPO / "PERF_LEDGER.jsonl"
+
+
+# -- adapters over the committed artifacts -----------------------------------
+
+
+def test_bench_adapter_recovers_truncated_r05_claims():
+    recs = ledger_mod.normalize_artifact(str(REPO / "BENCH_r05.json"))
+    fps = {r["fingerprint"] for r in recs}
+    assert "bench:tpu:flagship_2d:16384^2x10240" in fps
+    flag = next(
+        r for r in recs
+        if r["fingerprint"] == "bench:tpu:flagship_2d:16384^2x10240"
+    )
+    assert flag["value"] > 1.9e12 and flag["mfu"] == 0.663
+    assert flag["backend"] == "tpu" and flag["round"] == 5
+
+
+def test_bench_adapter_parses_intact_tails():
+    recs = ledger_mod.normalize_artifact(str(REPO / "BENCH_r03.json"))
+    assert len(recs) == 1
+    assert recs[0]["mfu"] == 0.646
+    assert recs[0]["kind"] == "throughput"
+
+
+def test_batch_sparse_adapters_are_cpu_rows():
+    batch = ledger_mod.normalize_artifact(str(REPO / "BATCH_r06.json"))
+    sparse = ledger_mod.normalize_artifact(str(REPO / "SPARSE_r07.json"))
+    assert all(r["backend"] == "cpu" for r in batch + sparse)
+    assert any("B64" in r["fingerprint"] or "B16" in r["fingerprint"]
+               for r in batch)
+    assert all(
+        r["extra"]["speedup_vs_dense"] is not None for r in sparse
+    )
+
+
+def test_halo_adapter_is_attribution_never_gated():
+    recs = ledger_mod.normalize_artifact(str(REPO / "HALO_r05.json"))
+    assert recs and all(r["kind"] == "attribution" for r in recs)
+    assert all(r["direction"] == "lower" for r in recs)
+    # Attribution records never enter the gate, even on their backend.
+    assert ledger_mod.check_records(recs, backends=("all",)) == []
+
+
+def test_scale_and_multichip_adapters():
+    scale = ledger_mod.normalize_artifact(str(REPO / "SCALE_r05.json"))
+    assert any(r["fingerprint"].startswith("scale:tpu:") for r in scale)
+    multi = ledger_mod.normalize_artifact(str(REPO / "MULTICHIP_r05.json"))
+    assert multi[0]["kind"] == "equivalence" and multi[0]["value"] == 1.0
+
+
+def test_header_stamped_artifact_routes_by_tool(tmp_path):
+    payload = {
+        "header": {"schema": ledger_mod.ARTIFACT_SCHEMA,
+                   "tool": "batchbench", "backend": "cpu", "argv": []},
+        "backend": "cpu",
+        "size": 64,
+        "iters": 32,
+        "rows": [
+            {"B": 2, "engine": "bitpack",
+             "aggregate_updates_per_sec": 1e9,
+             "per_world_updates_per_sec": 5e8,
+             "per_world_speedup_vs_sequential": 1.5},
+        ],
+    }
+    path = tmp_path / "custom_r09.json"
+    path.write_text(json.dumps(payload))
+    recs = ledger_mod.normalize_artifact(str(path))
+    assert len(recs) == 1 and recs[0]["tool"] == "batchbench"
+    assert recs[0]["round"] == 9
+
+
+def test_unknown_artifact_raises(tmp_path):
+    path = tmp_path / "junk.json"
+    path.write_text(json.dumps({"hello": 1}))
+    try:
+        ledger_mod.normalize_artifact(str(path))
+    except telemetry.SchemaError:
+        return
+    raise AssertionError("unrecognized artifact did not raise")
+
+
+# -- telemetry-directory ingestion -------------------------------------------
+
+
+def _tiny_run(tmp_path, run_id="ledg", rate=5e7):
+    with telemetry.EventLog(
+        str(tmp_path), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "2d", "engine": "auto", "resolved_engine": "bitpack",
+             "height": 64, "width": 64, "mesh": None}
+        )
+        ev.chunk_event(0, 8, 8, 0.001, int(rate / 1000), 0.001)
+        ev.emit(
+            "summary", duration_s=0.001, cell_updates=int(rate / 1000),
+            updates_per_sec=rate, phases={"total": 0.001},
+        )
+
+
+def test_telemetry_dir_ingests_to_one_record_per_run(tmp_path):
+    _tiny_run(tmp_path)
+    recs = ledger_mod.normalize_telemetry_dir(str(tmp_path))
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["tool"] == "telemetry"
+    assert rec["fingerprint"] == "telemetry:cpu:2d:bitpack:64x64:meshnone"
+    assert rec["value"] == 5e7
+    assert rec["mfu"] == 0.001
+
+
+def test_ingest_is_idempotent(tmp_path):
+    run_dir = tmp_path / "runs"
+    run_dir.mkdir()
+    _tiny_run(run_dir)
+    ledger = tmp_path / "L.jsonl"
+    added, skipped = ledger_mod.append_records(
+        str(ledger), ledger_mod.normalize(str(run_dir))
+    )
+    assert (added, skipped) == (1, 0)
+    added, skipped = ledger_mod.append_records(
+        str(ledger), ledger_mod.normalize(str(run_dir))
+    )
+    assert (added, skipped) == (0, 1)
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def test_check_passes_on_committed_ledger(capsys):
+    assert LEDGER.exists(), "PERF_LEDGER.jsonl must be committed at HEAD"
+    rc = summ_mod.main(["ledger", "check", "--ledger", str(LEDGER)])
+    assert rc == 0
+    assert "no regression" in capsys.readouterr().out
+
+
+def test_check_flags_injected_slow_record(tmp_path, capsys):
+    records = ledger_mod.read_ledger(str(LEDGER))
+    baseline = next(
+        r for r in records
+        if r["fingerprint"] == "bench:tpu:flagship_2d:16384^2x10240"
+    )
+    bad = dict(baseline)
+    bad["value"] = baseline["value"] * 0.5  # a 50% collapse
+    bad["source"] = "BENCH_r99.json"
+    inj = tmp_path / "inj.jsonl"
+    shutil.copy(LEDGER, inj)
+    with open(inj, "a") as f:
+        f.write(json.dumps(bad) + "\n")
+    rc = summ_mod.main(["ledger", "check", "--ledger", str(inj)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "flagship_2d" in out
+
+
+def test_check_tolerates_historical_dips():
+    # A dip BETWEEN best and newest is history, not a live regression.
+    recs = [
+        ledger_mod._record("f:tpu:x", v, "u", f"s{i}", "t", "tpu")
+        for i, v in enumerate([100.0, 60.0, 95.0])
+    ]
+    assert ledger_mod.check_records(recs) == []
+    # ...but a slow NEWEST record fails.
+    recs.append(ledger_mod._record("f:tpu:x", 60.0, "u", "s3", "t", "tpu"))
+    assert len(ledger_mod.check_records(recs)) == 1
+
+
+def test_check_gates_tpu_only_by_default():
+    recs = [
+        ledger_mod._record("f:cpu:x", 100.0, "u", "s0", "t", "cpu"),
+        ledger_mod._record("f:cpu:x", 10.0, "u", "s1", "t", "cpu"),
+    ]
+    assert ledger_mod.check_records(recs) == []
+    assert len(ledger_mod.check_records(recs, backends=("all",))) == 1
+
+
+def test_check_lower_is_better_direction():
+    recs = [
+        ledger_mod._record(
+            "h:tpu:x", 1.0, "s", "s0", "t", "tpu",
+            kind="throughput", direction="lower",
+        ),
+        ledger_mod._record(
+            "h:tpu:x", 1.5, "s", "s1", "t", "tpu",
+            kind="throughput", direction="lower",
+        ),
+    ]
+    assert len(ledger_mod.check_records(recs)) == 1
+
+
+def test_equivalence_flip_is_a_regression():
+    recs = [
+        ledger_mod._record(
+            "m:tpu:8dev", 1.0, "ok", "s0", "t", "tpu", kind="equivalence"
+        ),
+        ledger_mod._record(
+            "m:tpu:8dev", 0.0, "ok", "s1", "t", "tpu", kind="equivalence"
+        ),
+    ]
+    assert len(ledger_mod.check_records(recs)) == 1
+
+
+# -- summarize --ledger anomaly ----------------------------------------------
+
+
+def test_summarize_flags_regression_against_ledger(tmp_path, capsys):
+    run_dir = tmp_path / "runs"
+    run_dir.mkdir()
+    _tiny_run(run_dir, rate=5e7)
+    ledger = tmp_path / "L.jsonl"
+    best = ledger_mod._record(
+        "telemetry:cpu:2d:bitpack:64x64:meshnone", 5e8, "cell-updates/s",
+        "runs/old", "telemetry", "cpu",
+    )
+    ledger.write_text(json.dumps(best) + "\n")
+    rc = summ_mod.main(
+        ["summarize", str(run_dir), "--ledger", str(ledger)]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ANOMALY: regression" in out
+
+
+def test_summarize_quiet_when_within_threshold(tmp_path, capsys):
+    run_dir = tmp_path / "runs"
+    run_dir.mkdir()
+    _tiny_run(run_dir, rate=5e7)
+    ledger = tmp_path / "L.jsonl"
+    best = ledger_mod._record(
+        "telemetry:cpu:2d:bitpack:64x64:meshnone", 5.5e7, "cell-updates/s",
+        "runs/old", "telemetry", "cpu",
+    )
+    ledger.write_text(json.dumps(best) + "\n")
+    rc = summ_mod.main(
+        ["summarize", str(run_dir), "--ledger", str(ledger)]
+    )
+    assert rc == 0
+    assert "regression" not in capsys.readouterr().out
+
+
+def test_show_renders_trends(capsys):
+    rc = summ_mod.main(["ledger", "show", "--ledger", str(LEDGER)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "best" in out and "flagship_2d" in out
+
+
+def test_ledger_rejects_bad_lines(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"ledger": 1}\n')
+    try:
+        ledger_mod.read_ledger(str(bad))
+    except telemetry.SchemaError:
+        return
+    raise AssertionError("invalid ledger line did not raise")

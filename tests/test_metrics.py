@@ -1,0 +1,265 @@
+"""Live metrics endpoint (--metrics-port; docs/OBSERVABILITY.md).
+
+- the registry is a faithful event-stream consumer (counters per type);
+- the HTTP server serves parseable Prometheus text exposition format;
+- a real run is scraped **mid-run** and its counters reconcile with the
+  run's final JSONL telemetry (one emission feeds both — they cannot
+  drift);
+- trace identity: metrics-on and metrics-off runtimes trace
+  byte-identical jaxprs (the knob is host-side by construction);
+- the CLI rejects --metrics-port without --telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+
+from gol_tpu.models.state import Geometry
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.telemetry import metrics as metrics_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.e+-]+|NaN)$"
+)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Exposition-format parser: {metric_name[{labels}]: float}.
+
+    Strict enough to fail on anything a real scraper would reject:
+    every non-comment line must be `name[{labels}] value`.
+    """
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        out[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return out
+
+
+def scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5.0
+    ) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        return resp.read().decode()
+
+
+# -- registry unit ------------------------------------------------------------
+
+
+def test_registry_consumes_the_event_stream():
+    reg = metrics_mod.MetricsRegistry()
+    reg.observe(
+        {"event": "chunk", "index": 0, "take": 8, "generation": 8,
+         "wall_s": 0.5, "updates_per_sec": 1e6, "roofline_util": None,
+         "spans": {"dispatch": 0.1, "ready": 0.4}}
+    )
+    reg.observe(
+        {"event": "chunk", "index": 1, "take": 8, "generation": 16,
+         "wall_s": 0.4, "updates_per_sec": 2e6, "roofline_util": None,
+         "spans": {"dispatch": 0.1, "ready": 0.3},
+         "activity": {"active_fraction": 0.25}}
+    )
+    reg.observe({"event": "stats", "population": 42, "take": 8,
+                 "index": 1, "generation": 16})
+    reg.observe({"event": "checkpoint", "generation": 16, "wall_s": 0.01})
+    reg.observe({"event": "summary", "updates_per_sec": 1.5e6})
+    vals = parse_prometheus(reg.render())
+    assert vals["gol_generation"] == 16
+    assert vals["gol_chunks_total"] == 2
+    assert vals["gol_generations_total"] == 16
+    assert vals["gol_generations_per_sec"] == 8 / 0.4
+    assert vals["gol_population"] == 42
+    assert vals["gol_activity_fraction"] == 0.25
+    assert vals["gol_checkpoints_total"] == 1
+    assert vals['gol_span_seconds_total{phase="dispatch"}'] == 0.2
+    assert vals['gol_span_seconds_total{phase="ready"}'] == 0.7
+    assert vals["gol_run_finished"] == 1
+    assert vals["gol_updates_per_sec_final"] == 1.5e6
+
+
+def test_server_serves_and_404s(tmp_path):
+    reg = metrics_mod.MetricsRegistry()
+    srv = metrics_mod.MetricsServer(reg, 0)
+    try:
+        vals = parse_prometheus(scrape(srv.port))
+        assert vals["gol_generation"] == 0
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/other", timeout=5.0
+            )
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("/other did not 404")
+    finally:
+        srv.close()
+
+
+# -- mid-run scrape + reconciliation -----------------------------------------
+
+
+def test_midrun_scrape_reconciles_with_final_jsonl(tmp_path):
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="bitpack",
+        checkpoint_every=8,
+        checkpoint_dir=str(tmp_path / "ck"),
+        telemetry_dir=str(tmp_path / "t"),
+        run_id="mscrape",
+        stats=True,
+        metrics_port=0,
+    )
+    iterations = 4096
+    done = threading.Event()
+    errors = []
+
+    def run():
+        try:
+            rt.run(pattern=6, iterations=iterations)
+        except Exception as e:  # surfaces in the main thread's assert
+            errors.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    mid = None
+    while not done.is_set():
+        if rt._metrics_server is None:
+            time.sleep(0.005)
+            continue
+        try:
+            vals = parse_prometheus(scrape(rt._metrics_server.port))
+        except OSError:
+            time.sleep(0.005)
+            continue
+        if vals.get("gol_generation", 0) > 0 and not vals.get(
+            "gol_run_finished"
+        ):
+            mid = vals
+            break
+        time.sleep(0.005)
+    t.join(timeout=300)
+    assert not errors, errors
+    assert mid is not None, "never scraped the endpoint mid-run"
+
+    recs = [
+        json.loads(ln)
+        for ln in open(pathlib.Path(tmp_path) / "t" / "mscrape.rank0.jsonl")
+    ]
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    stats = [r for r in recs if r["event"] == "stats"]
+    # The mid-run scrape saw a generation the JSONL also recorded.
+    assert mid["gol_generation"] in {c["generation"] for c in chunks}
+    # The registry's final state reconciles exactly with the stream.
+    reg = rt.last_metrics
+    assert reg is not None
+    assert reg.generation == chunks[-1]["generation"] == iterations
+    assert reg.chunks_total == len(chunks)
+    assert reg.generations_total == sum(c["take"] for c in chunks)
+    assert reg.population == stats[-1]["population"]
+    assert reg.checkpoints_total == len(
+        [r for r in recs if r["event"] == "checkpoint"]
+    )
+    assert reg.finished
+    spans_total = {}
+    for c in chunks:
+        for phase, secs in c["spans"].items():
+            spans_total[phase] = spans_total.get(phase, 0.0) + secs
+    for phase, secs in spans_total.items():
+        assert abs(reg.span_seconds[phase] - secs) < 1e-9
+    # The server died with the event log.
+    assert rt.last_metrics is not None
+
+
+# -- trace identity -----------------------------------------------------------
+
+
+def test_metrics_knob_never_changes_the_traced_program(tmp_path):
+    from gol_tpu.analysis import walker
+
+    for engine in ("dense", "bitpack"):
+        kw = dict(geometry=Geometry(size=64, num_ranks=1), engine=engine)
+        rt_off = GolRuntime(**kw)
+        rt_on = GolRuntime(
+            **kw,
+            telemetry_dir=str(tmp_path / "ti"),
+            run_id="ti",
+            metrics_port=0,
+        )
+        spec = jax.ShapeDtypeStruct((64, 64), np.uint8)
+        jaxprs = []
+        for rt in (rt_off, rt_on):
+            fn, dynamic, static = rt._evolve_fn(4)
+            jaxprs.append(
+                str(walker.trace_jaxpr(fn, spec, *dynamic, *static))
+            )
+        assert jaxprs[0] == jaxprs[1], f"engine {engine} trace diverged"
+
+
+def test_metrics_run_bit_identical_board(tmp_path):
+    kw = dict(geometry=Geometry(size=64, num_ranks=1), engine="bitpack")
+    _, plain = GolRuntime(**kw).run(pattern=6, iterations=16)
+    _, metered = GolRuntime(
+        **kw,
+        telemetry_dir=str(tmp_path / "t"),
+        run_id="bits",
+        metrics_port=0,
+    ).run(pattern=6, iterations=16)
+    assert np.array_equal(
+        np.asarray(plain.board), np.asarray(metered.board)
+    )
+
+
+# -- CLI validation -----------------------------------------------------------
+
+
+def test_cli_rejects_metrics_port_without_telemetry(capsys):
+    from gol_tpu import cli
+
+    rc = cli.main(["0", "64", "4", "512", "0", "--metrics-port", "0"])
+    assert rc == 255
+    assert "--telemetry" in capsys.readouterr().out
+
+
+def test_cli_rejects_out_of_range_port(capsys):
+    from gol_tpu import cli
+
+    rc = cli.main(
+        ["0", "64", "4", "512", "0", "--telemetry", "/tmp/x",
+         "--metrics-port", "70000"]
+    )
+    assert rc == 255
+    assert "0..65535" in capsys.readouterr().out
+
+
+def test_batch_runtime_serves_metrics(tmp_path):
+    from gol_tpu.batch import GolBatchRuntime
+
+    rng = np.random.default_rng(1)
+    worlds = [(rng.random((64, 64)) < 0.3).astype(np.uint8)] * 2
+    brt = GolBatchRuntime(
+        worlds=worlds,
+        telemetry_dir=str(tmp_path / "t"),
+        run_id="bmx",
+        metrics_port=0,
+    )
+    brt.run(8)
+    reg = brt.last_metrics
+    assert reg is not None
+    assert reg.generation == 8
+    assert reg.finished
