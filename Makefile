@@ -1,11 +1,11 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
-# resilience drill + batch smoke + sparse smoke + obs smoke + tier-1
-# tests (see scripts/check.sh).
+# resilience drill + batch smoke + sparse smoke + obs smoke + reshard
+# smoke + tier-1 tests (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
-	obs-smoke ledger-check
+	obs-smoke ledger-check reshard-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -74,6 +74,12 @@ obs-smoke:
 ledger-check:
 	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry ledger check \
 	    --ledger PERF_LEDGER.jsonl
+
+# Elastic-mesh smoke (docs/RESILIENCE.md): a 2-D-block sharded snapshot
+# resumed on a 1-D ring bit-equal to a straight run, with a
+# non-identity plan and the schema-v7 reshard event stamped.
+reshard-smoke:
+	JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
 check:
 	bash scripts/check.sh

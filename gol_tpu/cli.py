@@ -143,6 +143,21 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     # code 75 (EX_TEMPFAIL: preempted, resumable).
     ext.add_argument("--auto-resume", action="store_true")
     ext.add_argument("--keep-snapshots", type=int, default=3, metavar="K")
+    # Elastic meshes (docs/RESILIENCE.md): --allow-shrink lets a run
+    # whose board cannot tile every visible device proceed on the
+    # largest device count it divides (the degraded-pod relaunch path;
+    # supervised children get it via GOL_ALLOW_SHRINK=1).
+    # --sharded-snapshots writes the piece-table checkpoint directory
+    # format even single-process.  --reshard-at GEN stops at the first
+    # chunk boundary reaching GEN, snapshots, and continues the
+    # remaining generations on --reshard-mesh — the in-flight reshard
+    # drill knob (resume-on-a-new-mesh without leaving the process).
+    ext.add_argument("--allow-shrink", action="store_true")
+    ext.add_argument("--sharded-snapshots", action="store_true")
+    ext.add_argument("--reshard-at", type=int, default=0, metavar="GEN")
+    ext.add_argument(
+        "--reshard-mesh", choices=["none", "1d", "2d"], default=None
+    )
     # Multi-host (the `mpirun -np N` analog): connect this process to the
     # job before any device work; the mesh then spans the whole pod.
     from gol_tpu.parallel.multihost import add_multihost_args
@@ -372,6 +387,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"--keep-snapshots must be >= 0, got {ns.keep_snapshots} "
                 "(0 keeps every snapshot)"
             )
+        if ns.reshard_at < 0:
+            raise ValueError(
+                f"--reshard-at must be >= 0, got {ns.reshard_at} "
+                "(0 disables the in-flight reshard stop)"
+            )
+        if ns.reshard_at > 0:
+            if ns.reshard_mesh is None:
+                raise ValueError(
+                    "--reshard-at stops to continue on a new topology; "
+                    "pass --reshard-mesh {none,1d,2d}"
+                )
+            if topo.process_count > 1:
+                raise ValueError(
+                    "--reshard-at is single-process (a multi-host job "
+                    "reshapes by relaunching under --auto-resume)"
+                )
+            if ns.guard_every > 0:
+                raise ValueError(
+                    "--reshard-at applies to unguarded runs; drop "
+                    "--guard-every"
+                )
+            if ns.batch:
+                raise ValueError(
+                    "--reshard-at applies to single-world runs; drop "
+                    "--batch"
+                )
+            if ns.halo != "fresh":
+                raise ValueError(
+                    "--reshard-at runs fresh halos only (stale_t0 worlds "
+                    "are single-device by definition)"
+                )
+            # The stop writes through a snapshot; give it a home.
+            ns.checkpoint_dir = ns.checkpoint_dir or "checkpoints"
+        elif ns.reshard_mesh is not None:
+            raise ValueError(
+                "--reshard-mesh names the post-stop topology; pass "
+                "--reshard-at GEN"
+            )
+        if ns.sharded_snapshots and ns.mesh == "none" and not ns.reshard_at:
+            raise ValueError(
+                "--sharded-snapshots writes the piece-table directory "
+                "format, which shards over a mesh; pass --mesh 1d/2d"
+            )
         if ns.batch < 0:
             raise ValueError(f"--batch must be >= 0, got {ns.batch}")
         if ns.batch_sizes and not ns.batch:
@@ -485,28 +543,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ns, batch_sizes, resume, resume_info, iterations, restart_attempt
         )
 
-    try:
-        rt = GolRuntime(
+    # Elastic shrink policy (docs/RESILIENCE.md): opt in via the flag or
+    # the supervisor's environment export, so a supervised relaunch that
+    # comes up with a device count the board cannot tile proceeds on a
+    # smaller mesh instead of crashing its restart budget.
+    allow_shrink = ns.allow_shrink or (
+        os.environ.get("GOL_ALLOW_SHRINK") == "1"
+    )
+    board_shape = (ns.world_size * ns.ranks, ns.world_size)
+
+    def make_runtime(mesh_kind, run_id, reshard_at, rt_resume_info):
+        return GolRuntime(
             geometry=geom,
             engine=ns.engine,
             halo_mode=ns.halo,
             tile_hint=ns.threads,
             checkpoint_every=ns.checkpoint_every,
             checkpoint_dir=ns.checkpoint_dir,
-            mesh=build_mesh(ns.mesh),
+            mesh=build_mesh(
+                mesh_kind, shape=board_shape, allow_shrink=allow_shrink
+            ),
             shard_mode=ns.shard_mode,
             halo_depth=ns.halo_depth,
             rule=ns.rule,
             telemetry_dir=ns.telemetry,
-            run_id=ns.run_id,
+            run_id=run_id,
             stats=ns.stats,
             keep_snapshots=ns.keep_snapshots,
             restart_attempt=restart_attempt,
-            resume_info=resume_info,
+            resume_info=rt_resume_info,
             activity_tile=ns.activity_tile,
             activity_capacity=ns.activity_capacity,
             metrics_port=ns.metrics_port,
+            reshard_at=reshard_at,
+            sharded_snapshots=ns.sharded_snapshots,
         )
+
+    try:
+        rt = make_runtime(ns.mesh, ns.run_id, ns.reshard_at, resume_info)
         guard_report = None
         with resilience.preemption_guard():
             if ns.guard_every > 0:
@@ -530,12 +604,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     resume=resume,
                 )
             else:
-                report, final_state = rt.run(
-                    pattern=ns.pattern,
-                    iterations=iterations,
-                    resume=resume,
-                    profile_dir=ns.profile,
-                )
+                try:
+                    report, final_state = rt.run(
+                        pattern=ns.pattern,
+                        iterations=iterations,
+                        resume=resume,
+                        profile_dir=ns.profile,
+                    )
+                except resilience.ReshardPoint as rp:
+                    # In-flight reshard (--reshard-at): the run stopped
+                    # at a chunk boundary through a snapshot; replan and
+                    # finish the remaining generations on the new mesh
+                    # in this same process.  The resumed runtime detects
+                    # the topology change itself and stamps the v7
+                    # reshard telemetry event.
+                    if topo.is_coordinator:
+                        print(
+                            f"reshard: generation {rp.generation}, mesh "
+                            f"{ns.mesh} -> {ns.reshard_mesh} "
+                            f"({rp.remaining} generations remain)"
+                        )
+                    rt = make_runtime(
+                        ns.reshard_mesh,
+                        f"{ns.run_id}-reshard" if ns.run_id else None,
+                        0,
+                        None,
+                    )
+                    report, final_state = rt.run(
+                        pattern=ns.pattern,
+                        iterations=rp.remaining,
+                        resume=rp.snapshot_path,
+                        profile_dir=None,
+                    )
     except resilience.Preempted as e:
         # NOT the error path: the run stopped cleanly at a chunk
         # boundary with a resumable snapshot.  EX_TEMPFAIL tells a
@@ -562,6 +662,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "resume from it, or rerun with --auto-resume to "
                     "select it (and fall back) automatically"
                 )
+        elif ns.resume and (
+            "not divisible by mesh" in str(e)
+            or "does not divide" in str(e)
+            or "empty shards" in str(e)
+        ):
+            # Topology mismatch on a plain --resume: the board in the
+            # snapshot cannot tile the requested mesh.  Resharding is
+            # automatic on any mesh that CAN tile it — say so instead
+            # of leaving the raw divisibility error as the last word.
+            hint = resilience.topology_resume_hint(ns.resume, kind="2d")
+            if hint:
+                print(hint)
         return 255
 
     # Rank 0's report (gol-main.c:121-128) + closing banner (gol-main.c:132);

@@ -849,6 +849,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "resume from it, or rerun with --auto-resume to "
                     "select it (and fall back) automatically"
                 )
+        elif ns.resume and (
+            "not divisible" in str(e)
+            or "does not divide" in str(e)
+            or "divisible by" in str(e)
+        ):
+            # Topology mismatch on a plain 3-D --resume: unlike the 2-D
+            # driver there is no reshard path — the hint names the
+            # writing topology instead (docs/RESILIENCE.md).
+            hint = resilience.topology_resume_hint(ns.resume, kind="3d")
+            if hint:
+                print(hint)
         return 255
     finally:
         if ckpt_writer is not None:

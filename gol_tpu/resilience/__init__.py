@@ -26,11 +26,21 @@ trace-identity tests).
 from gol_tpu.resilience.preempt import (  # noqa: F401
     EX_TEMPFAIL,
     Preempted,
+    ReshardPoint,
     agreed_preempt_requested,
     clear_preemption,
     preempt_requested,
     preemption_guard,
     request_preemption,
+)
+from gol_tpu.resilience.reshard import (  # noqa: F401
+    MeshLayout,
+    ReshardError,
+    ReshardPlanError,
+    load_resharded,
+    plan_reshard,
+    topology_resume_hint,
+    validate_plan,
 )
 from gol_tpu.resilience.resume import (  # noqa: F401
     corrupt_resume_hint,

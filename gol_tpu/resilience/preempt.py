@@ -52,6 +52,28 @@ class Preempted(Exception):
         )
 
 
+class ReshardPoint(Exception):
+    """An in-flight reshard stop: the chunked loop checkpointed at a chunk
+    boundary so the driver can replan and reload on a different mesh.
+
+    Rides the same chunk-boundary plumbing as :class:`Preempted` (board
+    whole, fenced, snapshot durably renamed before the raise) but means
+    "continue me on the new topology *now*, in this process", not "exit
+    75 and wait for a relaunch".  Like ``Preempted`` it is deliberately
+    not a ``ValueError`` — the CLIs' clean-error handlers must never eat
+    it.
+    """
+
+    def __init__(self, generation: int, snapshot_path: str, remaining: int):
+        self.generation = generation
+        self.snapshot_path = snapshot_path
+        self.remaining = remaining  # generations still owed after the stop
+        super().__init__(
+            f"reshard point at generation {generation} "
+            f"({remaining} generations remaining; snapshot {snapshot_path})"
+        )
+
+
 _flag = threading.Event()
 
 

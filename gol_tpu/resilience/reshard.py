@@ -1,0 +1,658 @@
+"""Elastic meshes: load any snapshot onto any other supported topology.
+
+The PR 4 resilience tier made snapshots *survivable*; this module makes
+them *portable*.  A checkpoint written by a 2-D-block pod run can resume
+on a 1-D ring, a single chip, or a bigger pod — and vice versa — by
+repartitioning the stored pieces onto the destination mesh's shard
+boxes (docs/RESILIENCE.md, "Elastic meshes").  Three pieces:
+
+- :class:`MeshLayout` — the portable topology descriptor (``none`` /
+  ``1d`` / ``2d`` plus the rows×cols grid) stamped into sharded
+  manifests by :func:`gol_tpu.utils.checkpoint.save_sharded` and
+  inferred from the piece table for pre-stamp (``legacy``) snapshots.
+- :class:`ReshardPlan` — the explicit src-piece → dst-shard move table.
+  :func:`plan_reshard` builds it from pure geometry and
+  :func:`validate_plan` proves every destination cell is covered by
+  **exactly one** source intersection (the soundness property the
+  static verifier's broken-fixture check keeps honest —
+  ``gol_tpu/analysis/reshardcheck.py``).
+- :class:`SnapshotSource` — a uniform read surface over every snapshot
+  format (single-file, 1-D row-sharded, 2-D block-sharded, batch
+  worlds).  Pieces are cached **bit-packed** (32 cells per uint32 word,
+  the :mod:`gol_tpu.ops.bitlife` layout) so serving a full cross-read —
+  every destination shard touching every source piece — holds 1 bit per
+  cell, not 1 byte, and the full dense board is never assembled unless
+  the destination *is* one device.  Destination column ranges that cut
+  a source piece mid-word are realigned with word shifts
+  (:func:`slice_packed_cols` — the roll/mask repack), not by unpacking
+  whole pieces; only the requested cells ever widen back to uint8.
+  This is the host-side analog of the memory-efficient redistribution
+  collective (PAPERS.md): bounded transport state, piecewise moves.
+
+Resume-on-a-different-mesh is pinned byte-identical to same-mesh resume
+(tests/test_reshard.py); when source and destination topologies match,
+the plan is the identity and nothing here runs at all — the
+trace-identity pins still hold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gol_tpu.utils import checkpoint as ckpt_mod
+
+Box = Tuple[int, int, int, int]  # (r0, r1, c0, c1), half-open
+
+WORD_BITS = 32
+
+
+class ReshardError(ValueError):
+    """A snapshot cannot be repartitioned onto the requested topology."""
+
+
+class ReshardPlanError(ReshardError):
+    """A move table fails the exactly-once coverage property."""
+
+
+# -- topology descriptor ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """Portable shard-topology descriptor: how a board tiles over devices.
+
+    ``kind`` is the CLI's mesh vocabulary (``none``/``1d``/``2d``);
+    ``rows``/``cols`` the device grid.  The descriptor is deliberately
+    device-free — it survives in manifests and telemetry, and two runs
+    with the same layout produce identical shard boxes regardless of
+    which physical chips back them.
+    """
+
+    kind: str
+    rows: int = 1
+    cols: int = 1
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("none", "1d", "2d"):
+            raise ReshardError(
+                f"unknown mesh layout kind {self.kind!r}; expected "
+                "'none'/'1d'/'2d'"
+            )
+        if self.rows < 1 or self.cols < 1:
+            raise ReshardError(
+                f"mesh layout needs positive grid extents, got "
+                f"{self.rows}x{self.cols}"
+            )
+        if self.kind == "none" and (self.rows, self.cols) != (1, 1):
+            raise ReshardError("layout 'none' is a 1x1 grid by definition")
+        if self.kind == "1d" and self.cols != 1:
+            raise ReshardError("layout '1d' shards rows only (cols must be 1)")
+
+    @staticmethod
+    def from_mesh(mesh) -> "MeshLayout":
+        """The layout of a live :class:`jax.sharding.Mesh` (None = none)."""
+        from gol_tpu.parallel import mesh as mesh_mod
+
+        if mesh is None:
+            return MeshLayout("none")
+        rows = mesh.shape.get(mesh_mod.ROWS, 1)
+        cols = mesh.shape.get(mesh_mod.COLS, 1)
+        if mesh_mod.COLS in mesh.axis_names:
+            return MeshLayout("2d", rows=rows, cols=cols)
+        return MeshLayout("1d", rows=rows)
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> Optional["MeshLayout"]:
+        if d is None:
+            return None
+        return MeshLayout(
+            str(d["kind"]), int(d.get("rows", 1)), int(d.get("cols", 1))
+        )
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "rows": self.rows, "cols": self.cols}
+
+    def describe(self) -> str:
+        if self.kind == "none":
+            return "unsharded (single device)"
+        return f"{self.kind} mesh, {self.rows}x{self.cols} shard grid"
+
+    def boxes(self, shape: Sequence[int]) -> List[Box]:
+        """The shard boxes this layout tiles ``shape`` into (row-major).
+
+        Shard boxes mirror the canonical ``PartitionSpec(rows, cols)``
+        sharding, so they are exactly the regions
+        ``jax.make_array_from_callback`` will request — which requires
+        the board to divide the grid evenly.
+        """
+        h, w = (int(shape[0]), int(shape[1]))
+        if h % self.rows or w % self.cols:
+            raise ReshardError(
+                f"board {h}x{w} does not divide the {self.describe()} "
+                f"({self.rows} row / {self.cols} col shards)"
+            )
+        sh, sw = h // self.rows, w // self.cols
+        return [
+            (r * sh, (r + 1) * sh, c * sw, (c + 1) * sw)
+            for r in range(self.rows)
+            for c in range(self.cols)
+        ]
+
+
+def infer_layout(shape: Sequence[int], boxes: Sequence[Box]) -> MeshLayout:
+    """Best-effort layout of a legacy piece table (no manifest stamp).
+
+    A single full-board piece is ``none``; full-width row bands are a
+    ``1d`` ring; a regular r×c grid is ``2d``.  Irregular covers (valid
+    as checkpoints, impossible from our mesh shardings) report as a
+    ``1d`` ring of their distinct row bands — the planner only needs
+    *source boxes*, the layout label is telemetry.
+    """
+    h, w = int(shape[0]), int(shape[1])
+    boxes = [tuple(int(x) for x in b) for b in boxes]
+    if len(boxes) == 1 and boxes[0] == (0, h, 0, w):
+        return MeshLayout("none")
+    row_edges = sorted({b[0] for b in boxes})
+    col_edges = sorted({b[2] for b in boxes})
+    if all(b[2] == 0 and b[3] == w for b in boxes):
+        return MeshLayout("1d", rows=len(row_edges))
+    if len(boxes) == len(row_edges) * len(col_edges):
+        return MeshLayout("2d", rows=len(row_edges), cols=len(col_edges))
+    return MeshLayout("1d", rows=len(row_edges))
+
+
+# -- packed-word transport ----------------------------------------------------
+
+
+def pack_rows(cells: np.ndarray) -> np.ndarray:
+    """uint8[h, w] 0/1 cells -> uint32[h, ceil(w/32)] words.
+
+    Same bit order as :func:`gol_tpu.ops.bitlife.pack` (bit j of word k
+    is column 32k+j), built host-side from ``np.packbits`` little-endian
+    bytes so a packed piece and the device representation agree.
+    """
+    cells = np.asarray(cells, np.uint8)
+    by = np.packbits(cells, axis=1, bitorder="little")
+    pad = (-by.shape[1]) % 4
+    if pad:
+        by = np.pad(by, ((0, 0), (0, pad)))
+    return np.ascontiguousarray(by).view("<u4")
+
+
+def unpack_rows(words: np.ndarray, width: int) -> np.ndarray:
+    """Inverse of :func:`pack_rows`, trimmed to ``width`` columns."""
+    by = np.ascontiguousarray(words.astype("<u4")).view(np.uint8)
+    return np.unpackbits(by, axis=1, count=width, bitorder="little")
+
+
+def slice_packed_cols(words: np.ndarray, c0: int, c1: int) -> np.ndarray:
+    """Cells ``[c0, c1)`` of packed rows, via word shifts — the seam path.
+
+    A destination shard seam rarely lands on a source word boundary;
+    instead of unpacking the whole piece, the covering words are
+    realigned with a logical-shift pair (``w[k] >> s | w[k+1] << 32-s``)
+    so bit 0 of the result is column ``c0``, and only the ``c1 - c0``
+    requested cells are unpacked.  Word-aligned requests skip the shift
+    entirely.
+    """
+    if not 0 <= c0 <= c1 <= words.shape[1] * WORD_BITS:
+        raise ReshardError(
+            f"column range [{c0}, {c1}) outside the packed width "
+            f"{words.shape[1] * WORD_BITS}"
+        )
+    if c0 == c1:
+        return np.zeros((words.shape[0], 0), np.uint8)
+    k0, s = divmod(c0, WORD_BITS)
+    k1 = -(-c1 // WORD_BITS)
+    sel = words[:, k0:k1].astype(np.uint32, copy=bool(s))
+    if s:
+        hi = np.zeros_like(sel)
+        hi[:, :-1] = sel[:, 1:]
+        if k1 < words.shape[1]:
+            # The last selected word's high bits live in the next word.
+            hi[:, -1] = words[:, k1]
+        sel = (sel >> np.uint32(s)) | (hi << np.uint32(WORD_BITS - s))
+    return unpack_rows(sel, c1 - c0)
+
+
+class PackedStore:
+    """Piece cache holding boards at 1 bit/cell, serving arbitrary regions.
+
+    ``put`` packs a piece once (host-side, vectorized); ``region``
+    assembles any requested box from the intersecting pieces' packed
+    rows via :func:`slice_packed_cols`.  The store is what lets a full
+    cross-topology reshard run in O(board bits) transport memory plus
+    one destination shard of cells at a time.
+    """
+
+    def __init__(self) -> None:
+        self._pieces: Dict[Box, np.ndarray] = {}
+
+    def __contains__(self, box: Box) -> bool:
+        return tuple(box) in self._pieces
+
+    def put(self, box: Box, cells: np.ndarray) -> None:
+        box = tuple(int(x) for x in box)
+        want = (box[1] - box[0], box[3] - box[2])
+        if tuple(cells.shape) != want:
+            raise ReshardError(
+                f"piece {box} has shape {tuple(cells.shape)}, expected {want}"
+            )
+        self._pieces[box] = pack_rows(cells)
+
+    def region(self, box: Box) -> np.ndarray:
+        r0, r1, c0, c1 = (int(x) for x in box)
+        out = np.empty((r1 - r0, c1 - c0), np.uint8)
+        filled = 0
+        for (pr0, pr1, pc0, pc1), words in self._pieces.items():
+            ir0, ir1 = max(pr0, r0), min(pr1, r1)
+            ic0, ic1 = max(pc0, c0), min(pc1, c1)
+            if ir0 >= ir1 or ic0 >= ic1:
+                continue
+            cells = slice_packed_cols(
+                words[ir0 - pr0 : ir1 - pr0], ic0 - pc0, ic1 - pc0
+            )
+            out[ir0 - r0 : ir1 - r0, ic0 - c0 : ic1 - c0] = cells
+            filled += (ir1 - ir0) * (ic1 - ic0)
+        if filled != out.size:
+            raise ReshardError(
+                f"region {box} only covered {filled} of {out.size} cells; "
+                "the piece store does not tile it"
+            )
+        return out
+
+
+# -- the move table -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """Explicit src-piece → dst-shard move table for one repartition.
+
+    ``moves`` holds one entry per destination shard box: the source
+    boxes it reads from and the global-coordinate intersection each
+    contributes.  Everything downstream — execution, telemetry
+    accounting, the verifier's soundness check — consumes this one
+    structure.
+    """
+
+    shape: Tuple[int, int]
+    src: MeshLayout
+    dst: MeshLayout
+    # ((dst_box, ((src_box, inter_box), ...)), ...)
+    moves: Tuple[Tuple[Box, Tuple[Tuple[Box, Box], ...]], ...]
+
+    @property
+    def identity(self) -> bool:
+        """True when every dst shard is exactly one whole src piece."""
+        return all(
+            len(srcs) == 1 and srcs[0][0] == dst and srcs[0][1] == dst
+            for dst, srcs in self.moves
+        )
+
+    @property
+    def cells_moved(self) -> int:
+        return sum(
+            (i[1] - i[0]) * (i[3] - i[2])
+            for _, srcs in self.moves
+            for _, i in srcs
+        )
+
+    @property
+    def seam_splits(self) -> int:
+        """Moves whose column range starts sub-word inside its src piece
+        (the intersections that exercise the shift repack)."""
+        return sum(
+            1
+            for _, srcs in self.moves
+            for sbox, i in srcs
+            if (i[2] - sbox[2]) % WORD_BITS != 0
+        )
+
+    def summary(self) -> dict:
+        """The telemetry block of a v7 ``reshard`` event (plus logs)."""
+        return {
+            "src_mesh": self.src.to_dict(),
+            "dst_mesh": self.dst.to_dict(),
+            "dst_shards": len(self.moves),
+            "src_pieces": len({s for _, srcs in self.moves for s, _ in srcs}),
+            "moves": sum(len(srcs) for _, srcs in self.moves),
+            "seam_splits": self.seam_splits,
+            "cells": self.cells_moved,
+            # Transport bytes: pieces travel bit-packed (32 cells/word).
+            "bytes_moved": self.cells_moved // 8,
+        }
+
+
+def _intersect(a: Box, b: Box) -> Optional[Box]:
+    r0, r1 = max(a[0], b[0]), min(a[1], b[1])
+    c0, c1 = max(a[2], b[2]), min(a[3], b[3])
+    if r0 >= r1 or c0 >= c1:
+        return None
+    return (r0, r1, c0, c1)
+
+
+def plan_reshard(
+    shape: Sequence[int],
+    src_boxes: Sequence[Box],
+    src: MeshLayout,
+    dst: MeshLayout,
+) -> ReshardPlan:
+    """Build + validate the move table from source pieces to ``dst``.
+
+    Pure geometry — no file or device I/O — so the static verifier can
+    prove plan soundness for every topology pair without a snapshot on
+    disk.  The returned plan always passed :func:`validate_plan`.
+    """
+    shape = (int(shape[0]), int(shape[1]))
+    src_boxes = [tuple(int(x) for x in b) for b in src_boxes]
+    moves = []
+    for dbox in dst.boxes(shape):
+        srcs = []
+        for sbox in src_boxes:
+            inter = _intersect(dbox, sbox)
+            if inter is not None:
+                srcs.append((sbox, inter))
+        moves.append((dbox, tuple(srcs)))
+    plan = ReshardPlan(shape=shape, src=src, dst=dst, moves=tuple(moves))
+    validate_plan(plan)
+    return plan
+
+
+def validate_plan(plan: ReshardPlan) -> None:
+    """Exactly-once coverage: every destination cell has one source.
+
+    Raises :class:`ReshardPlanError` when any dst shard is under- or
+    over-covered, an intersection leaks outside its dst box or its
+    claimed src box, or the dst boxes fail to tile the board.  The
+    verifier's broken-fixture check feeds deliberately overlapping and
+    gapped plans through here — this function failing to reject them
+    fails the verify gate.
+    """
+    h, w = plan.shape
+    try:
+        ckpt_mod._validate_box_cover(
+            "reshard plan (dst)", plan.shape, [d for d, _ in plan.moves]
+        )
+    except ckpt_mod.CorruptSnapshotError as e:
+        raise ReshardPlanError(str(e)) from e
+    for dbox, srcs in plan.moves:
+        measure = 0
+        inters = []
+        for sbox, i in srcs:
+            if _intersect(i, dbox) != i:
+                raise ReshardPlanError(
+                    f"move {i} leaks outside its dst shard {dbox}"
+                )
+            if _intersect(i, sbox) != i:
+                raise ReshardPlanError(
+                    f"move {i} claims cells outside its src piece {sbox}"
+                )
+            measure += (i[1] - i[0]) * (i[3] - i[2])
+            inters.append(i)
+        want = (dbox[1] - dbox[0]) * (dbox[3] - dbox[2])
+        if measure != want:
+            raise ReshardPlanError(
+                f"dst shard {dbox} covered by {measure} of {want} cells; "
+                "the plan is "
+                + ("overlapping" if measure > want else "incomplete")
+            )
+        inters.sort()
+        for idx, a in enumerate(inters):
+            for b in inters[idx + 1 :]:
+                if b[0] >= a[1]:
+                    break
+                if b[2] < a[3] and b[3] > a[2]:
+                    raise ReshardPlanError(
+                        f"dst shard {dbox}: moves {a} and {b} overlap — "
+                        "a cell would be written twice"
+                    )
+
+
+# -- snapshot sources ---------------------------------------------------------
+
+
+class SnapshotSource:
+    """Uniform read surface over one snapshot, any format.
+
+    Attributes mirror what resume needs (``shape``, ``generation``,
+    ``rule``, ``num_ranks``, ``layout``, ``legacy``); ``region(box)``
+    serves any rectangle of the stored board from the packed piece
+    store, verifying piece fingerprints on first touch.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        shape: Tuple[int, int],
+        generation: int,
+        src_boxes: Sequence[Box],
+        layout: MeshLayout,
+        rule: Optional[str] = None,
+        num_ranks: Optional[int] = None,
+        legacy: bool = False,
+    ) -> None:
+        self.path = path
+        self.shape = shape
+        self.generation = generation
+        self.rule = rule
+        self.num_ranks = num_ranks
+        self.layout = layout
+        self.legacy = legacy
+        self.src_boxes = [tuple(int(x) for x in b) for b in src_boxes]
+        self._store = PackedStore()
+
+    def _load_piece(self, box: Box) -> None:
+        raise NotImplementedError
+
+    def region(self, box: Box) -> np.ndarray:
+        for sbox in self.src_boxes:
+            if _intersect(sbox, tuple(box)) and sbox not in self._store:
+                self._load_piece(sbox)
+        return self._store.region(box)
+
+    def plan_onto(self, dst: MeshLayout) -> ReshardPlan:
+        return plan_reshard(self.shape, self.src_boxes, self.layout, dst)
+
+
+class _WholeBoardSource(SnapshotSource):
+    """Single-file formats: one piece, already verified at load."""
+
+    def __init__(self, path, board, generation, layout, **kw):
+        h, w = board.shape
+        super().__init__(
+            path, (h, w), generation, [(0, h, 0, w)], layout, **kw
+        )
+        self._store.put((0, h, 0, w), board)
+
+    def _load_piece(self, box):  # pragma: no cover - pre-populated
+        raise AssertionError(box)
+
+
+class _ShardedSource(SnapshotSource):
+    """Sharded checkpoint directory: pieces verified + packed on demand."""
+
+    def __init__(self, path: str, meta: ckpt_mod.ShardedMeta) -> None:
+        layout = MeshLayout.from_dict(meta.layout)
+        legacy = layout is None
+        if legacy:
+            layout = infer_layout(meta.shape, meta.rects)
+        super().__init__(
+            path,
+            tuple(meta.shape),
+            meta.generation,
+            [tuple(int(x) for x in r) for r in meta.rects],
+            layout,
+            rule=meta.rule,
+            num_ranks=meta.num_ranks,
+            legacy=legacy,
+        )
+        self.meta = meta
+        self._proc_of = {
+            tuple(int(x) for x in r): int(p)
+            for r, p in zip(meta.rects, meta.procs)
+        }
+
+    def _load_piece(self, box: Box) -> None:
+        # One-piece region read: the checkpoint module's existing
+        # fingerprint-verified assembly, reused piece-by-piece so a
+        # corrupt shard file fails with the same CorruptSnapshotError
+        # wording every other load path produces.
+        cells = ckpt_mod.read_sharded_region(
+            self.path,
+            self.meta,
+            (slice(box[0], box[1]), slice(box[2], box[3])),
+        )
+        self._store.put(box, cells)
+
+
+def open_source(
+    path: str, kind: str = "2d", world: Optional[int] = None
+) -> SnapshotSource:
+    """A :class:`SnapshotSource` for any 2-D-board snapshot on disk.
+
+    ``kind='2d'`` accepts single-file and sharded-directory snapshots;
+    ``kind='batch'`` with ``world=i`` opens world ``i`` of a batched
+    snapshot as its own (unsharded) source — a batch world resumed onto
+    a mesh is a reshard like any other.  3-D volumes have no reshard
+    path yet (their driver's meshes are built per-run; see
+    docs/RESILIENCE.md).
+    """
+    name = os.path.basename(path)
+    if kind == "batch" or name.endswith(ckpt_mod.BCKPT_SUFFIX):
+        snap = ckpt_mod.load_batch(path)
+        if world is None:
+            raise ReshardError(
+                f"{path}: a batch snapshot holds "
+                f"{len(snap.boards)} worlds; pass world=<i> to reshard one"
+            )
+        if not 0 <= world < len(snap.boards):
+            raise ReshardError(
+                f"{path}: world {world} out of range "
+                f"(snapshot holds {len(snap.boards)})"
+            )
+        return _WholeBoardSource(
+            path, snap.boards[world], snap.generation, MeshLayout("none")
+        )
+    if kind == "3d" or name.endswith(ckpt_mod.CKPT3D_SUFFIX) or name.endswith(
+        ckpt_mod.SHARD3D_DIR_SUFFIX
+    ):
+        raise ReshardError(
+            f"{path}: 3-D volume snapshots have no reshard path"
+        )
+    if ckpt_mod.is_sharded(path):
+        meta = ckpt_mod.load_sharded_meta(path)
+        return _ShardedSource(path, meta)
+    snap = ckpt_mod.load(path)
+    if snap.top0 is not None:
+        raise ReshardError(
+            f"{path}: stale_t0 (reference-compat) snapshots are "
+            "single-device by definition and cannot reshard"
+        )
+    return _WholeBoardSource(
+        path,
+        snap.board,
+        snap.generation,
+        MeshLayout("none"),
+        rule=snap.rule,
+        num_ranks=snap.num_ranks,
+    )
+
+
+def place(source: SnapshotSource, mesh, plan: ReshardPlan):
+    """Materialize the snapshot's board on the destination mesh.
+
+    Sharded destinations assemble each addressable shard directly from
+    the source pieces (``make_array_from_callback`` — a multi-host
+    process only ever reads the regions its own devices hold); a
+    ``None`` mesh gets the whole board on one device.  ``plan`` must be
+    the validated plan for this (source, mesh) pair — it is the proof
+    the per-shard reads below tile the board exactly once.
+    """
+    import jax
+
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    validate_plan(plan)
+    h, w = source.shape
+    if mesh is None:
+        return jax.device_put(source.region((0, h, 0, w)))
+
+    def read(idx):
+        sl = list(idx) + [slice(None)] * (2 - len(idx))
+        r0 = 0 if sl[0].start is None else sl[0].start
+        r1 = h if sl[0].stop is None else sl[0].stop
+        c0 = 0 if sl[1].start is None else sl[1].start
+        c1 = w if sl[1].stop is None else sl[1].stop
+        return source.region((r0, r1, c0, c1))
+
+    return jax.make_array_from_callback(
+        (h, w), mesh_mod.board_sharding(mesh), read
+    )
+
+
+def load_resharded(
+    path: str,
+    mesh,
+    kind: str = "2d",
+    world: Optional[int] = None,
+):
+    """One-call cross-topology load: ``(board, source, plan)``.
+
+    The convenience surface the smoke script and tests drive; the
+    runtime's resume path composes the same three steps itself so it can
+    interleave its existing shape/rule/ranks validation.
+    """
+    source = open_source(path, kind=kind, world=world)
+    plan = source.plan_onto(MeshLayout.from_mesh(mesh))
+    return place(source, mesh, plan), source, plan
+
+
+def topology_resume_hint(resume_path: str, kind: str = "2d") -> Optional[str]:
+    """Actionable message for a plain ``--resume`` topology mismatch.
+
+    Mirror of :func:`gol_tpu.resilience.resume.corrupt_resume_hint`: when
+    the configured mesh cannot tile the board a snapshot holds, describe
+    the snapshot's stamped (or inferred) topology and the ways out
+    instead of leaving a raw divisibility error as the last word.  3-D
+    volume snapshots have no reshard path — their hint says so and names
+    the writing topology from the manifest stamp.
+    """
+    if kind == "3d" or os.path.basename(resume_path).endswith(
+        ckpt_mod.SHARD3D_DIR_SUFFIX
+    ):
+        try:
+            meta = ckpt_mod.load_sharded3d_meta(
+                resume_path, verify_stamp=False
+            )
+        except (ckpt_mod.CorruptSnapshotError, OSError, ValueError):
+            return None
+        wrote = (
+            f"written by {meta.process_count} processes"
+            if meta.process_count is not None
+            else f"written as {len(meta.boxes)} pieces (pre-stamp manifest)"
+        )
+        d, h, w = meta.shape
+        return (
+            f"hint: snapshot {resume_path} holds a {d}x{h}x{w} volume "
+            f"{wrote}. 3-D volume snapshots have no reshard path "
+            "(docs/RESILIENCE.md, elastic meshes) — relaunch on the "
+            "topology that wrote it"
+        )
+    try:
+        source = open_source(resume_path, kind=kind)
+    except (ckpt_mod.CorruptSnapshotError, ReshardError, OSError, ValueError):
+        return None
+    h, w = source.shape
+    legacy = " (legacy manifest, layout inferred)" if source.legacy else ""
+    return (
+        f"hint: snapshot {resume_path} holds a {h}x{w} board written as "
+        f"{source.layout.describe()}{legacy}. Resume resharding is "
+        "automatic on any mesh that tiles the board evenly — pick a mesh "
+        "whose rows/cols divide it, or pass --allow-shrink to drop "
+        "devices until the geometry divides."
+    )

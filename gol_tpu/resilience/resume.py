@@ -72,7 +72,16 @@ def resolve_auto_resume(
 
     multi = jax.process_count() > 1
     only = jax.process_index() if multi else None
-    path, skipped = ckpt_mod.latest_valid(directory, kind, only_process=only)
+    # expect_processes arms the topology check: a snapshot stamped by a
+    # different job size (elastic shrink/grow) is verified in full by
+    # every rank — the own-pieces shortcut would leave vanished ranks'
+    # pieces vouched for by nobody (docs/RESILIENCE.md, elastic meshes).
+    path, skipped = ckpt_mod.latest_valid(
+        directory,
+        kind,
+        only_process=only,
+        expect_processes=jax.process_count() if multi else None,
+    )
     local_gen = -1
     if path is not None:
         gen = ckpt_mod.snapshot_generation(path)

@@ -138,7 +138,17 @@ def supervise(
                 exit_code=None,
             )
             manifest["attempts"].append(record)
-            env = dict(os.environ, GOL_RESTART_ATTEMPT=str(attempt))
+            # GOL_ALLOW_SHRINK arms the elastic shrink policy in the
+            # child (docs/RESILIENCE.md): a relaunch that comes up with
+            # fewer (or non-tiling) devices drops to the largest mesh
+            # the board divides and reshards its resume snapshot onto
+            # it, instead of burning this budget on a divisibility
+            # error attempt after attempt.
+            env = dict(
+                os.environ,
+                GOL_RESTART_ATTEMPT=str(attempt),
+                GOL_ALLOW_SHRINK="1",
+            )
             proc = subprocess.Popen(child_argv, env=env)
             child["proc"] = proc
             record["pid"] = proc.pid

@@ -18,6 +18,7 @@ mid-loop persistence).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -37,15 +38,63 @@ ENGINES = ("auto", "dense", "bitpack", "pallas", "pallas_bitpack", "activity")
 MESH_CHOICES = ("none", "1d", "2d")
 
 
-def build_mesh(kind: str) -> Optional[Mesh]:
-    """CLI-level mesh selection: shard over all visible devices."""
+def build_mesh(
+    kind: str,
+    shape: Optional[Tuple[int, int]] = None,
+    allow_shrink: bool = False,
+) -> Optional[Mesh]:
+    """CLI-level mesh selection: shard over all visible devices.
+
+    With ``shape`` and ``allow_shrink`` set, applies the elastic shrink
+    policy (docs/RESILIENCE.md): when the board does not tile evenly
+    over every visible device — the degraded-pod case, a relaunch coming
+    up with fewer (or an awkward number of) chips — drop to the largest
+    device count whose mesh the geometry divides instead of refusing to
+    run.  The snapshot reshards onto whatever mesh results, so a
+    supervised job keeps making progress on the smaller topology rather
+    than burning its restart budget on a divisibility error.  (The
+    policy checks the dense cell-quantum tiling; engine-specific
+    constraints — packed word widths, Pallas alignment — still resolve
+    downstream exactly as on a full mesh, falling back to the dense
+    engine under ``auto``.)
+    """
     if kind == "none":
         return None
-    if kind == "1d":
-        return mesh_mod.make_mesh_1d()
-    if kind == "2d":
-        return mesh_mod.make_mesh_2d()
-    raise ValueError(f"unknown mesh kind {kind!r}; expected one of {MESH_CHOICES}")
+    if kind not in ("1d", "2d"):
+        raise ValueError(
+            f"unknown mesh kind {kind!r}; expected one of {MESH_CHOICES}"
+        )
+    devices = jax.devices()
+    counts = (
+        range(len(devices), 0, -1)
+        if allow_shrink and shape is not None
+        else (len(devices),)
+    )
+    last_err: Optional[ValueError] = None
+    for n in counts:
+        if kind == "1d":
+            mesh = mesh_mod.make_mesh_1d(n, devices=devices[:n])
+        else:
+            mesh = mesh_mod.make_mesh_2d(devices=devices[:n])
+        if shape is None:
+            return mesh
+        try:
+            mesh_mod.validate_geometry(shape, mesh)
+        except ValueError as e:
+            last_err = e
+            continue
+        if n < len(devices):
+            import warnings
+
+            warnings.warn(
+                f"elastic shrink: board {shape[0]}x{shape[1]} does not "
+                f"tile all {len(devices)} devices; proceeding on "
+                f"{n} ({dict(mesh.shape)})",
+                stacklevel=2,
+            )
+        return mesh
+    assert last_err is not None
+    raise last_err
 
 
 def chunk_schedule(iterations: int, chunk: int) -> list:
@@ -122,6 +171,20 @@ class GolRuntime:
     # true activity after one generation (bit-identity pinned).
     activity_tile: int = 0
     activity_capacity: float = 0.25
+    # Elastic-mesh knobs (docs/RESILIENCE.md):
+    # reshard_at > 0 stops the run at the first chunk boundary whose
+    # generation reaches it, writes a snapshot, and raises
+    # resilience.ReshardPoint so the driver can replan and reload the
+    # remaining generations on a different mesh (--reshard-at /
+    # --reshard-mesh; the in-flight reshard drill knob).  Requires a
+    # checkpoint_dir; single-process only (a multi-host job reshapes by
+    # relaunching under --auto-resume, which reshards on load).
+    reshard_at: int = 0
+    # sharded_snapshots writes the sharded checkpoint directory format
+    # even single-process (multi-host always does): the piece-table
+    # format cross-topology resume repartitions, exercisable without a
+    # pod.
+    sharded_snapshots: bool = False
     # Live metrics endpoint (--metrics-port; docs/OBSERVABILITY.md):
     # rank 0 serves Prometheus text on 127.0.0.1:<port> (0 = ephemeral),
     # fed by the same in-process event stream the rank files get — so
@@ -344,6 +407,21 @@ class GolRuntime:
         # The snapshot this run resumed from — protected from retention
         # GC for the whole run (a rollback may still need it).
         self._resume_source: Optional[str] = None
+        if self.reshard_at < 0:
+            raise ValueError(
+                f"reshard_at must be >= 0, got {self.reshard_at} "
+                "(0 disables the in-flight reshard stop)"
+            )
+        if self.reshard_at > 0 and not self.checkpoint_dir:
+            raise ValueError(
+                "reshard_at stops through a snapshot; set checkpoint_dir "
+                "(or a checkpoint cadence)"
+            )
+        # Cross-topology resume record (docs/RESILIENCE.md): set by
+        # initial_state when the snapshot's stamped/inferred topology
+        # differs from this run's mesh — the v7 `reshard` telemetry
+        # event's payload, and the test surface for the planner.
+        self.last_reshard: Optional[dict] = None
         # Host-int stats of the last run()'s chunks (--stats mode):
         # [{"index", "take", "generation", "population", ...}, ...].
         self.last_stats: list = []
@@ -694,8 +772,12 @@ class GolRuntime:
         silently change the semantics mid-run).
         """
         self._resume_source = resume or None
+        self.last_reshard = None
         if resume and ckpt_mod.is_sharded(resume):
-            meta = ckpt_mod.load_sharded_meta(resume)
+            from gol_tpu.resilience import reshard as reshard_mod
+
+            source = reshard_mod.open_source(resume, kind="2d")
+            meta = source
             if meta.num_ranks != self.geometry.num_ranks:
                 raise ValueError(
                     f"checkpoint has {meta.num_ranks} ranks, run configured "
@@ -718,23 +800,21 @@ class GolRuntime:
                     "sharded checkpoints are written by fresh-halo runs "
                     "only; a stale_t0 run cannot resume from one bit-exactly"
                 )
-            if self.mesh is not None:
-                # Each host reads only the rows its devices own — the
-                # load-side mirror of the gather-free save.
-                board = jax.make_array_from_callback(
-                    meta.shape,
-                    mesh_mod.board_sharding(self.mesh),
-                    lambda idx: ckpt_mod.read_sharded_region(
-                        resume, meta, idx
-                    ),
+            # Elastic resume: the plan repartitions the stored pieces
+            # onto THIS run's topology — each host still reads only the
+            # regions its devices own (the gather-free load).  A
+            # matching topology yields the identity plan and no event.
+            dst = reshard_mod.MeshLayout.from_mesh(self.mesh)
+            plan = source.plan_onto(dst)
+            board = reshard_mod.place(source, self.mesh, plan)
+            if source.layout != dst:
+                self.last_reshard = dict(
+                    generation=source.generation,
+                    path=os.path.abspath(resume),
+                    legacy_manifest=source.legacy,
+                    **plan.summary(),
                 )
-            else:
-                board = jax.device_put(
-                    ckpt_mod.read_sharded_region(
-                        resume, meta, (slice(None), slice(None))
-                    )
-                )
-            return GolState.create(board, meta.generation)
+            return GolState.create(board, source.generation)
         if resume:
             snap = ckpt_mod.load(resume)
             if snap.num_ranks != self.geometry.num_ranks:
@@ -767,6 +847,26 @@ class GolRuntime:
                     jax.device_put(snap.top0),
                     jax.device_put(snap.bottom0),
                 )
+            if self.mesh is not None:
+                # A whole-board snapshot landing on a mesh is a reshard
+                # too (layout none → this mesh); the placement itself is
+                # unchanged (shard_board in run()), but the move is
+                # planned/validated and recorded like the sharded case.
+                from gol_tpu.resilience import reshard as reshard_mod
+
+                h, w = snap.board.shape
+                plan = reshard_mod.plan_reshard(
+                    (h, w),
+                    [(0, h, 0, w)],
+                    reshard_mod.MeshLayout("none"),
+                    reshard_mod.MeshLayout.from_mesh(self.mesh),
+                )
+                self.last_reshard = dict(
+                    generation=snap.generation,
+                    path=os.path.abspath(resume),
+                    legacy_manifest=False,
+                    **plan.summary(),
+                )
             return GolState.create(jax.device_put(snap.board), snap.generation)
 
         board_np = patterns.init_global(
@@ -794,12 +894,16 @@ class GolRuntime:
         top0, bottom0 = self._halos if self._halos is not None else (None, None)
         multi = jax.process_count() > 1
         rule = None if self._rule is None else self._rule.rulestring()
-        if multi:
+        if multi or (self.sharded_snapshots and self.mesh is not None):
             # Sharded format: every process writes only the rectangles its
             # devices own — no all-gather, no host ever materializes the
             # board (VERDICT r1 #4; at 65536² the old fetch_global path
             # replicated 4 GB to every host per snapshot).  stale_t0 never
             # reaches here (multi-host runs are fresh-halo by validation).
+            # The manifest stamps this run's mesh layout so a future
+            # resume on another topology can name the reshard it does.
+            from gol_tpu.resilience import reshard as reshard_mod
+
             ckpt_mod.save_sharded(
                 ckpt_mod.sharded_checkpoint_path(
                     self.checkpoint_dir, int(state.generation)
@@ -809,6 +913,9 @@ class GolRuntime:
                 self.geometry.num_ranks,
                 rule=rule,
                 fingerprint=fingerprint,
+                mesh_layout=reshard_mod.MeshLayout.from_mesh(
+                    self.mesh
+                ).to_dict(),
             )
             from jax.experimental import multihost_utils
 
@@ -903,6 +1010,41 @@ class GolRuntime:
             generation,
             checkpoint_dir=self.checkpoint_dir if checkpointed else None,
         )
+
+    def _reshard_stop(self, state, sw: Stopwatch, writer, remaining: int) -> None:
+        """In-flight reshard stop (``reshard_at``): snapshot, then raise.
+
+        Mirrors :meth:`_preempt`'s chunk-boundary contract — the board is
+        whole and fenced, the snapshot is durably renamed before the
+        raise — but hands control back to the driver via
+        :class:`gol_tpu.resilience.ReshardPoint` so the remaining
+        generations reload on the new mesh in this same process.
+        """
+        from gol_tpu import resilience
+        from gol_tpu import telemetry as telemetry_mod
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "reshard_at is single-process (a multi-host job reshapes "
+                "by relaunching under --auto-resume, which reshards on "
+                "load)"
+            )
+        generation = int(state.generation)
+        if self.checkpoint_every <= 0:
+            # No cadence: this boundary has no snapshot yet — write one.
+            with telemetry_mod.trace_annotation("gol.checkpoint.save"):
+                with sw.phase("checkpoint"):
+                    self._save_snapshot(state)
+        if writer is not None:
+            with sw.phase("checkpoint"):
+                writer.flush()
+        if self.sharded_snapshots and self.mesh is not None:
+            path = ckpt_mod.sharded_checkpoint_path(
+                self.checkpoint_dir, generation
+            )
+        else:
+            path = ckpt_mod.checkpoint_path(self.checkpoint_dir, generation)
+        raise resilience.ReshardPoint(generation, path, remaining)
 
     # -- shared compile machinery -------------------------------------------
     def chunk_schedule(self, iterations: int, chunk: int) -> list:
@@ -1031,6 +1173,10 @@ class GolRuntime:
                 fallback=bool(self.resume_info.get("fallback")),
                 skipped=self.resume_info.get("skipped") or [],
             )
+        if self.last_reshard is not None:
+            # Cross-topology resume happened (schema v7): record the
+            # src/dst topologies and the validated plan's accounting.
+            events.reshard_event(**self.last_reshard)
         return events
 
     def _initial_activity_mask(self):
@@ -1279,6 +1425,17 @@ class GolRuntime:
                                     writer,
                                     events,
                                     already_saved=self.checkpoint_every > 0,
+                                )
+                            if (
+                                self.reshard_at > 0
+                                and int(state.generation) >= self.reshard_at
+                            ):
+                                # In-flight reshard stop: same boundary
+                                # contract as preemption, but the driver
+                                # continues on a new mesh immediately.
+                                self._reshard_stop(
+                                    state, sw, writer,
+                                    remaining=sum(schedule[i + 1 :]),
                                 )
                 if writer is not None:
                     with sw.phase("checkpoint"):

@@ -55,7 +55,14 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 6 (this round) adds host-side span attribution
+# Version 7 (this round) adds the elastic-mesh event
+# (docs/RESILIENCE.md): a ``reshard`` record marks a run whose board was
+# repartitioned across topologies — a cross-topology resume or an
+# in-flight ``--reshard-at`` stop — carrying the source/destination mesh
+# layouts (``{kind, rows, cols}``), the validated move-table accounting
+# (``dst_shards``, ``src_pieces``, ``moves``, ``seam_splits``,
+# ``cells``), and ``bytes_moved`` (pieces travel bit-packed, 32
+# cells/word).  Version 6 added host-side span attribution
 # (docs/OBSERVABILITY.md): ``chunk`` events carry a ``spans`` block —
 # ``{phase: seconds, ...}`` with phases like ``dispatch``, ``ready``,
 # ``checkpoint``, ``telemetry``, ``preempt_poll`` (the guard adds
@@ -74,11 +81,11 @@ from typing import Dict, Optional
 # resilience events — ``preempt``, ``resume``, ``restart``
 # (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
-# readable: every v1-v5 event type and field survives unchanged, so
+# readable: every v1-v6 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5 fixture tests).
-SCHEMA_VERSION = 6
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6)
+# committed v1/v2/v3/v4/v5/v6 fixture tests).
+SCHEMA_VERSION = 7
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -125,6 +132,13 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # v3: this run is attempt N (> 0) of a supervised job — the
     # restart-storm watchdog counts these across a directory's runs.
     "restart": frozenset({"attempt"}),
+    # v7: this run's board was repartitioned across mesh topologies
+    # (cross-topology resume or an in-flight --reshard-at stop).
+    # src_mesh/dst_mesh are {kind, rows, cols} layout dicts; bytes_moved
+    # is the bit-packed transport volume of the validated move table.
+    "reshard": frozenset(
+        {"generation", "src_mesh", "dst_mesh", "bytes_moved"}
+    ),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -356,6 +370,27 @@ class EventLog:
     def restart_event(self, attempt: int, **extra) -> None:
         """Supervised restart marker (v3): this run is attempt N > 0."""
         self.emit("restart", attempt=attempt, **extra)
+
+    def reshard_event(
+        self,
+        generation: int,
+        src_mesh: dict,
+        dst_mesh: dict,
+        bytes_moved: int,
+        **extra,
+    ) -> None:
+        """Elastic-mesh repartition marker (v7).  ``extra`` carries the
+        plan accounting (``dst_shards``/``src_pieces``/``moves``/
+        ``seam_splits``/``cells``), the snapshot ``path``, and
+        ``legacy_manifest`` (layout was inferred, not stamped)."""
+        self.emit(
+            "reshard",
+            generation=generation,
+            src_mesh=src_mesh,
+            dst_mesh=dst_mesh,
+            bytes_moved=bytes_moved,
+            **extra,
+        )
 
     def stats_event(
         self, index: int, take: int, generation: int, values: dict

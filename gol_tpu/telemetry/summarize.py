@@ -570,6 +570,28 @@ def render_run(run: Run, out) -> None:
             f"({'checkpointed' if r['checkpointed'] else 'NO checkpoint'})",
             file=out,
         )
+    for r in run.records("reshard", rank=rank0):
+        src, dst = r["src_mesh"], r["dst_mesh"]
+
+        def _mesh(m):
+            return (
+                m["kind"]
+                if m["kind"] == "none"
+                else f"{m['kind']} {m['rows']}x{m['cols']}"
+            )
+
+        print(
+            f"  reshard: generation {r['generation']} "
+            f"{_mesh(src)} -> {_mesh(dst)}, "
+            f"{r['bytes_moved']} packed bytes moved"
+            + (
+                f" ({r['seam_splits']} seam splits)"
+                if "seam_splits" in r
+                else ""
+            )
+            + ("  [legacy manifest]" if r.get("legacy_manifest") else ""),
+            file=out,
+        )
 
     benches = run.records("bench_row")
     if benches:

@@ -597,6 +597,17 @@ class ShardedMeta:
     rects: np.ndarray  # [n, 4] (r0, r1, c0, c1) disjoint cover
     procs: np.ndarray  # [n] writer process per rect
     fingerprint: Optional[int]  # global stamp (guard audit), if known
+    # Elastic-mesh stamp (docs/RESILIENCE.md): the mesh topology that
+    # wrote the snapshot ({kind, rows, cols}) and the writing job's
+    # process count.  ``None`` on pre-stamp (legacy) manifests — the
+    # reshard planner then infers the layout from the rect table and
+    # flags the source ``legacy``.
+    layout: Optional[dict] = None
+    process_count: Optional[int] = None
+
+    @property
+    def legacy(self) -> bool:
+        return self.layout is None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -609,6 +620,11 @@ class Sharded3DMeta:
     boxes: np.ndarray  # [n, 6] (d0, d1, r0, r1, c0, c1) disjoint cover
     procs: np.ndarray  # [n] writer process per box
     fingerprint: Optional[int]
+    # Writing job's process count (the elastic-mesh stamp, shared with
+    # the 2-D manifest writer); None on pre-stamp manifests.  3-D
+    # volumes have no reshard path — the stamp feeds the topology
+    # diagnosis, not a planner.
+    process_count: Optional[int] = None
 
 
 def fingerprint3d_np(
@@ -676,6 +692,13 @@ def _save_sharded_nd(dirpath: str, arr, box_key: str, manifest_fields):
     import jax
 
     os.makedirs(dirpath, exist_ok=True)
+    # Topology stamp (elastic meshes, docs/RESILIENCE.md): every
+    # manifest records the writing job's process count, so a resume on
+    # a different job size can tell "topology changed" from "pieces
+    # missing" and verify accordingly.
+    manifest_fields = dict(
+        manifest_fields, process_count=np.int64(jax.process_count())
+    )
     shape = tuple(arr.shape)
     owner = _piece_table_nd(arr.sharding, shape)
     me = jax.process_index()
@@ -701,6 +724,7 @@ def _save_sharded_nd(dirpath: str, arr, box_key: str, manifest_fields):
     path = os.path.join(dirpath, f"shards_{me:05d}.npz")
     tmp = path + ".tmp.npz"
     np.savez_compressed(tmp, **arrays)
+    _tmp_rename_gap()
     os.replace(tmp, path)
     written.append(path)
     if me == 0:
@@ -716,6 +740,7 @@ def _save_sharded_nd(dirpath: str, arr, box_key: str, manifest_fields):
         mpath = os.path.join(dirpath, _MANIFEST)
         tmp = mpath + ".tmp.npz"
         np.savez_compressed(tmp, **manifest)
+        _tmp_rename_gap()
         os.replace(tmp, mpath)
         written.append(mpath)
     return written
@@ -728,12 +753,17 @@ def save_sharded(
     num_ranks: int,
     rule: Optional[str] = None,
     fingerprint: Optional[int] = None,
+    mesh_layout: Optional[dict] = None,
 ) -> list:
     """Write this process's pieces of a sharded board (collective call).
 
     See :func:`_save_sharded_nd` for the write protocol; the caller fences
     with a barrier before relying on the checkpoint
     (``runtime._save_snapshot`` uses ``sync_global_devices``).
+    ``mesh_layout`` (``{kind, rows, cols}``, see
+    :class:`gol_tpu.resilience.reshard.MeshLayout`) stamps the writing
+    topology into the manifest so a cross-topology resume can name the
+    mismatch instead of inferring it.
     """
     fields = dict(
         generation=np.int64(generation), num_ranks=np.int64(num_ranks)
@@ -742,6 +772,10 @@ def save_sharded(
         fields["rule"] = np.asarray(rule)
     if fingerprint is not None:
         fields["fingerprint"] = np.uint32(fingerprint)
+    if mesh_layout is not None:
+        fields["mesh_kind"] = np.asarray(str(mesh_layout["kind"]))
+        fields["mesh_rows"] = np.int64(mesh_layout.get("rows", 1))
+        fields["mesh_cols"] = np.int64(mesh_layout.get("cols", 1))
     return _save_sharded_nd(dirpath, arr, "rects", fields)
 
 
@@ -840,6 +874,13 @@ def load_sharded_meta(dirpath: str, verify_stamp: bool = True) -> ShardedMeta:
 
     try:
         with np.load(os.path.join(dirpath, _MANIFEST)) as data:
+            layout = None
+            if "mesh_kind" in data:
+                layout = dict(
+                    kind=str(data["mesh_kind"]),
+                    rows=int(data["mesh_rows"]),
+                    cols=int(data["mesh_cols"]),
+                )
             meta = ShardedMeta(
                 shape=tuple(int(x) for x in data["shape"]),
                 generation=int(data["generation"]),
@@ -849,6 +890,12 @@ def load_sharded_meta(dirpath: str, verify_stamp: bool = True) -> ShardedMeta:
                 procs=data["procs"].copy(),
                 fingerprint=(
                     int(data["fingerprint"]) if "fingerprint" in data else None
+                ),
+                layout=layout,
+                process_count=(
+                    int(data["process_count"])
+                    if "process_count" in data
+                    else None
                 ),
             )
     except (KeyError, ValueError, zipfile.BadZipFile) as e:
@@ -883,6 +930,11 @@ def load_sharded3d_meta(
                 procs=data["procs"].copy(),
                 fingerprint=(
                     int(data["fingerprint"]) if "fingerprint" in data else None
+                ),
+                process_count=(
+                    int(data["process_count"])
+                    if "process_count" in data
+                    else None
                 ),
             )
     except (KeyError, ValueError, zipfile.BadZipFile) as e:
@@ -1119,7 +1171,11 @@ def _verify_pieces_nd(
                 ) from e
 
 
-def verify_snapshot(path: str, only_process: Optional[int] = None) -> int:
+def verify_snapshot(
+    path: str,
+    only_process: Optional[int] = None,
+    expect_processes: Optional[int] = None,
+) -> int:
     """Fully validate one snapshot (any format); return its generation.
 
     Single-file snapshots load + fingerprint-verify end to end; sharded
@@ -1127,8 +1183,14 @@ def verify_snapshot(path: str, only_process: Optional[int] = None) -> int:
     fingerprint-verify every piece — or, with ``only_process``, only that
     process's pieces and no global stamp (each rank vouches for its own
     writes; cross-rank agreement happens at the resume-generation min).
-    Raises :class:`CorruptSnapshotError` (or ``OSError`` for a vanished
-    file) when the snapshot cannot be trusted.
+    ``expect_processes`` (the resuming job's process count) arms the
+    topology check: when the manifest was stamped by a *different* job
+    size, the own-pieces shortcut is unsound — a shrunk job would leave
+    the vanished ranks' pieces vouched for by nobody — so the sweep
+    silently widens to every piece plus the global stamp (the
+    shared-storage degraded-resume path).  Raises
+    :class:`CorruptSnapshotError` (or ``OSError`` for a vanished file)
+    when the snapshot cannot be trusted.
     """
     name = os.path.basename(path)
     if name.endswith(SHARD_DIR_SUFFIX) or name.endswith(SHARD3D_DIR_SUFFIX):
@@ -1137,17 +1199,26 @@ def verify_snapshot(path: str, only_process: Optional[int] = None) -> int:
                 f"{path}: torn sharded checkpoint (manifest or shard "
                 "files missing)"
             )
-        verify_stamp = only_process is None
         if name.endswith(SHARD3D_DIR_SUFFIX):
-            meta3 = load_sharded3d_meta(path, verify_stamp=verify_stamp)
+            meta3 = load_sharded3d_meta(path, verify_stamp=False)
+            only3 = _effective_only_process(
+                only_process, expect_processes, meta3.process_count,
+                meta3.procs,
+            )
+            if only3 is None and meta3.fingerprint is not None:
+                _verify_global_stamp(path, meta3.procs, meta3.fingerprint)
             _verify_pieces_nd(
-                path, meta3.shape, meta3.boxes, meta3.procs, "boxes",
-                only_process,
+                path, meta3.shape, meta3.boxes, meta3.procs, "boxes", only3
             )
             return meta3.generation
-        meta = load_sharded_meta(path, verify_stamp=verify_stamp)
+        meta = load_sharded_meta(path, verify_stamp=False)
+        only = _effective_only_process(
+            only_process, expect_processes, meta.process_count, meta.procs
+        )
+        if only is None and meta.fingerprint is not None:
+            _verify_global_stamp(path, meta.procs, meta.fingerprint)
         _verify_pieces_nd(
-            path, meta.shape, meta.rects, meta.procs, "rects", only_process
+            path, meta.shape, meta.rects, meta.procs, "rects", only
         )
         return meta.generation
     if name.endswith(BCKPT_SUFFIX):
@@ -1159,8 +1230,34 @@ def verify_snapshot(path: str, only_process: Optional[int] = None) -> int:
     raise CorruptSnapshotError(f"{path}: not a snapshot path")
 
 
+def _effective_only_process(
+    only_process: Optional[int],
+    expect_processes: Optional[int],
+    stamped: Optional[int],
+    procs,
+) -> Optional[int]:
+    """Resolve the per-rank verification shortcut against the topology.
+
+    The shortcut is only sound when the resuming job has the same shape
+    as the writing job; on a mismatch (stamped process count differs, or
+    a legacy manifest's piece table implies one) every rank verifies
+    every piece.  With ``expect_processes`` unset (plain
+    :func:`verify_snapshot` callers) the shortcut is honored as before.
+    """
+    if only_process is None or expect_processes is None:
+        return only_process
+    if stamped is None:
+        # Legacy manifest: the writer count is whatever the piece table
+        # references (process ids are dense from 0 by construction).
+        stamped = max((int(p) for p in procs), default=0) + 1
+    return only_process if stamped == expect_processes else None
+
+
 def latest_valid(
-    directory: str, kind: str = "2d", only_process: Optional[int] = None
+    directory: str,
+    kind: str = "2d",
+    only_process: Optional[int] = None,
+    expect_processes: Optional[int] = None,
 ) -> Tuple[Optional[str], List[str]]:
     """Newest snapshot that fully verifies, walking newest→oldest.
 
@@ -1172,7 +1269,11 @@ def latest_valid(
     skipped: List[str] = []
     for path in reversed(list_snapshots(directory, kind)):
         try:
-            verify_snapshot(path, only_process=only_process)
+            verify_snapshot(
+                path,
+                only_process=only_process,
+                expect_processes=expect_processes,
+            )
             return path, skipped
         except (CorruptSnapshotError, OSError):
             skipped.append(path)

@@ -23,26 +23,30 @@
 #                       with the JSONL, summarize the span table, and
 #                       run `ledger check` against the committed
 #                       PERF_LEDGER.jsonl regression gate)
-#   9. tier-1 tests    (the exact ROADMAP.md command)
+#   9. reshard smoke   (elastic meshes: a 2-D-block sharded snapshot
+#                       resumed on a 1-D ring must be bit-equal to a
+#                       straight run, with a non-identity plan and the
+#                       schema-v7 reshard event stamped)
+#  10. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/9] lint =="
+echo "== [1/10] lint =="
 bash scripts/lint.sh
 
-echo "== [2/9] static verifier (gol_tpu.analysis) =="
+echo "== [2/10] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/9] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/10] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/9] stats smoke (in-graph simulation statistics) =="
+echo "== [4/10] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -51,19 +55,22 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/9] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/10] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/9] batch smoke (docs/BATCHING.md) =="
+echo "== [6/10] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/9] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/10] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/9] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/10] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/9] tier-1 tests =="
+echo "== [9/10] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
+
+echo "== [10/10] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

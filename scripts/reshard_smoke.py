@@ -1,0 +1,129 @@
+"""Reshard smoke: cross-topology resume, end to end on CPU devices.
+
+check.sh stage [9/10] (docs/RESILIENCE.md, "Elastic meshes").  A board
+is evolved on a 2-D (4x2) block mesh, snapshotted in the sharded
+piece-table format with the topology stamped into the manifest, then
+resumed on a 1-D 8-ring — every destination row band assembled from two
+source blocks — and run to the end.  The result must be (1) bit-equal
+to a straight unmeshed run of the same total length — the reshard may
+only move cells, never change them — and (2) an actual repartition:
+the runtime must record a non-identity plan and stamp the schema-v7
+``reshard`` telemetry event naming the 2d 4x2 -> 1d 8x1 move.  A smoke
+that only checked equality would pass for a loader that ignores the
+mesh; one that only checked the event would pass for a planner that
+shuffles cells.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+SIZE = 256
+MID = 24
+REST = 40
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.resilience import reshard as rs
+    from gol_tpu.runtime import GolRuntime
+    from gol_tpu.utils import checkpoint as ckpt
+
+    geom = Geometry(size=SIZE, num_ranks=1)
+
+    # Straight oracle run: the whole evolution, unmeshed.
+    _, ref = GolRuntime(geometry=geom, engine="bitpack").run(
+        pattern=6, iterations=MID + REST
+    )
+
+    with tempfile.TemporaryDirectory() as tdir:
+        # Evolve MID generations on the 2-D block mesh and snapshot it
+        # in the stamped sharded format.
+        mesh2d = mesh_mod.make_mesh_2d((4, 2))
+        rt_src = GolRuntime(geometry=geom, engine="bitpack", mesh=mesh2d)
+        _, mid_state = rt_src.run(pattern=6, iterations=MID)
+        snap = ckpt.sharded_checkpoint_path(os.path.join(tdir, "ck"), MID)
+        os.makedirs(os.path.dirname(snap), exist_ok=True)
+        ckpt.save_sharded(
+            snap,
+            mid_state.board,
+            MID,
+            geom.num_ranks,
+            mesh_layout=rs.MeshLayout.from_mesh(mesh2d).to_dict(),
+        )
+        if ckpt.verify_snapshot(snap) != MID:
+            print("FAIL: freshly written sharded snapshot does not verify")
+            return 1
+
+        # Resume the 2-D snapshot on a 1-D ring — the cross-topology
+        # load — and finish the run there.
+        rt_dst = GolRuntime(
+            geometry=geom,
+            engine="bitpack",
+            mesh=mesh_mod.make_mesh_1d(8),
+            telemetry_dir=tdir,
+            run_id="reshardsmoke",
+        )
+        _, final = rt_dst.run(pattern=6, iterations=REST, resume=snap)
+
+        if not np.array_equal(np.asarray(final.board), np.asarray(ref.board)):
+            print("FAIL: 2d-snapshot -> 1d-mesh resume diverges from the "
+                  "straight run")
+            return 1
+
+        plan = rt_dst.last_reshard
+        if plan is None:
+            print("FAIL: cross-topology resume recorded no reshard plan")
+            return 1
+        if (
+            plan["src_mesh"] != {"kind": "2d", "rows": 4, "cols": 2}
+            or plan["dst_mesh"] != {"kind": "1d", "rows": 8, "cols": 1}
+            or plan["moves"] <= plan["dst_shards"]
+        ):
+            print(f"FAIL: expected a true 2d 4x2 -> 1d 8x1 repartition, "
+                  f"got {plan}")
+            return 1
+
+        recs = [
+            json.loads(ln)
+            for ln in open(pathlib.Path(tdir) / "reshardsmoke.rank0.jsonl")
+        ]
+        events = [r for r in recs if r["event"] == "reshard"]
+        if len(events) != 1 or events[0]["bytes_moved"] != SIZE * SIZE // 8:
+            print(f"FAIL: expected one v7 reshard event moving "
+                  f"{SIZE * SIZE // 8} packed bytes, got {events}")
+            return 1
+
+    print(
+        f"reshard smoke OK: 2d 4x2 snapshot resumed on 1d 8x1 bit-equal "
+        f"to the straight run ({plan['moves']} moves, "
+        f"{plan['bytes_moved']} packed bytes, "
+        f"{plan['seam_splits']} seam splits)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -507,3 +507,145 @@ def test_activity_sharded_glider_any_offset(dy, dx, n):
     )
     out, _, _ = fn(board, mask)
     np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+# -- elastic-mesh reshard families (docs/RESILIENCE.md) -----------------------
+
+from gol_tpu.resilience import reshard as rs  # noqa: E402
+from gol_tpu.utils import checkpoint as ckpt_prop  # noqa: E402
+
+
+@given(
+    rows=st.integers(1, 5),
+    cols=st.integers(1, 5),
+    drows=st.integers(1, 5),
+    dcols=st.integers(1, 5),
+    rh=st.integers(1, 9),
+    cw=st.integers(1, 41),
+    seed=seeds,
+)
+@settings(**_SETTINGS)
+def test_reshard_repartition_matches_slicing_any_geometry(
+    rows, cols, drows, dcols, rh, cw, seed
+):
+    """Pure-geometry pin: repartitioning a random board from any src
+    grid to any dst grid through the packed piece store reproduces
+    plain numpy slicing — including column seams that straddle uint32
+    words (``cw`` not a multiple of 32 puts every interior seam
+    sub-word, driving the shift repack)."""
+    h = rows * drows * rh
+    w = cols * dcols * cw
+    board = _board(h, w, seed)
+    src = rs.MeshLayout("2d", rows, cols) if cols > 1 else (
+        rs.MeshLayout("1d", rows) if rows > 1 else rs.MeshLayout("none")
+    )
+    dst = rs.MeshLayout("2d", drows, dcols) if dcols > 1 else (
+        rs.MeshLayout("1d", drows) if drows > 1 else rs.MeshLayout("none")
+    )
+    src_boxes = src.boxes((h, w))
+    plan = rs.plan_reshard((h, w), src_boxes, src, dst)
+    store = rs.PackedStore()
+    for b in src_boxes:
+        store.put(b, board[b[0] : b[1], b[2] : b[3]])
+    for dbox, _ in plan.moves:
+        np.testing.assert_array_equal(
+            store.region(dbox), board[dbox[0] : dbox[1], dbox[2] : dbox[3]]
+        )
+    assert plan.cells_moved == h * w
+
+
+_RESHARD_LAYOUTS = {
+    "none": None,
+    "1d2": ("1d", (2,)),
+    "1d4": ("1d", (4,)),
+    "1d8": ("1d", (8,)),
+    "2d2x2": ("2d", (2, 2)),
+    "2d4x2": ("2d", (4, 2)),
+}
+
+
+def _reshard_mesh(kind):
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    if kind == "none":
+        return None
+    axes, shape = _RESHARD_LAYOUTS[kind]
+    if axes == "1d":
+        return mesh_mod.make_mesh_1d(shape[0])
+    return mesh_mod.make_mesh_2d(
+        shape, devices=jax.devices()[: shape[0] * shape[1]]
+    )
+
+
+@given(
+    seed=seeds,
+    src_kind=st.sampled_from(
+        ["none", "1d2", "1d4", "2d2x2", "2d4x2", "batch"]
+    ),
+    dst_kind=st.sampled_from(
+        ["none", "1d2", "1d4", "1d8", "2d2x2", "2d4x2"]
+    ),
+    engine=st.sampled_from(["dense", "bitpack"]),
+    size=st.sampled_from([48, 64]),
+    m=st.integers(1, 5),
+    n=st.integers(1, 5),
+)
+@settings(max_examples=10, deadline=None)
+def test_reshard_resume_equals_straight_run(
+    seed, src_kind, dst_kind, engine, size, m, n
+):
+    """The acceptance pin as a family: evolve m generations, snapshot in
+    a random topology's format (single-file / 1-D / 2-D sharded / batch
+    world), resume-reshard onto a random destination mesh, evolve n
+    more — the result must equal the straight m+n oracle run.  size=48
+    puts the 2-col shard seams sub-word (24-column pieces)."""
+    import tempfile
+
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.runtime import GolRuntime
+
+    if size == 48:
+        engine = "dense"  # bitpack tiers need word-multiple (sub)widths
+    board0 = _board(size, size, seed)
+    ref = oracle.run_torus(board0, m + n)
+    mid = oracle.run_torus(board0, m)
+    tmp = tempfile.mkdtemp()
+    src_mesh = _reshard_mesh("none" if src_kind == "batch" else src_kind)
+    if src_kind == "batch":
+        path = ckpt_prop.batch_checkpoint_path(tmp, m)
+        ckpt_prop.save_batch(path, [np.zeros_like(mid), mid], m)
+    elif src_mesh is None:
+        path = ckpt_prop.checkpoint_path(tmp, m)
+        ckpt_prop.save(path, mid, m, 1)
+    else:
+        path = ckpt_prop.sharded_checkpoint_path(tmp, m)
+        arr = jax.device_put(mid, mesh_mod.board_sharding(src_mesh))
+        ckpt_prop.save_sharded(
+            path, arr, m, 1,
+            mesh_layout=rs.MeshLayout.from_mesh(src_mesh).to_dict(),
+        )
+    dst_mesh = _reshard_mesh(dst_kind)
+    if src_kind == "batch":
+        board, _, _ = rs.load_resharded(path, dst_mesh, kind="batch", world=1)
+        if dst_mesh is None:
+            from gol_tpu.parallel import engine as engine_mod
+
+            out = engine_mod.evolve_fresh(jnp.asarray(board), n)
+        else:
+            from gol_tpu.parallel import sharded as sharded_mod
+
+            out = sharded_mod.compiled_evolve(dst_mesh, n, "explicit", 1)(
+                mesh_mod.place_private(
+                    board, mesh_mod.board_sharding(dst_mesh)
+                )
+            )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        return
+    rt = GolRuntime(
+        geometry=Geometry(size=size, num_ranks=1),
+        engine=engine,
+        mesh=dst_mesh,
+    )
+    _, st_out = rt.run(pattern=0, iterations=n, resume=path)
+    np.testing.assert_array_equal(np.asarray(st_out.board), ref)

@@ -195,3 +195,140 @@ def test_chaos_3d(tmp_path):
         tmp_path, "gol_tpu.cli3d", ["2", "64", "24", "64", "1"], [],
         "World3D_of_1.npy",
     )
+
+
+def _tmps_recursive(ck):
+    """In-flight ``.tmp`` writes anywhere under the checkpoint dir —
+    sharded snapshots nest their piece/manifest tmps inside the
+    ``ckpt_*.gol.d`` directory."""
+    found = []
+    for root, _, names in os.walk(ck):
+        found.extend(
+            os.path.join(root, n) for n in names if n.endswith(".tmp.npz")
+        )
+    return found
+
+
+def test_chaos_shrink_then_resume(tmp_path):
+    """Elastic-mesh chaos (docs/RESILIENCE.md): a supervised 1-D-mesh run
+    is SIGTERM'd, relaunched on a device count the board cannot tile —
+    the shrink policy (GOL_ALLOW_SHRINK, exported by the supervisor)
+    must drop it to a smaller mesh and reshard the 4-shard snapshot onto
+    it — then SIGKILL'd mid-sharded-checkpoint-write, and relaunched
+    again to finish.  The final dump must be byte-identical to an
+    uninterrupted (unmeshed) run, and telemetry must carry the v7
+    ``reshard`` event naming the 1d 4x1 → 1d 2x1 repartition.
+    """
+    ref = tmp_path / "ref"
+    out = tmp_path / "out"
+    ck = str(tmp_path / "ck")
+    tm = str(tmp_path / "tm")
+    manifest = str(tmp_path / "m.json")
+    ref.mkdir()
+    out.mkdir()
+    world = ["4", "256", "40", "512", "1"]
+
+    # Uninterrupted reference (no mesh — mesh-independence is pinned
+    # elsewhere; byte-equality against it is the stronger assertion).
+    subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *world, "--outdir", str(ref)],
+        env=_env(), cwd=REPO, check=True,
+    )
+
+    # The shrink shim: attempt 0 comes up with 4 CPU devices, every
+    # relaunch with 3 — a count the 256-row board cannot tile, forcing
+    # the elastic shrink down to 2.  XLA_FLAGS must be set before jax
+    # imports, hence a wrapper process instead of supervisor env.
+    shim = tmp_path / "shim.py"
+    shim.write_text(
+        "import os, runpy, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "n = 4 if os.environ.get('GOL_RESTART_ATTEMPT', '0') == '0' else 3\n"
+        "os.environ['XLA_FLAGS'] = (\n"
+        "    f'--xla_force_host_platform_device_count={n}'\n"
+        ")\n"
+        "runpy.run_module('gol_tpu', run_name='__main__', alter_sys=True)\n"
+    )
+    child = [
+        sys.executable, str(shim), *world,
+        "--outdir", str(out),
+        "--mesh", "1d", "--sharded-snapshots",
+        "--checkpoint-every", "2", "--checkpoint-dir", ck,
+        "--auto-resume", "--telemetry", tm,
+    ]
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "gol_tpu.resilience", "supervise",
+         "--max-restarts", "4", "--backoff-base", "0",
+         "--manifest", manifest, "--checkpoint-dir", ck, "--", *child],
+        env=_env(write_delay=0.3), cwd=REPO,
+    )
+    try:
+        # Phase 1: SIGTERM the 4-device attempt once a snapshot dir has
+        # a manifest (the sharded promotion point).
+        def _complete():
+            return [
+                n for n in _snapshots(ck)
+                if os.path.exists(os.path.join(ck, n, "manifest.npz"))
+            ]
+
+        pid0 = _wait(
+            lambda: _running_pid(manifest, 0) if _complete() else None,
+            what="attempt 0 with a complete sharded checkpoint",
+        )
+        os.kill(pid0, signal.SIGTERM)
+
+        # Phase 2: SIGKILL the shrunk attempt mid-sharded-write.
+        pid1 = _wait(
+            lambda: _running_pid(manifest, 1), what="attempt 1 to spawn"
+        )
+        before = set(_tmps_recursive(ck))
+        _wait(
+            lambda: set(_tmps_recursive(ck)) - before,
+            what="an in-flight sharded .tmp write",
+        )
+        os.kill(pid1, signal.SIGKILL)
+
+        rc = sup.wait(timeout=300)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+    assert rc == 0, f"supervisor exited {rc}; manifest: {_read_manifest(manifest)}"
+
+    m = _read_manifest(manifest)
+    codes = [a["exit_code"] for a in m["attempts"]]
+    assert codes[0] == 75, f"SIGTERM attempt should exit 75, got {codes}"
+    assert codes[1] == -signal.SIGKILL, (
+        f"SIGKILL attempt should die on signal 9, got {codes}"
+    )
+    assert codes[-1] == 0 and m["finished"]
+
+    # Every promoted snapshot (manifest present) fully verifies; the
+    # torn mid-write dir was never promoted past its tmp names.
+    from gol_tpu.utils import checkpoint as ckpt
+
+    verified = 0
+    for name in _snapshots(ck):
+        if os.path.exists(os.path.join(ck, name, "manifest.npz")):
+            ckpt.verify_snapshot(os.path.join(ck, name))
+            verified += 1
+    assert verified, "no promoted snapshot survived the drill"
+
+    # The shrink really happened and was repartitioned, not restarted:
+    # some attempt's stream carries the v7 reshard event 1d 4x1 -> 1d 2x1.
+    import glob
+
+    reshards = []
+    for path in glob.glob(os.path.join(tm, "*.rank0.jsonl")):
+        for line in open(path):
+            rec = json.loads(line)
+            if rec.get("event") == "reshard":
+                reshards.append(rec)
+    assert any(
+        r["src_mesh"] == {"kind": "1d", "rows": 4, "cols": 1}
+        and r["dst_mesh"] == {"kind": "1d", "rows": 2, "cols": 1}
+        for r in reshards
+    ), f"expected a 1d 4x1 -> 1d 2x1 reshard event, got {reshards}"
+
+    a = (ref / "Rank_0_of_1.txt").read_bytes()
+    b = (out / "Rank_0_of_1.txt").read_bytes()
+    assert a == b, "final grid differs from the uninterrupted run"

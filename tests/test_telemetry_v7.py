@@ -1,0 +1,150 @@
+"""Schema v7 (elastic-mesh reshard event) + v1–v6 back-compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..6}.py.
+Here:
+
+- the v7 addition round-trips: the ``reshard`` event (src/dst mesh
+  layouts, validated-plan accounting, packed transport bytes —
+  docs/RESILIENCE.md);
+- **back-compat**: ALL SIX committed fixtures — PR 2 (v1), PR 3 (v2),
+  PR 5 (v3), PR 6 (v4), PR 7 (v5) and PR 8 (v6) — still load, and a
+  directory holding v1–v6 + a freshly-written v7 stream merges and
+  renders in one ``summarize`` pass (exit 0) including the reshard
+  line, while a bogus schema still exits 2.
+
+Real-run emission (cross-topology resume stamps exactly one event,
+same-mesh resume stamps none) is pinned in tests/test_reshard.py.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+}
+
+RESHARD_FIELDS = dict(
+    generation=8,
+    src_mesh={"kind": "2d", "rows": 4, "cols": 2},
+    dst_mesh={"kind": "1d", "rows": 8, "cols": 1},
+    bytes_moved=512,
+    cells=4096,
+    dst_shards=8,
+    src_pieces=8,
+    moves=16,
+    seam_splits=2,
+    legacy_manifest=False,
+    path="/ck/ckpt_000000000008.gol.d",
+)
+
+
+def _v7_stream(directory, run_id="v7"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "2d", "engine": "auto", "resolved_engine": "bitpack",
+             "height": 64, "width": 64}
+        )
+        ev.compile_event(8, 0.01, 0.11)
+        ev.resume_event(generation=8, path="/ck/x", fallback=False)
+        ev.reshard_event(**RESHARD_FIELDS)
+        ev.chunk_event(0, 8, 16, 0.002, 32768, None)
+        return ev.path
+
+
+def test_v7_reshard_roundtrip(tmp_path):
+    path = _v7_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION == 7
+    assert set(telemetry.SUPPORTED_SCHEMAS) == {1, 2, 3, 4, 5, 6, 7}
+    reshard = recs[3]
+    assert reshard["event"] == "reshard"
+    assert reshard["src_mesh"]["rows"] == 4
+    assert reshard["dst_mesh"]["kind"] == "1d"
+    assert reshard["bytes_moved"] == 512
+    assert reshard["seam_splits"] == 2
+
+
+def test_reshard_event_schema_required_fields():
+    import pytest
+
+    with pytest.raises(telemetry.SchemaError, match="missing fields"):
+        telemetry.validate_record(
+            {"event": "reshard", "t": 0.0, "generation": 8}
+        )
+
+
+def test_committed_fixture_schemas_are_v1_to_v6():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v6_fixture_carries_spans():
+    chunks = [
+        json.loads(ln)
+        for ln in FIXTURES[6].open()
+        if '"chunk"' in ln
+    ]
+    chunks = [c for c in chunks if c["event"] == "chunk"]
+    assert chunks and all("spans" in c for c in chunks)
+
+
+def test_v1_to_v7_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v7_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run", "v7"
+    ):
+        assert run_id in out
+    assert "reshard: generation 8 2d 4x2 -> 1d 8x1" in out
+    assert "512 packed bytes moved" in out
+    assert "(2 seam splits)" in out
+
+
+def test_bogus_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": 99, "run_id": "bad",
+             "process_index": 0, "process_count": 1, "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+
+
+def test_legacy_manifest_flag_renders(tmp_path, capsys):
+    with telemetry.EventLog(str(tmp_path), run_id="leg", process_index=0) \
+            as ev:
+        ev.run_header({"driver": "2d"})
+        ev.reshard_event(
+            generation=4,
+            src_mesh={"kind": "1d", "rows": 2, "cols": 1},
+            dst_mesh={"kind": "none", "rows": 1, "cols": 1},
+            bytes_moved=128,
+            legacy_manifest=True,
+        )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "[legacy manifest]" in out
+    assert "1d 2x1 -> none" in out
