@@ -1,11 +1,11 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
-# smoke + tier-1 tests (see scripts/check.sh).
+# smoke + halo smoke + tier-1 tests (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
-	obs-smoke ledger-check reshard-smoke
+	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep
 
 lint:
 	bash scripts/lint.sh
@@ -80,6 +80,18 @@ ledger-check:
 # non-identity plan and the schema-v7 reshard event stamped.
 reshard-smoke:
 	JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
+
+# Pipelined-halo smoke (docs/DESIGN.md): 512² glider, pipeline k=4 on a
+# 1-D mesh bit-equal to explicit k=1, v8 halo blocks on every chunk.
+halo-smoke:
+	JAX_PLATFORMS=cpu python scripts/halo_smoke.py
+
+# The k-vs-MFU depth sweep (HALO_r07.json's command; curve shape only
+# on CPU — the TPU headline geometry is pinned in the artifact's note).
+halobench-sweep:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	    python -m gol_tpu.utils.halobench 1024 16 1d:4 \
+	    dense,bitpack,pallas --halo-depth-sweep 1,2,4,8,16
 
 check:
 	bash scripts/check.sh

@@ -89,16 +89,17 @@ def expected_exchange_plan(
 ) -> Tuple[int, int]:
     """(generations per full exchange, remainder generations).
 
-    Mirrors the engines' documented chunking: explicit-mode engines ship
-    one ``halo_depth``-deep band per ``halo_depth`` generations (plus one
-    remainder chunk); overlap dense/bitpack exchange every generation;
+    Mirrors the engines' documented chunking: every ring mode —
+    explicit, the depth-k overlap split, and the cross-chunk pipeline —
+    ships one ``halo_depth``-deep band per ``halo_depth`` generations
+    (plus one remainder chunk; the pipeline's remainder *consumes* the
+    carried band instead of exchanging, and its prologue exchange rides
+    outside the loop at full depth, which ``supplied >= need`` admits);
     the sharded Pallas engine always runs 8-aligned bands.
     """
     if engine == "pallas_bitpack":
         depth = 8 if halo_depth == 1 else halo_depth
         return depth, steps % depth
-    if shard_mode == "overlap":
-        return 1, 0
     return halo_depth, steps % halo_depth
 
 
@@ -110,8 +111,11 @@ def slab_depth(engine: str, axis_name: str, shape: Sequence[int]) -> int:
     ``(k, W)`` slices; column bands on axis 1 — the ``(h+2k, k)`` edge
     columns of the row-extended block — except the sharded Pallas
     engine's 1-word column band, which rides transposed ``(words, rows)``
-    for the kernel's lane layout.
+    for the kernel's lane layout.  3-D volume bands (rank-3 operands)
+    carry their depth on the phase's own axis: planes 0, rows 1, cols 2.
     """
+    if len(shape) == 3:
+        return shape[{"planes": 0, "rows": 1, "cols": 2}[axis_name]]
     if axis_name == "cols":
         return shape[0] if engine == "pallas_bitpack" else shape[1]
     return shape[0]
@@ -176,7 +180,8 @@ def check_comm(jaxpr, cfg, mesh) -> CheckResult:
             Finding(
                 ERROR,
                 "comm",
-                "sharded explicit/overlap program contains no ppermute — "
+                "sharded explicit/overlap/pipeline program contains no "
+                "ppermute — "
                 "shards would evolve independently (the reference's bug "
                 "B1, permanently)",
             )
@@ -264,7 +269,10 @@ def check_comm(jaxpr, cfg, mesh) -> CheckResult:
                     "cells",
                 )
             )
-        elif supplied > 4 * STENCIL_RADIUS * max(need, 8):
+        elif supplied > 4 * STENCIL_RADIUS * max(need, 8, quantum):
+            # (quantum in the slack: a word-column axis cannot ship finer
+            # than 32 cells, so k-word bands at small k are convention,
+            # not waste)
             findings.append(
                 Finding(
                     WARN,

@@ -142,6 +142,16 @@ def default_matrix() -> List[EngineConfig]:
             name="dense/1d/overlap", engine="dense", mesh="1d",
             shard_mode="overlap",
         ),
+        # The depth-1 restriction lifted (PR 9): the overlap split at a
+        # deep band, and the cross-chunk pipelined double buffer.
+        EngineConfig(
+            name="dense/1d/overlap/k=4", engine="dense", mesh="1d",
+            shard_mode="overlap", halo_depth=4,
+        ),
+        EngineConfig(
+            name="dense/1d/pipeline/k=4", engine="dense", mesh="1d",
+            shard_mode="pipeline", halo_depth=4,
+        ),
         EngineConfig(
             name="dense/1d/auto", engine="dense", mesh="1d",
             shard_mode="auto",
@@ -153,6 +163,10 @@ def default_matrix() -> List[EngineConfig]:
         EngineConfig(
             name="bitpack/1d/overlap", engine="bitpack", mesh="1d",
             shard_mode="overlap",
+        ),
+        EngineConfig(
+            name="bitpack/1d/pipeline/k=4", engine="bitpack", mesh="1d",
+            shard_mode="pipeline", halo_depth=4,
         ),
         EngineConfig(
             name="bitpack/1d/rule=B36S23", engine="bitpack", mesh="1d",
@@ -173,6 +187,13 @@ def default_matrix() -> List[EngineConfig]:
             mesh="1d", size=128, halo_depth=8, shard_mode="overlap",
             schedule=(16, 16), tile_hint=1024,
         ),
+        # The pipelined Pallas form: the ring ppermutes for chunk N+1
+        # ride operands computed by chunk N's boundary kernels only.
+        EngineConfig(
+            name="pallas_bitpack/1d/pipeline/k=8", engine="pallas_bitpack",
+            mesh="1d", size=128, halo_depth=8, shard_mode="pipeline",
+            schedule=(16, 16), tile_hint=1024,
+        ),
         # Negative entries: the runtime must refuse these cleanly.
         EngineConfig(
             name="pallas/1d (must reject)", engine="pallas", mesh="1d",
@@ -182,6 +203,11 @@ def default_matrix() -> List[EngineConfig]:
             name="bitpack/1d/auto (must reject)", engine="bitpack",
             mesh="1d", shard_mode="auto",
             reject_reason="no auto-SPMD",
+        ),
+        EngineConfig(
+            name="dense/1d/auto/k=2 (must reject)", engine="dense",
+            mesh="1d", shard_mode="auto", halo_depth=2,
+            reject_reason="no band to deepen",
         ),
     ]
 
@@ -203,10 +229,25 @@ def default_matrix() -> List[EngineConfig]:
             mesh="2d", size=128, halo_depth=8, schedule=(8, 8),
             tile_hint=1024,
         ),
+        # PR 9: the depth-k interior/boundary split covers the packed
+        # 2-D decomposition too — the old "1-D (row-ring) only"
+        # rejection is gone, and the pipeline rides the same geometry.
         EngineConfig(
-            name="bitpack/2d/overlap (must reject)", engine="bitpack",
-            mesh="2d", shard_mode="overlap",
-            reject_reason="1-D (row-ring) only",
+            name="bitpack/2d/overlap/k=2", engine="bitpack", mesh="2d",
+            size=128, shard_mode="overlap", halo_depth=2,
+        ),
+        EngineConfig(
+            name="bitpack/2d/pipeline/k=2", engine="bitpack", mesh="2d",
+            size=128, shard_mode="pipeline", halo_depth=2,
+        ),
+        EngineConfig(
+            name="dense/2d/pipeline/k=2", engine="dense", mesh="2d",
+            shard_mode="pipeline", halo_depth=2,
+        ),
+        EngineConfig(
+            name="pallas_bitpack/2d/pipeline/k=8", engine="pallas_bitpack",
+            mesh="2d", size=128, halo_depth=8, shard_mode="pipeline",
+            schedule=(16, 16), tile_hint=1024,
         ),
     ]
     return cfgs

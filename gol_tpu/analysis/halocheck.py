@@ -1,0 +1,370 @@
+"""Static + executed checks over the pipelined depth-k halo programs.
+
+The halo-pipeline matrix — one report per (tier, mesh, mode, k) — proves
+the three invariants the deep-band chunk forms live or die by, the way
+the engine/batch/activity/reshard matrices do (docs/ANALYSIS.md):
+
+- **ring soundness at depth k** — every ppermute in the traced chunk
+  program is a ±1 ring over the right mesh axis, both directions
+  exchanged, and the shipped band is deep enough for the k generations
+  it serves (the main matrix's comm check, re-run here over the deep
+  overlap/pipeline forms, including the 3-D packed tier the main matrix
+  does not cover).
+- **exactly one exchange per chunk** — the whole point of the pipeline:
+  each loop-carried chunk performs exactly one bidirectional band
+  exchange per mesh axis (2 ppermutes).  A second exchange inside the
+  body means the double buffer degenerated to the serial form (latency
+  back at the head of every chunk); zero means a chunk is consuming a
+  band nobody shipped.
+- **shallow-band teeth** — the reason the bit-equality pins can be
+  trusted: a deliberately-broken chunk loop whose exchanged band is one
+  row too shallow (outermost ghost layer zeroed, i.e. depth k-1 dressed
+  as depth k) must visibly diverge from the sequential oracle on the
+  same board, while the real pipelined loop matches it.  If the broken
+  fixture ever agrees with the oracle, the depth invariant has lost its
+  witness and the check fails.
+
+Run as part of ``python -m gol_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gol_tpu.analysis import walker
+from gol_tpu.analysis.checks import check_comm, check_dtype, check_purity
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class HaloConfig:
+    """One cell of the halo-pipeline verification matrix.
+
+    2-D cells build through the real :class:`~gol_tpu.runtime.GolRuntime`
+    dispatch (via :class:`~gol_tpu.analysis.configs.EngineConfig`); the
+    3-D cell goes straight to the packed ring builder — the runtime for
+    3-D lives in cli3d, which validates through the same modes matrix.
+    """
+
+    name: str
+    engine: str  # dense / bitpack / pallas_bitpack / bitpack3d
+    mesh: str  # 1d / 2d / 3d
+    shard_mode: str = "pipeline"
+    halo_depth: int = 4
+    size: int = 64
+    schedule: Tuple[int, ...] = (12, 9)
+    # Pallas tiers trace in interpret mode off-TPU — static checks only;
+    # the dense/bitpack cells carry the executed equivalence + teeth.
+    execute: bool = True
+
+
+def default_halo_matrix() -> List[HaloConfig]:
+    return [
+        HaloConfig("halo/dense/1d/pipeline/k=4", "dense", "1d"),
+        HaloConfig("halo/dense/2d/pipeline/k=2", "dense", "2d",
+                   halo_depth=2),
+        HaloConfig("halo/dense/1d/overlap/k=4", "dense", "1d",
+                   shard_mode="overlap"),
+        HaloConfig("halo/bitpack/1d/pipeline/k=4", "bitpack", "1d"),
+        HaloConfig("halo/bitpack/2d/overlap/k=2", "bitpack", "2d",
+                   shard_mode="overlap", halo_depth=2, size=128),
+        HaloConfig("halo/bitpack/2d/pipeline/k=2", "bitpack", "2d",
+                   halo_depth=2, size=128),
+        HaloConfig("halo/pallas_bitpack/1d/pipeline/k=8", "pallas_bitpack",
+                   "1d", halo_depth=8, size=128, schedule=(16, 16),
+                   execute=False),
+        HaloConfig("halo/pallas_bitpack/2d/pipeline/k=8", "pallas_bitpack",
+                   "2d", halo_depth=8, size=128, schedule=(16, 16),
+                   execute=False),
+        HaloConfig("halo/bitpack3d/3d/pipeline/k=2", "bitpack3d", "3d",
+                   halo_depth=2, size=64, schedule=(8, 6), execute=False),
+    ]
+
+
+def _build(cfg: HaloConfig):
+    """(traceable_fn, arg_spec, comm_cfg, mesh) through the real dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    if cfg.engine == "bitpack3d":
+        from gol_tpu.ops.life3d import BAYS_4555
+        from gol_tpu.parallel import mesh as mesh_mod
+        from gol_tpu.parallel import sharded3d
+
+        mesh = mesh_mod.make_mesh_3d((2, 2, 1), devices=jax.devices()[:4])
+        fn = sharded3d.compiled_evolve3d_packed(
+            mesh, max(cfg.schedule), BAYS_4555, cfg.halo_depth,
+            cfg.shard_mode,
+        )
+        spec = jax.ShapeDtypeStruct(
+            (cfg.size,) * 3, jnp.uint8,
+            sharding=sharded3d.volume_sharding(mesh),
+        )
+        # check_comm keys slab quanta off the 2-D packed engine name;
+        # the 3-D packed tier shares its word-column convention.
+        comm_cfg = dataclasses.replace(cfg, engine="bitpack")
+        return fn, spec, comm_cfg, mesh
+
+    from gol_tpu.analysis.configs import EngineConfig
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    ecfg = EngineConfig(
+        name=cfg.name, engine=cfg.engine, mesh=cfg.mesh, size=cfg.size,
+        schedule=cfg.schedule, shard_mode=cfg.shard_mode,
+        halo_depth=cfg.halo_depth, tile_hint=1024,
+    )
+    rt = ecfg.build_runtime()
+    fn, dynamic, static = rt._evolve_fn(max(cfg.schedule))
+    if dynamic or static:
+        raise RuntimeError(
+            f"{cfg.name}: ring engines take the board only, got extra "
+            f"args {dynamic} / {static}"
+        )
+    h, w = ecfg.board_shape
+    spec = jax.ShapeDtypeStruct(
+        (h, w), jnp.uint8, sharding=mesh_mod.board_sharding(rt.mesh)
+    )
+    return fn, spec, cfg, rt.mesh
+
+
+def check_one_exchange_per_chunk(jaxpr, cfg: HaloConfig, mesh) -> CheckResult:
+    """Each loop-carried chunk exchanges exactly once per mesh axis."""
+    findings: List[Finding] = []
+    per_axis: dict = {}
+    for info in walker.find_eqns(jaxpr, ["ppermute"]):
+        if not info.in_loop:
+            continue  # prologue / remainder-tail exchanges
+        axis = info.eqn.params["axis_name"]
+        axis = axis[0] if isinstance(axis, tuple) else axis
+        per_axis[axis] = per_axis.get(axis, 0) + 1
+    if not per_axis:
+        findings.append(
+            Finding(
+                ERROR,
+                "one-exchange",
+                "no in-loop ppermute: the chunk loop exchanges nothing — "
+                "either the loop unrolled (retrace hazard) or shards "
+                "evolve independently",
+            )
+        )
+    for axis, count in sorted(per_axis.items()):
+        if count != 2:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "one-exchange",
+                    f"axis {axis!r}: {count} in-loop ppermutes per chunk; "
+                    "exactly 2 (one bidirectional band exchange) expected "
+                    "— more means the double buffer degenerated to the "
+                    "serial form, fewer means a band nobody ships",
+                )
+            )
+        else:
+            findings.append(
+                Finding(
+                    INFO,
+                    "one-exchange",
+                    f"axis {axis!r}: one exchange (2 ppermutes) per chunk",
+                )
+            )
+    return CheckResult.from_findings("one-exchange", findings)
+
+
+def _soup(h: int, w: int) -> np.ndarray:
+    rng = np.random.default_rng(907)
+    return (rng.random((h, w)) < 0.33).astype(np.uint8)
+
+
+def check_pipeline_equivalence(
+    cfg: HaloConfig, fn, spec, mesh
+) -> CheckResult:
+    """Executed: the deep-band chunk program == the sequential oracle."""
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import stencil
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    findings: List[Finding] = []
+    steps = max(cfg.schedule)
+    board_np = _soup(*spec.shape)
+    ref = np.asarray(stencil.run(jnp.asarray(board_np), steps))
+    out = fn(
+        mesh_mod.place_private(
+            jnp.asarray(board_np), mesh_mod.board_sharding(mesh)
+        )
+    )
+    if np.array_equal(np.asarray(out), ref):
+        findings.append(
+            Finding(
+                INFO,
+                "pipeline-equivalence",
+                f"{cfg.shard_mode} k={cfg.halo_depth} bit-equal to the "
+                f"sequential oracle over {steps} generations",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                ERROR,
+                "pipeline-equivalence",
+                f"{cfg.shard_mode} k={cfg.halo_depth} diverges from the "
+                f"sequential oracle after {steps} generations",
+            )
+        )
+    return CheckResult.from_findings("pipeline-equivalence", findings)
+
+
+def check_shallow_band_teeth(cfg: HaloConfig) -> CheckResult:
+    """Band one row too shallow ⇒ must diverge; real pipeline ⇒ must not.
+
+    Runs two dense 1-D ring programs on the same soup: the real
+    pipelined loop at depth k, and a broken chunk loop whose exchanged
+    band has its outermost ghost layer zeroed — depth k-1 data dressed
+    in a depth-k shape, exactly the bug a mis-sliced ``ppermute`` operand
+    would produce.  The broken run's outermost generation per chunk reads
+    zeros instead of the neighbor's cells, so it must diverge from the
+    oracle; if it doesn't, the bit-equality pins have no witness on this
+    geometry and the check fails.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gol_tpu import compat
+    from gol_tpu.ops import stencil
+    from gol_tpu.parallel import halo
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel.mesh import ROWS
+
+    findings: List[Finding] = []
+    k = cfg.halo_depth
+    steps = max(cfg.schedule)
+    mesh = mesh_mod.make_mesh_1d(4, devices=jax.devices()[:4])
+    phases = ((0, ROWS, 4),)
+    step = lambda ext: stencil.step_halo_rows(ext[1:-1], ext[0], ext[-1])
+
+    def shallow(bands):
+        out = []
+        for (axis, _, _), (lo, hi) in zip(phases, bands):
+            nd = lo.ndim
+            zero = jnp.zeros_like(
+                lo[halo._axis_slice(nd, axis, slice(0, 1))]
+            )
+            out.append((
+                jnp.concatenate(
+                    [zero, lo[halo._axis_slice(nd, axis, slice(1, None))]],
+                    axis=axis,
+                ),
+                jnp.concatenate(
+                    [hi[halo._axis_slice(nd, axis, slice(None, -1))], zero],
+                    axis=axis,
+                ),
+            ))
+        return tuple(out)
+
+    def broken_local(x):
+        full, rem = divmod(steps, k)
+        for kk in [k] * full + ([rem] if rem else []):
+            bands = shallow(halo.exchange_bands(x, phases, kk))
+            x = halo._consume_chunk(step, phases, x, bands, kk)
+        return x
+
+    from jax.sharding import PartitionSpec as P
+
+    spec = mesh_mod.board_sharding(mesh)
+    broken_fn = jax.jit(
+        compat.shard_map(
+            broken_local, mesh=mesh, in_specs=P(ROWS, None),
+            out_specs=P(ROWS, None),
+        )
+    )
+    real_fn = jax.jit(
+        compat.shard_map(
+            halo.pipelined_local_loop(step, phases, steps, k),
+            mesh=mesh, in_specs=P(ROWS, None), out_specs=P(ROWS, None),
+        )
+    )
+
+    board_np = _soup(64, 64)
+    ref = np.asarray(stencil.run(jnp.asarray(board_np), steps))
+    place = lambda: mesh_mod.place_private(jnp.asarray(board_np), spec)
+    real = np.asarray(real_fn(place()))
+    broken = np.asarray(broken_fn(place()))
+    if not np.array_equal(real, ref):
+        findings.append(
+            Finding(
+                ERROR,
+                "shallow-band",
+                f"the REAL pipelined loop at k={k} diverges from the "
+                "oracle — the teeth check has nothing to witness against",
+            )
+        )
+    elif np.array_equal(broken, ref):
+        findings.append(
+            Finding(
+                ERROR,
+                "shallow-band",
+                "the one-row-too-shallow broken fixture matched the "
+                f"oracle over {steps} generations — the depth invariant "
+                "has no witness on this board; the bit-equality pins "
+                "cannot be trusted to catch a shallow band",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "shallow-band",
+                f"band depth k-1 dressed as k={k} diverges from the "
+                "oracle while the real pipeline matches it — the depth "
+                "invariant has teeth",
+            )
+        )
+    return CheckResult.from_findings("shallow-band", findings)
+
+
+def run_halo_config(cfg: HaloConfig) -> EngineReport:
+    report = EngineReport(config_name=cfg.name)
+    try:
+        fn, spec, comm_cfg, mesh = _build(cfg)
+        jaxpr = walker.trace_jaxpr(fn, spec)
+    except Exception as e:
+        from gol_tpu.analysis.report import FAIL
+
+        report.checks.append(
+            CheckResult("config", FAIL, [
+                Finding(
+                    ERROR, "config",
+                    f"halo program failed to build/trace: {e}",
+                )
+            ])
+        )
+        return report
+    report.checks.append(check_comm(jaxpr, comm_cfg, mesh))
+    report.checks.append(check_dtype(jaxpr, comm_cfg))
+    report.checks.append(check_purity(jaxpr, comm_cfg))
+    report.checks.append(check_one_exchange_per_chunk(jaxpr, cfg, mesh))
+    if cfg.execute:
+        report.checks.append(
+            check_pipeline_equivalence(cfg, fn, spec, mesh)
+        )
+    if cfg.name == "halo/dense/1d/pipeline/k=4":
+        # One teeth run carries the whole matrix: the broken fixture is
+        # mode-independent (any ring form consuming a shallow band reads
+        # the same zeros).
+        report.checks.append(check_shallow_band_teeth(cfg))
+    return report
+
+
+def run_halo_checks(
+    matrix: Optional[List[HaloConfig]] = None,
+) -> List[EngineReport]:
+    return [run_halo_config(c) for c in (matrix or default_halo_matrix())]

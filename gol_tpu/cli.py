@@ -84,9 +84,13 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
         "--activity-capacity", type=float, default=0.25, metavar="FRAC"
     )
     ext.add_argument("--mesh", choices=["none", "1d", "2d"], default="none")
+    # Shard-mode matrix (gol_tpu/parallel/modes.py): hand-placed
+    # ppermutes / depth-k comm-compute overlap / XLA auto-SPMD /
+    # cross-chunk double-buffered pipeline (chunk N+1's ghost band ships
+    # while chunk N's interior computes — docs/DESIGN.md).
     ext.add_argument(
         "--shard-mode",
-        choices=["explicit", "overlap", "auto"],
+        choices=["explicit", "overlap", "auto", "pipeline"],
         default="explicit",
     )
     ext.add_argument("--halo-depth", type=int, default=1, metavar="K")

@@ -103,8 +103,39 @@ def _pallas3d_sharded_fits(mesh, size: int) -> bool:
     )
 
 
+def _halo3d_block(mode: str, k: int, mesh, size: int, take: int) -> dict:
+    """One 3-D chunk's schema-v8 ``halo`` block: the packed ring tier's
+    exchange depth/count and band traffic (three ppermute phases — plane
+    band, row band of the plane-extended shard, word column of both)."""
+    from gol_tpu.parallel.mesh import COLS, PLANES, ROWS
+
+    npl = mesh.shape.get(PLANES, 1)
+    nr = mesh.shape.get(ROWS, 1)
+    nc = mesh.shape.get(COLS, 1)
+    d, h, nw = size // npl, size // nr, size // nc // 32
+
+    def band_bytes(dd: int) -> int:
+        planes = 2 * dd * h * nw * 4
+        rows = 2 * dd * (d + 2 * dd) * nw * 4
+        cols = 2 * dd * (d + 2 * dd) * (h + 2 * dd) * 4
+        return planes + rows + cols
+
+    full, rem = divmod(take, k)
+    chunk_bytes = full * band_bytes(k) + (band_bytes(rem) if rem else 0)
+    state = d * h * nw * 4
+    payload = chunk_bytes + take * state
+    return {
+        "depth": k,
+        "mode": mode,
+        "exchanges": full + (1 if rem else 0),
+        "band_bytes": chunk_bytes,
+        "exchange_share": chunk_bytes / payload if payload else 0.0,
+    }
+
+
 def _build_evolver(
-    engine: str, mesh, steps: int, rule, size: int, stats: bool = False
+    engine: str, mesh, steps: int, rule, size: int, stats: bool = False,
+    shard_mode: str = "explicit", halo_depth: int = 1,
 ):
     """(compiled, place) for the chosen engine/mesh.
 
@@ -155,7 +186,15 @@ def _build_evolver(
             # errors — auto only resolves here when the geometry fits.
             fn = sharded3d.compiled_evolve3d_pallas(mesh, steps, rule)
         elif engine == "bitpack":
-            fn = sharded3d.compiled_evolve3d_packed(mesh, steps, rule)
+            # The packed ring tier carries the temporal-blocking and
+            # chunk-form knobs: --halo-depth K ships a k-deep ghost
+            # shell per exchange, --shard-mode overlap/pipeline runs the
+            # depth-k interior/boundary split / cross-chunk double
+            # buffer (gol_tpu.parallel.halo; same forms as the 2-D
+            # driver, three ppermute phases instead of two).
+            fn = sharded3d.compiled_evolve3d_packed(
+                mesh, steps, rule, halo_depth, shard_mode
+            )
         else:
             sharded3d.validate_geometry3d(spec_shape, mesh)
             fn = sharded3d.compiled_evolve3d(mesh, steps, rule)
@@ -239,6 +278,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ext.add_argument("--rule", default="bays4555")
     ext.add_argument("--engine", choices=ENGINES3D, default="auto")
     ext.add_argument("--mesh", choices=["none", "3d"], default="none")
+    # Ring chunk form + temporal blocking for the packed sharded tier
+    # (--engine bitpack --mesh 3d): explicit serial chunks, the depth-k
+    # interior/boundary overlap split, or the cross-chunk pipelined
+    # double buffer — same matrix as the 2-D driver, one dimension up
+    # (gol_tpu/parallel/modes.py).
+    ext.add_argument(
+        "--shard-mode",
+        choices=["explicit", "overlap", "pipeline"],
+        default="explicit",
+    )
+    ext.add_argument("--halo-depth", type=int, default=1, metavar="K")
     # Explicit (planes, rows, cols) factorization: the fused sharded
     # kernel needs one of planes/rows to be 1 ((P,1,C) or (1,R,C)),
     # which the default most-cubic factorization of 8 devices (2,2,2)
@@ -493,6 +543,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # redundant checker's counterpart engine.
         resolved = _resolve_engine3d(ns.engine, mesh, size)
 
+        if ns.halo_depth < 1:
+            raise ValueError(
+                f"--halo-depth must be >= 1, got {ns.halo_depth}"
+            )
+        if ns.shard_mode != "explicit" or ns.halo_depth != 1:
+            # The chunk-form knobs configure the packed ring tier's
+            # exchange; everything else either has no ring (mesh none,
+            # dense) or owns its own banding (the fused Pallas engine).
+            if mesh is None:
+                raise ValueError(
+                    "--shard-mode/--halo-depth configure the sharded "
+                    "ring exchange; pass --mesh 3d"
+                )
+            if resolved != "bitpack":
+                raise ValueError(
+                    f"--shard-mode {ns.shard_mode!r}/--halo-depth "
+                    f"{ns.halo_depth} apply to the packed ring tier "
+                    f"(engine 'bitpack'); resolved engine is "
+                    f"{resolved!r} — pass --engine bitpack (the fused "
+                    "3-D Pallas engine keeps its own 8-deep banding)"
+                )
+
         from gol_tpu import telemetry as telemetry_mod
 
         num_devices = 1 if mesh is None else mesh.devices.size
@@ -516,6 +588,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     engine=ns.engine,
                     resolved_engine=resolved,
                     mesh=None if mesh is None else dict(mesh.shape),
+                    shard_mode=ns.shard_mode,
+                    halo_depth=ns.halo_depth,
                     rule=rulestr,
                     size=size,
                     checkpoint_every=ns.checkpoint_every,
@@ -638,7 +712,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 for take in set(schedule):
                     t0 = time_mod.perf_counter()
                     evolvers[take] = _build_evolver(
-                        ns.engine, mesh, take, rule, size, stats=ns.stats
+                        ns.engine, mesh, take, rule, size, stats=ns.stats,
+                        shard_mode=ns.shard_mode, halo_depth=ns.halo_depth,
                     )
                     if events is not None:
                         # _build_evolver lowers + compiles in one step;
@@ -738,6 +813,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             sc.add("dispatch", t1 - t0)
                             sc.add("ready", dt - (t1 - t0))
                             spans = sc.take()
+                            extra3 = {}
+                            if mesh is not None and resolved == "bitpack":
+                                # Schema v8: the packed ring tier's
+                                # exchange accounting for this chunk.
+                                extra3["halo"] = _halo3d_block(
+                                    ns.shard_mode, ns.halo_depth,
+                                    mesh, size, take,
+                                )
                             with sc.span("telemetry"):
                                 events.chunk_event(
                                     i,
@@ -747,6 +830,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     size**3 * take,
                                     util3d(take, dt),
                                     spans=spans,
+                                    **extra3,
                                 )
                         if dev_stats is not None and events is not None:
                             from gol_tpu.telemetry import (

@@ -136,6 +136,308 @@ def blocked_local_loop(
     return local
 
 
+# ---------------------------------------------------------------------------
+# Depth-k interior/boundary split + the pipelined double-buffer
+# ---------------------------------------------------------------------------
+#
+# The explicit blocked chunk (halo_extend then k shrinking steps) serializes
+# every chunk on its exchange: nothing computes until the ring delivers the
+# band.  The two forms below break that dependency.
+#
+# - ``overlap_local_loop``: the SAME per-chunk exchange, but the chunk is
+#   computed as interior + boundary slabs — the interior (rows [k, h-k) on
+#   every extended axis, the bulk) reads only local data, so XLA's
+#   latency-hiding scheduler runs the ring ppermutes underneath it; only
+#   the 2k boundary layers per axis wait for the band.  This lifts the
+#   depth-1 restriction of the hand-written overlap steps in ops/stencil.py
+#   to any k.
+#
+# - ``pipelined_local_loop``: the cross-chunk double buffer.  The loop
+#   carries ``(block, bands)``; each iteration consumes the band exchanged
+#   DURING the previous chunk's compute, and ships the next chunk's band
+#   from the just-computed boundary slabs — operands that never depend on
+#   the interior kernel, so the exchange for chunk N+1 is in flight while
+#   chunk N's interior still computes and its latency hides entirely.  The
+#   carried band is "one chunk stale" only in wall-clock: its contents are
+#   the neighbor's boundary at this chunk's start generation, which is
+#   exactly what the ghost shell must hold — correctness is unchanged, and
+#   every form below is pinned bit-identical to the explicit path.
+#
+# Both forms pay the same redundant boundary recompute as any temporal
+# block (each 3k-deep slab re-steps its overlap with the interior).  The
+# split is exact for the integer stencils here: stepping a slab yields
+# bit-identical cells to stepping the whole extended array, because every
+# step is a pure elementwise window op (wraps only on axes both forms keep
+# at full extent).
+
+
+def _axis_slice(ndim: int, axis: int, s: slice):
+    return tuple(s if a == axis else slice(None) for a in range(ndim))
+
+
+def _shrink(step: Callable, x: jax.Array, n: int) -> jax.Array:
+    for _ in range(n):  # each generation consumes one ghost layer
+        x = step(x)
+    return x
+
+
+def exchange_bands(block: jax.Array, phases, depth: int):
+    """The ``depth``-deep ghost bands of ``block``, in phase order.
+
+    Exactly the slices :func:`halo_extend` ships — phase i's bands carry
+    the earlier phases' ghost layers on their corner regions — returned
+    as ``((lo_0, hi_0), ...)`` instead of concatenated, so a pipelined
+    loop can carry them across chunks.
+    """
+    bands = []
+    ext = block
+    for axis, name, n in phases:
+        if block.shape[axis] < depth:
+            raise ValueError(
+                f"halo depth {depth} exceeds shard extent "
+                f"{block.shape[axis]} along axis {axis} ({name}); the ghost "
+                "shell would need cells from beyond the ring neighbor"
+            )
+        lo = lax.ppermute(
+            ext[_axis_slice(ext.ndim, axis, slice(-depth, None))],
+            name,
+            ring(n, 1),
+        )
+        hi = lax.ppermute(
+            ext[_axis_slice(ext.ndim, axis, slice(None, depth))],
+            name,
+            ring(n, -1),
+        )
+        bands.append((lo, hi))
+        ext = jnp.concatenate([lo, ext, hi], axis=axis)
+    return tuple(bands)
+
+
+def assemble_ext(block: jax.Array, bands, phases) -> jax.Array:
+    """Rebuild the halo-extended array from a block and its bands —
+    bit-identical to :func:`halo_extend`'s output for the same depth."""
+    ext = block
+    for (axis, _, _), (lo, hi) in zip(phases, bands):
+        ext = jnp.concatenate([lo, ext, hi], axis=axis)
+    return ext
+
+
+def trim_bands(bands, phases, k: int, kk: int):
+    """Slice ``k``-deep bands down to the ``kk`` layers adjacent to the
+    block (the remainder chunk's consumption of a full-depth band)."""
+    if kk == k:
+        return bands
+    out = []
+    for i, (axis_i, _, _) in enumerate(phases):
+        lo, hi = bands[i]
+        nd = lo.ndim
+        sl_lo = [slice(None)] * nd
+        sl_hi = [slice(None)] * nd
+        sl_lo[axis_i] = slice(-kk, None)  # layers nearest the block
+        sl_hi[axis_i] = slice(None, kk)
+        for j in range(i):  # corner regions shrink with the depth
+            axis_j = phases[j][0]
+            sl_lo[axis_j] = slice(k - kk, -(k - kk))
+            sl_hi[axis_j] = slice(k - kk, -(k - kk))
+        out.append((lo[tuple(sl_lo)], hi[tuple(sl_hi)]))
+    return tuple(out)
+
+
+def can_split(shape, phases, kk: int) -> bool:
+    """Whether the interior/boundary split has a nonempty interior at
+    depth ``kk`` (tiny shards fall back to the whole-array chunk)."""
+    return all(shape[axis] > 2 * kk for axis, _, _ in phases)
+
+
+def split_chunk(step: Callable, phases, block: jax.Array, bands, kk: int):
+    """One interior/boundary-split chunk of ``kk`` generations.
+
+    Returns ``(next_block, slabs)``: ``slabs[i] = (lo, hi)`` are the
+    untrimmed ``kk``-deep boundary slabs of ``next_block`` along each
+    phase axis at full extent on every other axis — exactly the operands
+    a pipelined exchange ships, computed without touching the interior.
+    The interior itself is stepped from ``block`` alone, so it carries no
+    data dependency on the bands (the overlap property).
+    """
+    nd = block.ndim
+    ext = assemble_ext(block, bands, phases)
+    interior = _shrink(step, block, kk)
+    slabs = []
+    for axis, _, _ in phases:
+        # A 3kk-deep slab of ext along this axis covers the kk-deep
+        # output boundary at full extent on every other axis (those stay
+        # ghost-extended in ext, and each step consumes one layer of
+        # every extended axis).
+        lo = _shrink(step, ext[_axis_slice(nd, axis, slice(None, 3 * kk))], kk)
+        hi = _shrink(step, ext[_axis_slice(nd, axis, slice(-3 * kk, None))], kk)
+        slabs.append((lo, hi))
+    out = interior
+    for i in range(len(phases) - 1, -1, -1):
+        axis = phases[i][0]
+        lo, hi = slabs[i]
+        sl = [slice(None)] * nd
+        for j in range(i):  # earlier-phase slabs own the corners
+            sl[phases[j][0]] = slice(kk, -kk)
+        out = jnp.concatenate([lo[tuple(sl)], out, hi[tuple(sl)]], axis=axis)
+    return out, tuple(slabs)
+
+
+def exchange_from_slabs(slabs, phases, k: int):
+    """Ship the next chunk's bands from boundary slabs alone.
+
+    Phase i's operands are the first/last ``k`` layers of the
+    phase-(<i)-extended next block along axis i — assembled from the
+    untrimmed slabs plus the NEW bands of earlier phases (the corner
+    two-hop), so no ppermute operand ever depends on the interior
+    kernel.  This is the property the pipeline exists for: the exchange
+    is already in flight while the interior computes.
+    """
+    bands = []
+    for i, (axis, name, n) in enumerate(phases):
+        lo_shell, hi_shell = slabs[i]
+        nd = lo_shell.ndim
+        for j in range(i):
+            axis_j = phases[j][0]
+            new_lo_j, new_hi_j = bands[j]
+            first = _axis_slice(nd, axis, slice(None, k))
+            last = _axis_slice(nd, axis, slice(-k, None))
+            lo_shell = jnp.concatenate(
+                [new_lo_j[first], lo_shell, new_hi_j[first]], axis=axis_j
+            )
+            hi_shell = jnp.concatenate(
+                [new_lo_j[last], hi_shell, new_hi_j[last]], axis=axis_j
+            )
+        lo = lax.ppermute(hi_shell, name, ring(n, 1))
+        hi = lax.ppermute(lo_shell, name, ring(n, -1))
+        bands.append((lo, hi))
+    return tuple(bands)
+
+
+def _consume_chunk(step: Callable, phases, block: jax.Array, bands, kk: int):
+    """One chunk from an already-exchanged band: split form where the
+    interior is nonempty, whole-extended-array form on tiny shards."""
+    if can_split(block.shape, phases, kk):
+        out, _ = split_chunk(step, phases, block, bands, kk)
+        return out
+    return _shrink(step, assemble_ext(block, bands, phases), kk)
+
+
+def overlap_local_loop(
+    step: Callable,
+    phases,
+    steps: int,
+    halo_depth: int,
+    pack: Optional[Callable] = None,
+    unpack: Optional[Callable] = None,
+) -> Callable:
+    """Depth-k comm/compute-overlap loop (the depth-1 restriction lifted).
+
+    Per chunk: exchange the k-deep bands, then compute the chunk as
+    interior + boundary slabs — the interior launch carries no data
+    dependency on the ppermutes.  Same exchange count and bit-identical
+    results as :func:`blocked_local_loop`.
+    """
+    if halo_depth < 1:
+        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+
+    def chunk(x, kk):
+        return _consume_chunk(step, phases, x, exchange_bands(x, phases, kk), kk)
+
+    full, rem = divmod(steps, halo_depth)
+
+    def local(x):
+        if pack is not None:
+            x = pack(x)
+        if full:
+            x = lax.fori_loop(0, full, lambda _, y: chunk(y, halo_depth), x)
+        if rem:
+            x = chunk(x, rem)
+        if unpack is not None:
+            x = unpack(x)
+        return x
+
+    return local
+
+
+def pipelined_local_loop(
+    step: Callable,
+    phases,
+    steps: int,
+    halo_depth: int,
+    pack: Optional[Callable] = None,
+    unpack: Optional[Callable] = None,
+) -> Callable:
+    """Cross-chunk double-buffered loop (``shard_mode "pipeline"``).
+
+    The loop carries ``(block, bands)``: each iteration consumes the band
+    exchanged during the PREVIOUS chunk's compute and ships the next
+    chunk's band from its just-computed boundary slabs, so exchange
+    latency hides under interior compute entirely.  Exactly one exchange
+    per chunk: one prologue exchange, one per loop iteration, and a
+    remainder chunk that consumes the final band (sliced to its depth)
+    instead of exchanging again; with no remainder the last chunk runs
+    consume-only.  Bit-identical to the explicit blocked loop.
+    """
+    if halo_depth < 1:
+        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+    k = halo_depth
+    full, rem = divmod(steps, k)
+
+    def body(carry):
+        x, bands = carry
+        if can_split(x.shape, phases, k):
+            nx, slabs = split_chunk(step, phases, x, bands, k)
+        else:
+            # Tiny shard: every layer is boundary — compute whole, ship
+            # slices (correct; there is no interior to hide behind).
+            nx = _shrink(step, assemble_ext(x, bands, phases), k)
+            nd = nx.ndim
+            slabs = tuple(
+                (
+                    nx[_axis_slice(nd, axis, slice(None, k))],
+                    nx[_axis_slice(nd, axis, slice(-k, None))],
+                )
+                for axis, _, _ in phases
+            )
+        return nx, exchange_from_slabs(slabs, phases, k)
+
+    def local(x):
+        if pack is not None:
+            x = pack(x)
+        if steps:
+            if full == 0:
+                # Remainder only: one exchange at the remainder's depth.
+                x = _consume_chunk(
+                    step, phases, x, exchange_bands(x, phases, rem), rem
+                )
+            else:
+                bands = exchange_bands(x, phases, k)  # prologue
+                n_loop = full if rem else full - 1
+                if n_loop:
+                    x, bands = lax.fori_loop(
+                        0, n_loop, lambda _, c: body(c), (x, bands)
+                    )
+                if rem:
+                    x = _consume_chunk(
+                        step, phases, x, trim_bands(bands, phases, k, rem), rem
+                    )
+                else:
+                    # Final chunk consume-only — no wasted exchange.
+                    x = _consume_chunk(step, phases, x, bands, k)
+        if unpack is not None:
+            x = unpack(x)
+        return x
+
+    return local
+
+
+LOCAL_LOOPS = {
+    "explicit": blocked_local_loop,
+    "overlap": overlap_local_loop,
+    "pipeline": pipelined_local_loop,
+}
+
+
 def build_ring_engine(
     mesh,
     steps: int,
@@ -144,19 +446,26 @@ def build_ring_engine(
     step_2d: Callable,
     pack: Optional[Callable] = None,
     unpack: Optional[Callable] = None,
+    mode: str = "explicit",
 ):
     """jit'ed shard_map ring engine over a 1-D or 2-D board mesh.
 
     The one builder behind the packed Conway engine and the generic-rule
     engines: picks the row-only or row+column phase list from the mesh's
-    axes, wires the matching shrink-by-one ``step`` through
-    :func:`blocked_local_loop`, and returns the donated-input jitted
+    axes, wires the matching shrink-by-one ``step`` through the ``mode``'s
+    chunk loop (:data:`LOCAL_LOOPS`: explicit blocked / depth-k overlap /
+    pipelined double-buffer), and returns the donated-input jitted
     program.  Keeping this in one place means a change to the mesh-phase
     or donation conventions cannot diverge between engines.
     """
     from gol_tpu.parallel.mesh import COLS, ROWS
     from jax.sharding import PartitionSpec as P
 
+    if mode not in LOCAL_LOOPS:
+        raise ValueError(
+            f"unknown ring-engine mode {mode!r}; expected one of "
+            f"{tuple(LOCAL_LOOPS)}"
+        )
     num_rows = mesh.shape[ROWS]
     num_cols = mesh.shape.get(COLS, 1)
     if COLS in mesh.axis_names:
@@ -166,7 +475,7 @@ def build_ring_engine(
         phases = ((0, ROWS, num_rows),)
         step, spec = step_1d, P(ROWS, None)
 
-    local = blocked_local_loop(
+    local = LOCAL_LOOPS[mode](
         step, phases, steps, halo_depth, pack=pack, unpack=unpack
     )
     shmapped = compat.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)

@@ -1,0 +1,139 @@
+"""The (engine, shard-mode, halo-depth) legality matrix — one authority.
+
+Before PR 9 the answer to "is this combo legal?" lived in four places
+that had already drifted: ``runtime.__post_init__`` grew an ad-hoc
+if-chain per rule (the stale ``halo_depth > 1 requires shard_mode
+'explicit'`` message survived two releases after overlap learned deep
+bands), the engine builders each re-validated their own subset with
+slightly different text, and the CLI and verifier re-derived the matrix
+by hand.  This module is now the single source of truth: the runtime
+validates every sharded configuration through :func:`check_combo` +
+:func:`check_depth`, and the per-combo error messages are pinned by
+``tests/test_mode_plan.py`` so a future mode can't resurrect the drift.
+
+The positive matrix (``ENGINE_MODES``):
+
+====================  ========  =======  ====  ========
+engine                explicit  overlap  auto  pipeline
+====================  ========  =======  ====  ========
+dense                 any k     any k    k=1   any k
+bitpack               any k     any k*   --    any k
+pallas_bitpack        k%8       k%8      --    k%8
+activity              k=1       --       --    --
+====================  ========  =======  ====  ========
+
+(*) the packed depth-1 overlap keeps its hand-written 1-D program;
+depth-1 2-D and every deeper form run the generic interior/boundary
+split in :mod:`gol_tpu.parallel.halo`.  ``pipeline`` is the cross-chunk
+double buffer: the loop carries ``(block, bands)`` and ships chunk
+N+1's ghost band while chunk N's interior computes.  Depth limits
+against shard extents (the ghost shell must come from the immediate
+ring neighbor; packed engines count the width axis in 32-cell words)
+are geometry checks, kept separate in :func:`check_depth`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+SHARD_MODES = ("explicit", "overlap", "auto", "pipeline")
+
+#: Shard modes with a built program per (resolved) engine.
+ENGINE_MODES = {
+    "dense": ("explicit", "overlap", "auto", "pipeline"),
+    "bitpack": ("explicit", "overlap", "pipeline"),
+    "pallas_bitpack": ("explicit", "overlap", "pipeline"),
+    "activity": ("explicit",),
+}
+
+#: Modes whose exchange ships a deeper-than-one-generation ghost band.
+DEEP_BAND_MODES = ("explicit", "overlap", "pipeline")
+
+
+def mode_rejection(engine: str, shard_mode: str) -> Optional[str]:
+    """The canonical rejection message for an (engine, mode) cell that
+    has no program, or ``None`` when the combination is supported."""
+    if shard_mode not in SHARD_MODES:
+        return (
+            f"unknown shard_mode {shard_mode!r}; expected one of "
+            f"{SHARD_MODES}"
+        )
+    allowed = ENGINE_MODES.get(engine)
+    if allowed is None or shard_mode in allowed:
+        return None
+    if engine == "bitpack" and shard_mode == "auto":
+        return (
+            "the bit-packed sharded engine has no auto-SPMD program; "
+            "shard_mode 'auto' applies to engine 'dense'"
+        )
+    if engine == "pallas_bitpack":
+        return (
+            "the sharded Pallas engine has the explicit, overlap and "
+            "pipeline ring programs only (got shard_mode "
+            f"{shard_mode!r})"
+        )
+    if engine == "activity":
+        return (
+            "the sharded activity engine has the explicit ring program "
+            f"only (got shard_mode {shard_mode!r})"
+        )
+    return (
+        f"engine {engine!r} has no {shard_mode!r} program; supported "
+        f"modes: {allowed}"
+    )
+
+
+def check_combo(engine: str, shard_mode: str, halo_depth: int) -> None:
+    """Raise the canonical ``ValueError`` for an illegal (engine, mode,
+    depth) combination — mesh-independent legality only."""
+    reason = mode_rejection(engine, shard_mode)
+    if reason is not None:
+        raise ValueError(reason)
+    if halo_depth < 1:
+        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+    if halo_depth > 1 and shard_mode not in DEEP_BAND_MODES:
+        # Only 'auto' survives the matrix to reach this rule today, but
+        # the check is written against DEEP_BAND_MODES so a future mode
+        # states its band policy instead of inheriting one silently.
+        raise ValueError(
+            "halo_depth > 1 (temporal blocking) requires shard_mode "
+            "'explicit', 'overlap' or 'pipeline' (got "
+            f"{shard_mode!r}): auto-SPMD derives its own per-generation "
+            "exchanges, so there is no band to deepen"
+        )
+    if engine == "pallas_bitpack" and halo_depth > 1 and halo_depth % 8:
+        raise ValueError(
+            "the sharded Pallas engine needs halo_depth to be a "
+            f"multiple of 8 (DMA row alignment), got {halo_depth}"
+        )
+    if engine == "activity" and halo_depth != 1:
+        raise ValueError(
+            "engine 'activity' exchanges one-tile mask halos per "
+            f"generation; halo_depth must be 1, got {halo_depth}"
+        )
+
+
+def check_depth(
+    halo_depth: int,
+    shard_h: int,
+    shard_w: int,
+    two_d: bool,
+    units: str = "cells",
+) -> None:
+    """Depth-vs-shard-extent limit: the ghost shell must come entirely
+    from the immediate ring neighbor.
+
+    ``shard_h``/``shard_w`` are the per-shard extents in each axis's
+    exchange quantum — rows vertically, 32-cell words horizontally for
+    the packed engines (``units`` names them for the message).  A 2-D
+    mesh extends the width axis even when its cols ring has size 1 (the
+    ring degenerates to the local wrap), so the width limit applies
+    whenever ``two_d`` is set.
+    """
+    limit = min(shard_h, shard_w) if two_d else shard_h
+    if halo_depth > limit:
+        raise ValueError(
+            f"halo_depth {halo_depth} exceeds the shard extent "
+            f"({shard_h}×{shard_w} rows×{units}); the ghost shell must "
+            "come from the immediate ring neighbor"
+        )

@@ -141,7 +141,9 @@ def compiled_evolve_packed_overlap(mesh: Mesh, steps: int):
 
 
 @functools.lru_cache(maxsize=64)
-def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
+def compiled_evolve_packed(
+    mesh: Mesh, steps: int, halo_depth: int = 1, mode: str = "explicit"
+):
     """Build + jit the packed sharded evolve for (mesh, steps, halo_depth).
 
     Dense uint8 board in/out with the canonical mesh sharding; pack /
@@ -157,6 +159,14 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
     against ``2k`` rows + ``2k`` single-cell columns for the dense engine;
     still ~8× fewer bytes on the row axis, break-even on the word axis at
     k=1, and k× fewer ppermute latencies either way.
+
+    ``mode`` picks the chunk loop (:data:`gol_tpu.parallel.halo.
+    LOCAL_LOOPS`): "explicit" (serial blocked chunks), "overlap" (depth-k
+    interior/boundary split — the packed counterpart of the dense
+    engine's lifted overlap mode, now on 1-D AND 2-D meshes at any k),
+    or "pipeline" (the cross-chunk double buffer: chunk N+1's packed
+    band ships while chunk N's interior computes).  All modes are pinned
+    bit-identical to explicit.
     """
     return build_ring_engine(
         mesh,
@@ -166,13 +176,14 @@ def compiled_evolve_packed(mesh: Mesh, steps: int, halo_depth: int = 1):
         step_2d=bitlife.step_packed_halo_full,  # row + word-column layer
         pack=bitlife.pack,
         unpack=bitlife.unpack,
+        mode=mode,
     )
 
 
 @functools.lru_cache(maxsize=64)
 def compiled_evolve_packed_pallas(
     mesh: Mesh, steps: int, halo_depth: int = 8, tile_hint: int = 1024,
-    rule=None, overlap: bool = False,
+    rule=None, overlap: bool = False, pipeline: bool = False,
 ):
     """Sharded evolve running the fused Pallas kernel per shard.
 
@@ -219,6 +230,25 @@ def compiled_evolve_packed_pallas(
     bitwise work) — hence a mode, not the default: serial wins single-chip,
     overlap wins when exchange latency is exposed (multi-chip, DCN).
 
+    ``pipeline=True`` is the cross-chunk double buffer (``--shard-mode
+    pipeline``): the chunk loop carries ``(block, bands)`` — each chunk
+    consumes the k-row ghost bands exchanged DURING the previous chunk's
+    compute, and ships the next chunk's bands from its own just-computed
+    k-row boundary kernels (whose outputs are exactly the rows the ring
+    must carry), so the ring ppermutes for chunk N+1 are in flight while
+    chunk N's interior kernel — which reads only carried state — still
+    runs.  Where overlap hides the exchange under the *same* chunk's
+    interior (the band must still arrive before the boundary kernels),
+    pipeline removes the arrival deadline entirely: the band has a full
+    chunk of interior compute to cross the wire.  One exchange per chunk
+    exactly (prologue + one per loop chunk; a remainder chunk consumes
+    the final carried band sliced to its depth, and with no remainder
+    the last chunk runs consume-only).  The carried band is one chunk
+    "stale" only in wall-clock — its contents are the neighbor's
+    boundary rows at this chunk's start generation, which is precisely
+    what the ghost shell must hold.  Geometry constraints match overlap
+    (the interior tile must clear both bands).
+
     **Narrow shards** (packed width not a multiple of 128 lanes — e.g.
     BASELINE config 3 on a 16×16 mesh: 1024-cell = 32-word shards) are
     evolved **lane-folded**: ``f = 128/gcd(nw, 128)`` row groups side by
@@ -258,6 +288,10 @@ def compiled_evolve_packed_pallas(
     from gol_tpu.ops import pallas_bitlife
 
     two_d = COLS in mesh.axis_names
+    if overlap and pipeline:
+        raise ValueError(
+            "overlap and pipeline are distinct chunk forms; pick one"
+        )
     if halo_depth < 8 or halo_depth % 8:
         raise ValueError(
             f"the sharded Pallas engine needs halo_depth to be a multiple "
@@ -585,6 +619,156 @@ def compiled_evolve_packed_pallas(
             [edges[:, :1], rows_out[:, 1:-1], edges[:, 1:]], axis=1
         )
 
+    def chunk_pipe_pieces(p_u32, bt, bb, tile_int):
+        """The three row pieces of one pipelined chunk, consuming the
+        CARRIED bands ``bt``/``bb`` (exchanged during the previous
+        chunk's compute).  Strip repair included, so the pieces are the
+        exact rows the next exchange ships."""
+        k = halo_depth
+        interior = kernel(p_u32, tile_int, k)  # carried state only
+        top = kernel(jnp.concatenate([bt, p_u32[: 2 * k]]), k, k)
+        bottom = kernel(jnp.concatenate([p_u32[-2 * k :], bb]), k, k)
+        if strip_fix:
+            # Same repair as chunk2d_overlap, spliced per piece (concat
+            # of spliced pieces == splice of the concat); the COLS
+            # ppermutes inside edge_strips read only carried state, so
+            # they too are in flight before the interior kernel.
+            edges = edge_strips(bt, four(p_u32), bb)
+            top = jnp.concatenate(
+                [edges[:k, :1], top[:, 1:-1], edges[:k, 1:]], axis=1
+            )
+            interior = jnp.concatenate(
+                [edges[k:-k, :1], interior[:, 1:-1], edges[k:-k, 1:]],
+                axis=1,
+            )
+            bottom = jnp.concatenate(
+                [edges[-k:, :1], bottom[:, 1:-1], edges[-k:, 1:]], axis=1
+            )
+        return top, interior, bottom
+
+    def chunk_folded_pipe_pieces(fp, tg, bg, tile_int, f):
+        """Folded counterpart: carried state is ``(fp, tg, bg)`` with the
+        two RING ghosts in unfolded ``[k, nw]`` layout; the interior
+        group seams' band parts are lane-shifted slices of ``fp`` itself
+        (carried state, no wire), exactly as in bands_folded."""
+        k = halo_depth
+        hg, fnw = fp.shape
+        nw = fnw // f
+        top_band = jnp.concatenate([tg, fp[hg - k :, : (f - 1) * nw]], axis=1)
+        bot_band = jnp.concatenate([fp[:k, nw:], bg], axis=1)
+        interior = kernel(fp, tile_int, k, groups=f)  # folded [k, hg-k)
+        top = kernel(
+            jnp.concatenate([top_band, fp[: 2 * k]]), k, k, groups=f
+        )
+        bottom = kernel(
+            jnp.concatenate([fp[-2 * k :], bot_band]), k, k, groups=f
+        )
+        if strip_fix:
+            edges_f = folded_edges(fp, tg, bg, f)
+
+            def splice(piece, rows):
+                return jnp.concatenate(
+                    [
+                        part
+                        for g in range(f)
+                        for part in (
+                            edges_f[rows, 2 * g : 2 * g + 1],
+                            piece[:, g * nw + 1 : (g + 1) * nw - 1],
+                            edges_f[rows, 2 * g + 1 : 2 * g + 2],
+                        )
+                    ],
+                    axis=1,
+                )
+
+            top = splice(top, slice(None, k))
+            interior = splice(interior, slice(k, hg - k))
+            bottom = splice(bottom, slice(hg - k, None))
+        return top, interior, bottom
+
+    def tail_consume(p_u32, bt, bb):
+        """The remainder chunk of a pipelined run: consume the carried
+        bands (sliced to depth rem) instead of exchanging again — same
+        values the serial tails' halo_extend would ship."""
+        ext_rows = jnp.concatenate([bt[-rem:], p_u32, bb[:rem]])
+        if strip_fix:
+            left = lax.ppermute(ext_rows[:, -1:], COLS, ring(num_cols, 1))
+            right = lax.ppermute(ext_rows[:, :1], COLS, ring(num_cols, -1))
+            ext = jnp.concatenate([left, ext_rows, right], axis=1)
+            for _ in range(rem):
+                ext = jnp_step_nowrap(ext)
+            return ext[:, 1:-1]
+        ext = ext_rows
+        for _ in range(rem):  # each step consumes one ghost layer
+            ext = jnp_step(ext)
+        return ext
+
+    def local_pipeline(packed, fold):
+        """The pipelined chunk loop: prologue exchange, carried
+        ``(block, bands)`` iterations each shipping the next chunk's
+        bands from its boundary pieces, and a band-consuming tail."""
+        k = halo_depth
+        if full == 0:
+            # steps < band depth: a single serial-tail chunk.
+            return (tail2d if strip_fix else tail)(packed)
+        n_loop = full if rem else full - 1
+        if fold > 1:
+            hg = packed.shape[0] // fold
+            nw = packed.shape[1]
+            tile = pallas_bitlife.pick_tile(
+                hg - 2 * k, fold * nw, tile_hint
+            )
+            fp = fold_rows(packed, fold)
+            tg = lax.ppermute(
+                fp[hg - k :, (fold - 1) * nw :], ROWS, ring(num_rows, 1)
+            )
+            bg = lax.ppermute(fp[:k, :nw], ROWS, ring(num_rows, -1))
+
+            def body_f(_, carry):
+                q, t, b = carry
+                top, inter, bottom = chunk_folded_pipe_pieces(
+                    q, t, b, tile, fold
+                )
+                nq = jnp.concatenate([top, inter, bottom])
+                nt = lax.ppermute(
+                    bottom[:, (fold - 1) * nw :], ROWS, ring(num_rows, 1)
+                )
+                nb = lax.ppermute(top[:, :nw], ROWS, ring(num_rows, -1))
+                return nq, nt, nb
+
+            if n_loop:
+                fp, tg, bg = lax.fori_loop(
+                    0, n_loop, body_f, (fp, tg, bg)
+                )
+            if rem:
+                return tail_consume(unfold_rows(fp, fold), tg, bg)
+            top, inter, bottom = chunk_folded_pipe_pieces(
+                fp, tg, bg, tile, fold
+            )
+            return unfold_rows(
+                jnp.concatenate([top, inter, bottom]), fold
+            )
+        tile = pallas_bitlife.pick_tile(
+            packed.shape[0] - 2 * k, packed.shape[1], tile_hint
+        )
+        bt, bb = bands_for(packed)  # prologue
+
+        def body(_, carry):
+            q, t, b = carry
+            top, inter, bottom = chunk_pipe_pieces(q, t, b, tile)
+            nq = jnp.concatenate([top, inter, bottom])
+            nt = lax.ppermute(bottom, ROWS, ring(num_rows, 1))
+            nb = lax.ppermute(top, ROWS, ring(num_rows, -1))
+            return nq, nt, nb
+
+        if n_loop:
+            packed, bt, bb = lax.fori_loop(
+                0, n_loop, body, (packed, bt, bb)
+            )
+        if rem:
+            return tail_consume(packed, bt, bb)
+        top, inter, bottom = chunk_pipe_pieces(packed, bt, bb, tile)
+        return jnp.concatenate([top, inter, bottom])
+
     def tail(p_u32):
         # One depth-rem exchange feeds all leftover generations (the
         # blocked-chunk pattern of halo.blocked_local_loop), instead of
@@ -607,6 +791,10 @@ def compiled_evolve_packed_pallas(
         h, w = board.shape  # per-shard block (static under shard_map)
         nw = w // bitlife.BITS
         fold = pallas_bitlife.fold_factor(nw)
+        # Overlap and pipeline share the split geometry: the interior
+        # kernel needs an aligned row tile clear of both k-row bands.
+        split = overlap or pipeline
+        split_name = "pipeline" if pipeline else "overlap"
         if fold > 1:
             # Narrow shard: evolve in the lane-folded [h/f, f*nw] layout
             # (see fold_rows) so the kernel still fills whole 128-lane
@@ -614,7 +802,7 @@ def compiled_evolve_packed_pallas(
             # width, where nw = 32.  The kernel's group-local lane rolls
             # keep the fold exact, so the only constraints are geometric.
             feasible = pallas_bitlife.fold_feasible(
-                h, fold, overlap, halo_depth
+                h, fold, split, halo_depth
             )
             if not feasible:
                 if jax.default_backend() == "tpu":
@@ -624,10 +812,10 @@ def compiled_evolve_packed_pallas(
                         f"lifts that but needs shard height divisible by "
                         f"{fold * 8} (got {h})"
                         + (
-                            f" and, in overlap mode, folded height h/f >= "
-                            f"2*halo_depth + 8 = {2 * halo_depth + 8} "
-                            f"(got {h // fold})"
-                            if overlap
+                            f" and, in {split_name} mode, folded height "
+                            f"h/f >= 2*halo_depth + 8 = "
+                            f"{2 * halo_depth + 8} (got {h // fold})"
+                            if split
                             else ""
                         )
                     )
@@ -643,14 +831,16 @@ def compiled_evolve_packed_pallas(
                 f"the 2-D sharded Pallas engine needs >= 2 packed words "
                 f"per shard (edge-word strips), got shard width {w}"
             )
-        if overlap and h < 2 * halo_depth + 8:
+        if split and h < 2 * halo_depth + 8:
             raise ValueError(
-                f"overlap mode needs shard height (got {h}) >= "
+                f"{split_name} mode needs shard height (got {h}) >= "
                 f"2*halo_depth + 8 = {2 * halo_depth + 8}: the interior "
                 "kernel must keep at least one aligned row tile that does "
                 "not touch the exchanged band"
             )
         packed = bitlife.pack(board)
+        if pipeline:
+            return bitlife.unpack(local_pipeline(packed, fold))
         if fold > 1 and overlap:
             # Interior tile lives clear of both exchanged bands, so the
             # tileable extent is the folded height minus the 2k margin.

@@ -50,11 +50,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from gol_tpu import compat
 from gol_tpu.ops import stencil
-from gol_tpu.parallel.halo import halo_extend, ring
+from gol_tpu.parallel.halo import (
+    halo_extend,
+    overlap_local_loop,
+    pipelined_local_loop,
+    ring,
+)
 from gol_tpu.parallel.mesh import COLS, ROWS, board_sharding, validate_geometry
 from gol_tpu.parallel.mesh import place_private as mesh_place_private
 
-MODES = ("explicit", "overlap", "auto")
+MODES = ("explicit", "overlap", "auto", "pipeline")
 
 
 def exchange_row_halos(block: jax.Array, num_rows: int):
@@ -86,12 +91,21 @@ def exchange_block_halos(block: jax.Array, num_rows: int, num_cols: int):
 def compiled_evolve(mesh: Mesh, steps: int, mode: str, halo_depth: int = 1):
     """Build + jit the sharded evolve for (mesh, steps, mode, halo_depth).
 
-    ``halo_depth=k > 1`` is temporal blocking (mode "explicit" only): each
-    exchange ships a k-deep ghost band and the shard then steps k
-    generations locally, consuming one ghost layer per generation — 2
-    ppermutes per axis per k generations instead of per generation, at the
-    cost of a k-wide band of redundant compute at shard edges (negligible
-    for big shards, a large win when exchange latency dominates).
+    ``halo_depth=k > 1`` is temporal blocking (modes "explicit",
+    "overlap" and "pipeline"): each exchange ships a k-deep ghost band
+    and the shard then steps k generations locally, consuming one ghost
+    layer per generation — 2 ppermutes per axis per k generations
+    instead of per generation, at the cost of a k-wide band of redundant
+    compute at shard edges (negligible for big shards, a large win when
+    exchange latency dominates).  "overlap" splits each chunk
+    interior/boundary so the exchange hides under the interior stencil
+    (the depth-1 split generalized by
+    :func:`gol_tpu.parallel.halo.overlap_local_loop`); "pipeline"
+    additionally double-buffers ACROSS chunks — the loop carries
+    ``(block, bands)`` and ships chunk N+1's band from chunk N's
+    boundary slabs while chunk N's interior computes
+    (:func:`gol_tpu.parallel.halo.pipelined_local_loop`), so no chunk
+    ever starts by waiting on the ring.
 
     The returned function donates its input buffer (the framework's double
     buffer); callers who need the input afterwards must pass a copy.
@@ -100,11 +114,11 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str, halo_depth: int = 1):
         raise ValueError(f"unknown mode {mode!r}; expected one of {MODES}")
     if halo_depth < 1:
         raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
-    if halo_depth > 1 and mode != "explicit":
+    if halo_depth > 1 and mode == "auto":
         raise ValueError(
-            f"halo_depth > 1 requires mode 'explicit' (got mode {mode!r}): "
-            "auto-SPMD derives its own exchanges and overlap's "
-            "interior/boundary split assumes single-layer halos"
+            f"halo_depth > 1 requires mode 'explicit', 'overlap' or "
+            f"'pipeline' (got mode {mode!r}): auto-SPMD derives its own "
+            "per-generation exchanges, so there is no band to deepen"
         )
     if mode == "auto":
         # XLA SPMD derives collective-permutes from the sharded torus rolls.
@@ -122,6 +136,7 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str, halo_depth: int = 1):
 
     if two_d:
         phases = ((0, ROWS, num_rows), (1, COLS, num_cols))
+        shrink_step = stencil.step_halo_full
 
         def chunk(blk, k):
             ext = halo_extend(blk, phases, depth=k)
@@ -136,6 +151,9 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str, halo_depth: int = 1):
         spec = P(ROWS, COLS)
     else:
         phases = ((0, ROWS, num_rows),)
+        shrink_step = lambda ext: stencil.step_halo_rows(
+            ext[1:-1], ext[0], ext[-1]
+        )
 
         def chunk(blk, k):
             ext = halo_extend(blk, phases, depth=k)
@@ -149,23 +167,33 @@ def compiled_evolve(mesh: Mesh, steps: int, mode: str, halo_depth: int = 1):
 
         spec = P(ROWS, None)
 
-    # Depth-1 explicit mode IS a one-generation chunk; overlap has its own
-    # interior/boundary split (single-layer halos only).
-    body = overlap_body if overlap else (lambda _, blk: chunk(blk, 1))
-
-    if halo_depth == 1:
-        local_loop = lambda b: lax.fori_loop(0, steps, body, b)
+    if mode == "pipeline":
+        # Cross-chunk double buffer: the loop carries (block, bands);
+        # chunk N+1's band ships from chunk N's boundary slabs while
+        # chunk N's interior computes (gol_tpu.parallel.halo).
+        local_loop = pipelined_local_loop(shrink_step, phases, steps, halo_depth)
+    elif overlap and halo_depth > 1:
+        # Depth-k interior/boundary split: the depth-1 restriction lifted
+        # — the interior launch still carries no ppermute dependency.
+        local_loop = overlap_local_loop(shrink_step, phases, steps, halo_depth)
     else:
-        full, rem = divmod(steps, halo_depth)
+        # Depth-1 explicit mode IS a one-generation chunk; depth-1
+        # overlap keeps its hand-written split (byte-identical program).
+        body = overlap_body if overlap else (lambda _, blk: chunk(blk, 1))
 
-        def local_loop(b):
-            if full:
-                b = lax.fori_loop(
-                    0, full, lambda _, x: chunk(x, halo_depth), b
-                )
-            if rem:
-                b = chunk(b, rem)
-            return b
+        if halo_depth == 1:
+            local_loop = lambda b: lax.fori_loop(0, steps, body, b)
+        else:
+            full, rem = divmod(steps, halo_depth)
+
+            def local_loop(b):
+                if full:
+                    b = lax.fori_loop(
+                        0, full, lambda _, x: chunk(x, halo_depth), b
+                    )
+                if rem:
+                    b = chunk(b, rem)
+                return b
 
     local = compat.shard_map(
         local_loop,

@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gol_tpu import compat
 from gol_tpu.ops.life3d import BAYS_4555, Rule3D, step3d_halo_full
-from gol_tpu.parallel.halo import blocked_local_loop, halo_extend
+from gol_tpu.parallel.halo import LOCAL_LOOPS, blocked_local_loop, halo_extend
 from gol_tpu.parallel.mesh import COLS, PLANES, ROWS, place_private
 
 
@@ -104,7 +104,8 @@ def validate_geometry3d_packed(shape, mesh: Mesh) -> None:
 
 @functools.lru_cache(maxsize=64)
 def compiled_evolve3d_packed(
-    mesh: Mesh, steps: int, rule: Rule3D, halo_depth: int = 1
+    mesh: Mesh, steps: int, rule: Rule3D, halo_depth: int = 1,
+    mode: str = "explicit",
 ):
     """Packed sharded 3-D evolve: word halos over three ppermute phases.
 
@@ -112,11 +113,22 @@ def compiled_evolve3d_packed(
     words — 8× less halo wire on the plane/row faces, word-quantum ghost
     columns along x.  ``halo_depth=k`` is temporal blocking exactly as in
     :func:`gol_tpu.parallel.packed.compiled_evolve_packed`: one 6-ppermute
-    exchange per k generations.
+    exchange per k generations.  ``mode`` picks the chunk loop
+    (:data:`gol_tpu.parallel.halo.LOCAL_LOOPS`): "explicit" serial
+    chunks, "overlap" the depth-k interior/boundary split (the interior
+    volume reads no exchanged shell), or "pipeline" the cross-chunk
+    double buffer — the next chunk's three-phase ghost shell ships from
+    the current chunk's boundary slabs while its interior computes.  All
+    three are pinned bit-identical.
     """
     from gol_tpu.ops import bitlife3d
 
-    local = blocked_local_loop(
+    if mode not in LOCAL_LOOPS:
+        raise ValueError(
+            f"unknown 3-D ring mode {mode!r}; expected one of "
+            f"{tuple(LOCAL_LOOPS)}"
+        )
+    local = LOCAL_LOOPS[mode](
         lambda ext: bitlife3d.step3d_packed_halo_full(ext, rule),
         _phases(mesh),
         steps,
@@ -137,10 +149,11 @@ def evolve_sharded3d_packed(
     mesh: Mesh,
     rule: Rule3D = BAYS_4555,
     halo_depth: int = 1,
+    mode: str = "explicit",
 ) -> jax.Array:
     """Packed-engine counterpart of :func:`evolve_sharded3d`."""
     validate_geometry3d_packed(vol.shape, mesh)
-    return compiled_evolve3d_packed(mesh, steps, rule, halo_depth)(
+    return compiled_evolve3d_packed(mesh, steps, rule, halo_depth, mode)(
         place_private(vol, volume_sharding(mesh))
     )
 

@@ -231,42 +231,46 @@ class GolRuntime:
         if self._rule is not None and self.mesh is not None:
             # B3/S23 stays on the hard-wired fast paths; other rules run
             # the generic evaluators — sharded via the explicit ring
-            # engine, or the sharded Pallas engine's overlap form (its
-            # kernel carries the generic rule tail).  Checked against the
-            # *resolved* engine so 'auto' runs that resolve to the Pallas
-            # engine get the same allowance as an explicit choice.
+            # engine, or the sharded Pallas engine's overlap/pipeline
+            # forms (its kernel carries the generic rule tail).  Checked
+            # against the *resolved* engine so 'auto' runs that resolve
+            # to the Pallas engine get the same allowance as an explicit
+            # choice.
             if self.shard_mode != "explicit" and not (
-                self.shard_mode == "overlap"
+                self.shard_mode in ("overlap", "pipeline")
                 and self._resolved == "pallas_bitpack"
             ):
                 raise ValueError(
                     "custom rules shard via the explicit ring engine (any "
-                    "engine) or the sharded Pallas engine's overlap form "
-                    f"(engine 'pallas_bitpack'); shard_mode "
+                    "engine) or the sharded Pallas engine's overlap/"
+                    f"pipeline forms (engine 'pallas_bitpack'); shard_mode "
                     f"{self.shard_mode!r} with engine {self._resolved!r} "
                     "is a Conway-specific program"
                 )
         if self._resolved == "activity":
             self._init_activity()
-        if self.halo_depth > 1:
-            if self.mesh is None:
+        # (engine, mode, depth) legality — ONE authority
+        # (gol_tpu.parallel.modes; the per-combo messages are pinned by
+        # tests/test_mode_plan.py).  Geometry limits follow.
+        from gol_tpu.parallel import modes as modes_mod
+
+        if self.mesh is None:
+            if self.halo_depth > 1:
                 raise ValueError(
                     "halo_depth > 1 (temporal blocking) only applies to "
                     "sharded runs; pass a mesh"
                 )
-            if self.shard_mode != "explicit" and not (
-                self.shard_mode == "overlap"
-                and self._resolved == "pallas_bitpack"
-            ):
-                # The sharded Pallas engine's overlap form keeps the
-                # k-deep band exchange (its interior/boundary split is
-                # band-depth-aware); the dense/XLA-packed overlap splits
-                # assume single-layer halos.
+            if self.shard_mode == "pipeline":
                 raise ValueError(
-                    "halo_depth > 1 requires shard_mode 'explicit' (or "
-                    "'overlap' with the sharded Pallas engine); got "
-                    f"{self.shard_mode!r}"
+                    "shard_mode 'pipeline' double-buffers ring exchanges "
+                    "across chunks, which only exist on sharded runs; "
+                    "pass a mesh"
                 )
+        elif self._resolved in modes_mod.ENGINE_MODES:
+            modes_mod.check_combo(
+                self._resolved, self.shard_mode, self.halo_depth
+            )
+        if self.halo_depth > 1 and self.mesh is not None:
             rows = self.mesh.shape.get(mesh_mod.ROWS, 1)
             cols = self.mesh.shape.get(mesh_mod.COLS, 1)
             shard_h = self.geometry.global_height // rows
@@ -277,19 +281,15 @@ class GolRuntime:
             # engine's horizontal quantum is the 32-cell word, so its
             # width-axis extent counts in words.
             two_d = mesh_mod.COLS in self.mesh.axis_names
+            units = "cells"
             if self._resolved == "bitpack":
                 from gol_tpu.ops import bitlife
 
                 shard_w //= bitlife.BITS
-            limit = min(shard_h, shard_w) if two_d else shard_h
-            if self.halo_depth > limit:
-                raise ValueError(
-                    f"halo_depth {self.halo_depth} exceeds the shard extent "
-                    f"({shard_h}×{shard_w} rows×"
-                    f"{'words' if self._resolved == 'bitpack' else 'cells'}); "
-                    "the ghost shell must come from the immediate ring "
-                    "neighbor"
-                )
+                units = "words"
+            modes_mod.check_depth(
+                self.halo_depth, shard_h, shard_w, two_d, units
+            )
         if self.mesh is not None:
             if self.halo_mode != "fresh":
                 raise ValueError(
@@ -308,13 +308,9 @@ class GolRuntime:
                 )
             shape = (self.geometry.global_height, self.geometry.global_width)
             if self._resolved == "pallas_bitpack":
-                if self.shard_mode not in ("explicit", "overlap"):
-                    raise ValueError(
-                        "the sharded Pallas engine has the explicit and "
-                        "overlap ring programs only (got shard_mode "
-                        f"{self.shard_mode!r})"
-                    )
-                if self.shard_mode == "overlap":
+                if self.shard_mode in ("overlap", "pipeline"):
+                    # Both split forms need the interior kernel's aligned
+                    # row tile clear of the exchanged bands.
                     depth = 8 if self.halo_depth == 1 else self.halo_depth
                     shard_h = self.geometry.global_height // self.mesh.shape[
                         mesh_mod.ROWS
@@ -350,18 +346,13 @@ class GolRuntime:
                             )
                         if shard_h // fold < 2 * depth + 8:
                             raise ValueError(
-                                f"overlap mode needs shard height ({shard_h}"
+                                f"{self.shard_mode} mode needs shard "
+                                f"height ({shard_h}"
                                 + (f", folded /{fold}" if fold > 1 else "")
                                 + f") >= 2*halo_depth + 8 = {2 * depth + 8}; "
                                 "shrink halo_depth or use shard_mode "
                                 "'explicit'"
                             )
-                if self.halo_depth > 1 and self.halo_depth % 8:
-                    raise ValueError(
-                        "the sharded Pallas engine needs halo_depth to be "
-                        "a multiple of 8 (DMA row alignment), got "
-                        f"{self.halo_depth}"
-                    )
                 from gol_tpu.ops import bitlife
 
                 if (
@@ -375,21 +366,10 @@ class GolRuntime:
                     )
                 packed_mod.validate_packed_geometry(shape, self.mesh)
             elif self._resolved == "bitpack":
-                if self.shard_mode == "auto":
-                    raise ValueError(
-                        "the bit-packed sharded engine has no auto-SPMD "
-                        "program; shard_mode 'auto' applies to engine "
-                        "'dense'"
-                    )
-                if (
-                    self.shard_mode == "overlap"
-                    and mesh_mod.COLS in self.mesh.axis_names
-                ):
-                    raise ValueError(
-                        "packed overlap mode is 1-D (row-ring) only; use "
-                        "shard_mode 'explicit' on 2-D meshes or engine "
-                        "'dense'"
-                    )
+                # Mode legality (incl. the auto-SPMD rejection) already
+                # ran through modes.check_combo — the overlap form is no
+                # longer 1-D-only: depth-k interior/boundary splits cover
+                # both decompositions (gol_tpu.parallel.halo).
                 packed_mod.validate_packed_geometry(shape, self.mesh)
             else:
                 mesh_mod.validate_geometry(shape, self.mesh)
@@ -534,7 +514,9 @@ class GolRuntime:
             if self.shard_mode == "auto":
                 return "dense"  # auto-SPMD exists for the dense step only
             two_d = mesh_mod.COLS in self.mesh.axis_names
-            overlap = self.shard_mode == "overlap"
+            # Overlap and pipeline share the Pallas engine's split
+            # geometry (interior tile clear of both bands).
+            split = self.shard_mode in ("overlap", "pipeline")
             try:
                 packed_mod.validate_packed_geometry(geom, self.mesh)
             except ValueError:
@@ -565,20 +547,21 @@ class GolRuntime:
                 shard_h = self.geometry.global_height // rows
                 shard_w = self.geometry.global_width // cols
                 depth = 8 if self.halo_depth == 1 else self.halo_depth
-                min_h = 2 * depth + 8 if overlap else depth
+                min_h = 2 * depth + 8 if split else depth
                 words = shard_w // bitlife.BITS
                 fold = pallas_bitlife.fold_factor(words)
                 # Narrow shards run lane-folded: f row groups side by
                 # side in lanes, exact via the kernel's group-local rolls
                 # — so BASELINE config 3's 16x16-mesh 32-word shards
-                # resolve here too, in both explicit AND overlap modes
-                # (r4: the folded interior kernel is ppermute-independent
-                # like the unfolded one; it just needs its aligned tile
-                # clear of both bands at the *folded* height).  Sharded
-                # columns additionally need >= 2 words for edge strips.
+                # resolve here too, in explicit AND overlap/pipeline
+                # modes (r4: the folded interior kernel is
+                # ppermute-independent like the unfolded one; it just
+                # needs its aligned tile clear of both bands at the
+                # *folded* height).  Sharded columns additionally need
+                # >= 2 words for edge strips.
                 fold_ok = fold == 1 or (
                     pallas_bitlife.fold_feasible(
-                        shard_h, fold, overlap, depth
+                        shard_h, fold, split, depth
                     )
                     and (cols <= 1 or words >= 2)
                 )
@@ -589,26 +572,12 @@ class GolRuntime:
                     and (not two_d or depth <= bitlife.BITS)
                 ):
                     return "pallas_bitpack"
-            if overlap and two_d:
-                # The XLA packed overlap program is 1-D only, and on TPU
-                # this geometry missed the flagship gate above — a real
-                # performance cliff, so say so instead of silently
-                # resolving dense (r3 verdict: the silent fallback hid an
-                # order-of-magnitude loss at infeasible pod geometries).
-                # Off-TPU the gate was never evaluated, so the warning
-                # would misdiagnose a backend limitation as a geometry one.
-                if jax.default_backend() == "tpu":
-                    import warnings
-
-                    warnings.warn(
-                        "auto: 2-D overlap at this geometry has no packed "
-                        "program (the fused Pallas gate failed — shard "
-                        "height/width or halo_depth constraints); resolving "
-                        "to the DENSE sharded engine. Use shard_mode "
-                        "'explicit' to keep the bit-packed ring.",
-                        stacklevel=2,
-                    )
-                return "dense"
+            # The XLA packed engine now covers every explicit/overlap/
+            # pipeline geometry at any depth (the depth-k split lifted
+            # the old 1-D-only overlap restriction), so a pod geometry
+            # that misses the fused-Pallas gate above degrades to the
+            # bit-packed ring — no dense cliff, no warning needed (the
+            # r3/r4 silent-dense-fallback story ends here).
             return "bitpack"
         from gol_tpu.ops import bitlife
 
@@ -669,6 +638,7 @@ class GolRuntime:
                     self.tile_hint,
                     self._rule,
                     self.shard_mode == "overlap",
+                    self.shard_mode == "pipeline",
                 ),
                 (),
                 (),
@@ -730,10 +700,29 @@ class GolRuntime:
         try:
             if name == "bitpack":
                 if self.mesh is not None:
-                    if self.shard_mode == "overlap":
+                    if (
+                        self.shard_mode == "overlap"
+                        and self.halo_depth == 1
+                        and mesh_mod.COLS not in self.mesh.axis_names
+                    ):
+                        # Depth-1 1-D overlap keeps its hand-written
+                        # program (byte-identical to every prior round);
+                        # deeper bands and 2-D meshes run the generic
+                        # interior/boundary split below.
                         return (
                             packed_mod.compiled_evolve_packed_overlap(
                                 self.mesh, steps
+                            ),
+                            (),
+                            (),
+                        )
+                    if self.shard_mode in ("overlap", "pipeline"):
+                        return (
+                            packed_mod.compiled_evolve_packed(
+                                self.mesh,
+                                steps,
+                                self.halo_depth,
+                                mode=self.shard_mode,
                             ),
                             (),
                             (),
@@ -1213,6 +1202,62 @@ class GolRuntime:
             "active_fraction": active / tile_gens if tile_gens else 0.0,
         }
 
+    def _halo_block(self, take: int) -> Optional[dict]:
+        """One chunk's ``halo`` telemetry block (schema v8, sharded ring
+        engines only): the exchange depth/mode actually compiled, the
+        per-chunk exchange count, and the band traffic in bytes — so the
+        k-vs-wire tradeoff the pipeline exists for is visible per chunk.
+
+        ``exchange_share`` is the band bytes over the chunk's total
+        shard-state + band payload — a *traffic* share (device-side
+        exchange latency is not host-observable; time attribution is
+        halobench's job, docs/OBSERVABILITY.md).
+        """
+        name = self._resolved
+        if self.mesh is None or name not in (
+            "dense", "bitpack", "pallas_bitpack"
+        ):
+            return None
+        rows = self.mesh.shape.get(mesh_mod.ROWS, 1)
+        cols = self.mesh.shape.get(mesh_mod.COLS, 1)
+        two_d = mesh_mod.COLS in self.mesh.axis_names
+        h = self.geometry.global_height // rows
+        w = self.geometry.global_width // cols
+        k = (
+            8
+            if name == "pallas_bitpack" and self.halo_depth == 1
+            else self.halo_depth
+        )
+        if self.shard_mode == "auto":
+            # XLA-derived exchanges: depth/count are the partitioner's
+            # business; report the per-generation contract only.
+            k = 1
+
+        def band_bytes(d: int) -> int:
+            if name == "dense":
+                per_row = w  # uint8 cells
+                col = 2 * d * (h + 2 * d) if two_d else 0
+            elif name == "bitpack":
+                per_row = (w // 32) * 4  # packed words
+                col = 2 * d * (h + 2 * d) * 4 if two_d else 0
+            else:  # pallas_bitpack: k-row packed band + 1-word column
+                per_row = (w // 32) * 4
+                col = 2 * (h + 2 * d) * 4 if two_d else 0
+            return 2 * d * per_row + col
+
+        full, rem = divmod(take, k)
+        exchanges = full + (1 if rem else 0)
+        chunk_bytes = full * band_bytes(k) + (band_bytes(rem) if rem else 0)
+        state_bytes = h * w if name == "dense" else h * (w // 32) * 4
+        payload = chunk_bytes + take * state_bytes
+        return {
+            "depth": k,
+            "mode": self.shard_mode,
+            "exchanges": exchanges,
+            "band_bytes": chunk_bytes,
+            "exchange_share": chunk_bytes / payload if payload else 0.0,
+        }
+
     def chunk_utilization(self, take: int, wall_s: float):
         """Roofline fraction of one executed chunk (see telemetry module)."""
         from gol_tpu import telemetry as telemetry_mod
@@ -1342,6 +1387,11 @@ class GolRuntime:
                             extra = (
                                 {"activity": act_block} if act_block else {}
                             )
+                            halo_blk = self._halo_block(take)
+                            if halo_blk is not None:
+                                # Schema v8: the exchange accounting of
+                                # this chunk's compiled ring program.
+                                extra["halo"] = halo_blk
                             # The drained spans cover this chunk's
                             # dispatch/ready plus the boundary phases
                             # since the previous chunk's event; writing

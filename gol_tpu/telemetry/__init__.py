@@ -55,7 +55,16 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 7 (this round) adds the elastic-mesh event
+# Version 8 (this round) adds the halo-exchange chunk block
+# (docs/OBSERVABILITY.md): ``chunk`` events of a sharded ring-engine run
+# carry a ``halo`` block — ``{depth, mode, exchanges, band_bytes,
+# exchange_share}`` — the exchange depth/mode the chunk program actually
+# compiled (``--shard-mode pipeline`` double-buffers the k-deep band
+# across chunks), how many ring exchanges the chunk performed, and the
+# band traffic in bytes with its share of the chunk's total payload (a
+# traffic share: device-side exchange *time* is not host-observable —
+# halobench owns time attribution).
+# Version 7 added the elastic-mesh event
 # (docs/RESILIENCE.md): a ``reshard`` record marks a run whose board was
 # repartitioned across topologies — a cross-topology resume or an
 # in-flight ``--reshard-at`` stop — carrying the source/destination mesh
@@ -81,11 +90,11 @@ from typing import Dict, Optional
 # resilience events — ``preempt``, ``resume``, ``restart``
 # (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
-# readable: every v1-v6 event type and field survives unchanged, so
+# readable: every v1-v7 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5/v6 fixture tests).
-SCHEMA_VERSION = 7
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7)
+# committed v1/v2/v3/v4/v5/v6/v7 fixture tests).
+SCHEMA_VERSION = 8
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may

@@ -335,40 +335,62 @@ def _sparse_records(data: dict, source: str, round_: Optional[int]) -> List[dict
 
 
 def _halo_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
-    """HALO_r*.json: named sections of seconds-per-generation columns.
-    Attribution records — kept for the trend tables, never gated (the
-    measurement method itself evolves between rounds)."""
-    out = []
-    for section, body in data.items():
-        if not isinstance(body, dict) or "step_s" not in body:
-            continue
-        backend = "tpu" if section.startswith("tpu") else "cpu"
-        out.append(
-            _record(
-                f"halo:{backend}:{section}",
-                body["step_s"],
-                "s/gen",
-                source,
-                "halobench",
-                backend,
-                kind="attribution",
-                direction="lower",
-                round_=round_,
-                extra={
-                    "exchange_s": body.get("exchange_s"),
-                    "stencil_s": body.get("stencil_s"),
-                    "exposed_exchange_s": body.get("exposed_exchange_s"),
-                },
-            )
+    """HALO_r*.json: named sections of seconds-per-generation columns
+    (attribution captures and PR 9 depth-sweep rows alike), or the bare
+    module emitter's single top-level row.  Attribution records — kept
+    for the trend tables, never gated (the measurement method itself
+    evolves between rounds)."""
+    default_backend = (data.get("header") or {}).get("backend", "cpu")
+
+    def one(section: str, body: dict) -> dict:
+        backend = (
+            "tpu" if section.startswith("tpu")
+            else "cpu" if section.startswith("cpu")
+            else default_backend
         )
-    return out
+        return _record(
+            f"halo:{backend}:{section}",
+            body["step_s"],
+            "s/gen",
+            source,
+            "halobench",
+            backend,
+            kind="attribution",
+            direction="lower",
+            mfu=body.get("mfu"),
+            round_=round_,
+            extra={
+                "exchange_s": body.get("exchange_s"),
+                "stencil_s": body.get("stencil_s"),
+                "exposed_exchange_s": body.get("exposed_exchange_s"),
+                "halo_depth": body.get("halo_depth"),
+                "shard_mode": body.get("shard_mode"),
+            },
+        )
+
+    if "step_s" in data:  # the bare module emitter: one flat row
+        mesh_s = "x".join(str(v) for v in (data.get("mesh") or {}).values())
+        return [one(f"{data.get('engine', '?')}:mesh{mesh_s or '?'}", data)]
+    return [
+        one(section, body)
+        for section, body in data.items()
+        if isinstance(body, dict) and "step_s" in body
+    ]
 
 
 def _scale_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
     out = []
-    for section, body in data.items():
-        if not isinstance(body, dict) or "rows" not in body:
-            continue
+    sections = {
+        section: body
+        for section, body in data.items()
+        if isinstance(body, dict) and "rows" in body
+    }
+    if not sections and isinstance(data.get("rows"), list):
+        # The bare module emitter: one flat curve, self-describing.
+        sections = {
+            f"{data.get('engine', '?')}_{data.get('mesh_kind', '?')}": data
+        }
+    for section, body in sections.items():
         backend = body.get(
             "platform", "tpu" if section.startswith("tpu") else "cpu"
         )

@@ -449,11 +449,13 @@ def render_run(run: Run, out) -> None:
         batched = any(c.get("batch") for c in chunks)
         spans_any = any(c.get("spans") for c in chunks)
         gated = any(c.get("activity") for c in chunks)
+        ringed = any(c.get("halo") for c in chunks)
         print(
             "  chunk     gens       gen      wall_s     updates/s  "
             "roofline"
             + ("  batch (bucket B eng per-world/s)" if batched else "")
-            + ("  activity (active% skipped fallbacks)" if gated else ""),
+            + ("  activity (active% skipped fallbacks)" if gated else "")
+            + ("  halo (mode k exch band)" if ringed else ""),
             file=out,
         )
         for c in chunks:
@@ -474,6 +476,17 @@ def render_run(run: Run, out) -> None:
                 )
                 if a.get("fallback_gens"):
                     line += f" fb={a['fallback_gens']}"
+            hb = c.get("halo")
+            if hb:
+                # Schema v8 (docs/OBSERVABILITY.md): the ring program's
+                # exchange accounting — band depth/mode, exchanges this
+                # chunk, and the band traffic's share of the payload.
+                line += (
+                    f"  {hb.get('mode', '?')} k={hb.get('depth', '?')}"
+                    f" x{hb.get('exchanges', '?')}"
+                    f" {hb.get('band_bytes', 0)}B"
+                    f" ({100 * hb.get('exchange_share', 0.0):.1f}%)"
+                )
             b = c.get("batch")
             if b:
                 # Schema v4 (docs/BATCHING.md): one chunk record per

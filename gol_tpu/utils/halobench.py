@@ -227,6 +227,109 @@ def measure(
     return out
 
 
+SWEEP_MODES = ("explicit", "overlap", "pipeline")
+
+
+def measure_depth_sweep(
+    mesh: Mesh,
+    size,
+    steps: int,
+    engine: str,
+    depths,
+    modes=SWEEP_MODES,
+) -> Dict[str, dict]:
+    """The k-vs-MFU curve the pipelined halo engine exists for (PR 9).
+
+    For every (shard mode, halo depth k) cell, times the FULL sharded
+    chunk program — explicit serial chunks, the depth-k interior/boundary
+    overlap split, or the cross-chunk pipelined double buffer — and
+    reports per-generation seconds, cell-updates/s, and the VPU-roofline
+    fraction (``telemetry.roofline_utilization`` over the same
+    ``xla_flops_model`` the chunk telemetry uses, so the sweep's MFU
+    column and a run's v8 ``halo`` block share one model).  Every row is
+    written only after a bit-equality receipt against the explicit
+    depth-1 program on the same board (the sparsebench discipline: a
+    fast wrong program must not enter an artifact).  Cells the engine
+    rejects (k beyond the shard extent, non-8-multiple Pallas depths)
+    become ``{"skipped": reason}`` rows — visible, never silently
+    dropped.
+
+    ``engine``: ``dense`` | ``bitpack`` | ``pallas`` (the fused sharded
+    Pallas engine; its depth quantum is 8, so k=1 measures the default
+    8-deep band and non-multiples of 8 skip).
+    """
+    from gol_tpu import telemetry as telemetry_mod
+    from gol_tpu.parallel import packed as packed_mod
+
+    if engine not in ("dense", "bitpack", "pallas"):
+        raise ValueError(
+            f"sweep engine {engine!r}: expected dense/bitpack/pallas"
+        )
+    h, w = (size, size) if isinstance(size, int) else size
+    rng = np.random.default_rng(0)
+    board_np = (rng.random((h, w)) < 0.35).astype(np.uint8)
+    place = lambda: jax.device_put(
+        jnp.asarray(board_np), board_sharding(mesh)
+    )
+    devices = mesh.devices.size
+    shard_cells = (h * w) // devices
+    model_engine = {"pallas": "pallas_bitpack"}.get(engine, engine)
+
+    def build(mode: str, k: int):
+        if engine == "pallas":
+            depth = 8 if k == 1 else k
+            if depth % 8:
+                raise ValueError(
+                    "the sharded Pallas engine needs halo_depth to be a "
+                    f"multiple of 8, got {k}"
+                )
+            packed_mod.validate_packed_geometry((h, w), mesh)
+            return depth, packed_mod.compiled_evolve_packed_pallas(
+                mesh,
+                steps,
+                halo_depth=depth,
+                overlap=mode == "overlap",
+                pipeline=mode == "pipeline",
+            )
+        if engine == "bitpack":
+            packed_mod.validate_packed_geometry((h, w), mesh)
+            return k, packed_mod.compiled_evolve_packed(
+                mesh, steps, k, mode=mode
+            )
+        return k, sharded.compiled_evolve(mesh, steps, mode, k)
+
+    _, ref_fn = build("explicit", 1)
+    ref = np.asarray(ref_fn(place()))
+    out: Dict[str, dict] = {}
+    for mode in modes:
+        for k in depths:
+            name = f"{engine}_{mode}_k{k}"
+            try:
+                depth, fn = build(mode, k)
+                got = np.asarray(fn(place()))
+                if not np.array_equal(got, ref):
+                    raise AssertionError(
+                        "bit-equality receipt FAILED vs explicit depth-1"
+                    )
+            except (ValueError, AssertionError) as e:
+                out[name] = {"skipped": str(e).splitlines()[0]}
+                continue
+            t_gen = _time(lambda b: fn(jnp.array(b, copy=True)), place()) / steps
+            mfu = telemetry_mod.roofline_utilization(
+                model_engine, shard_cells, steps, depth, True,
+                t_gen * steps,
+            )
+            out[name] = {
+                "step_s": t_gen,
+                "updates_per_sec": (h * w) / t_gen,
+                "mfu": mfu,
+                "halo_depth": depth,
+                "shard_mode": mode,
+                "bit_equal_explicit_k1": True,
+            }
+    return out
+
+
 @functools.lru_cache(maxsize=32)
 def _exchange_only_3d(mesh: Mesh, steps: int):
     """jit: ``steps`` chained exchanges of the 3-D flagship's own wire
@@ -367,18 +470,22 @@ def main(argv=None) -> None:
     import sys
 
     args = list(sys.argv[1:] if argv is None else argv)
-    # Optional structured-telemetry sink (docs/OBSERVABILITY.md), peeled
-    # off before the positional surface so the published CLI is unchanged.
-    telemetry_dir = run_id = None
-    for flag in ("--telemetry", "--run-id"):
+    # Optional flags, peeled off before the positional surface so the
+    # published CLI is unchanged: the structured-telemetry sink
+    # (docs/OBSERVABILITY.md) and the depth sweep (PR 9): a comma list of
+    # halo depths swept per shard mode, emitting the k-vs-MFU curve.
+    telemetry_dir = run_id = sweep_depths = None
+    for flag in ("--telemetry", "--run-id", "--halo-depth-sweep"):
         if flag in args:
             k = args.index(flag)
             value = args[k + 1]
             del args[k : k + 2]
             if flag == "--telemetry":
                 telemetry_dir = value
-            else:
+            elif flag == "--run-id":
                 run_id = value
+            else:
+                sweep_depths = [int(v) for v in value.split(",")]
     if len(args) > 0 and "x" in args[0]:
         parts = tuple(int(v) for v in args[0].split("x"))
         size = parts if len(parts) > 1 else parts[0]
@@ -405,12 +512,92 @@ def main(argv=None) -> None:
         out = measure3d(mesh, size, steps)
         engine = "pallas3d"
     else:
-        mesh = (
-            mesh_mod.make_mesh_2d()
-            if kind == "2d"
-            else mesh_mod.make_mesh_1d()
-        )
+        # "1d:N" / "2d:R,C" pin the device count (a sweep wants shard
+        # extents that admit its deepest band); bare kinds keep the
+        # all-devices default.
+        if ":" in kind:
+            base, spec_s = kind.split(":", 1)
+            if base == "1d":
+                n = int(spec_s)
+                mesh = mesh_mod.make_mesh_1d(n, devices=jax.devices()[:n])
+            else:
+                r, c = (int(v) for v in spec_s.split(","))
+                mesh = mesh_mod.make_mesh_2d(
+                    (r, c), devices=jax.devices()[: r * c]
+                )
+        else:
+            mesh = (
+                mesh_mod.make_mesh_2d()
+                if kind == "2d"
+                else mesh_mod.make_mesh_1d()
+            )
+        if sweep_depths is not None:
+            from gol_tpu.telemetry import ledger as ledger_mod
+
+            out = {
+                "header": ledger_mod.artifact_header("halobench"),
+                "note": (
+                    "k-vs-MFU sweep of the ring chunk forms (PR 9): "
+                    "step_s/updates_per_sec/mfu per (shard_mode, "
+                    "halo_depth), every row bit-equality-receipted "
+                    "against the explicit depth-1 program on the same "
+                    "board before timing; rejected cells appear as "
+                    "skipped rows. mfu shares xla_flops_model with the "
+                    "v8 chunk telemetry."
+                    + (
+                        " THIS CAPTURE IS CPU (virtual-device ring): "
+                        "curve SHAPE only — CPU cores timeshare, so "
+                        "exchange latency is not the bottleneck the "
+                        "pipeline hides and absolute MFU is "
+                        "meaningless. TPU headline command: python -m "
+                        "gol_tpu.utils.halobench 16384 8192 1d "
+                        "dense,bitpack,pallas --halo-depth-sweep "
+                        "1,2,4,8,16 (and 2d:4,2 for the pod "
+                        "decomposition)."
+                        if jax.default_backend() != "tpu"
+                        else ""
+                    )
+                ),
+            }
+            # The engine positional accepts a comma list here so one
+            # invocation (one reproducible argv) captures the whole
+            # artifact.
+            kind_key = kind.replace(":", "x").replace(",", "x")
+            for eng in engine.split(","):
+                out.update(
+                    {
+                        f"{jax.default_backend()}_mesh{kind_key}_{key}": body
+                        for key, body in measure_depth_sweep(
+                            mesh, size, steps, eng, sweep_depths
+                        ).items()
+                    }
+                )
+            out.update(
+                {
+                    "size": list(size) if isinstance(size, tuple) else size,
+                    "steps": steps,
+                    "mesh": dict(mesh.shape),
+                    "devices": len(mesh.devices.ravel()),
+                    "depths": sweep_depths,
+                }
+            )
+            print(json.dumps(out, indent=1))
+            if telemetry_dir:
+                from gol_tpu import telemetry as telemetry_mod
+
+                with telemetry_mod.EventLog(
+                    telemetry_dir, run_id=run_id
+                ) as ev:
+                    ev.run_header(
+                        dict(tool="halobench", sweep=True, kind=kind)
+                    )
+                    for key, body in out.items():
+                        if isinstance(body, dict) and "step_s" in body:
+                            ev.bench_row("halobench", {**body, "name": key})
+            return
         out = measure(mesh, size, steps, engine)
+    from gol_tpu.telemetry import ledger as ledger_mod
+
     out.update(
         {
             "size": list(size) if isinstance(size, tuple) else size,
@@ -418,6 +605,10 @@ def main(argv=None) -> None:
             "mesh": dict(mesh.shape),
             "devices": len(mesh.devices.ravel()),
             "engine": engine,
+            # Satellite (PR 9): the module emitter stamps the common
+            # header too, so a bare `python -m gol_tpu.utils.halobench`
+            # capture ingests with zero sniffing like capture_artifacts'.
+            "header": ledger_mod.artifact_header("halobench"),
         }
     )
     print(json.dumps(out))

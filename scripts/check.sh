@@ -27,26 +27,30 @@
 #                       resumed on a 1-D ring must be bit-equal to a
 #                       straight run, with a non-identity plan and the
 #                       schema-v7 reshard event stamped)
-#  10. tier-1 tests    (the exact ROADMAP.md command)
+#  10. halo smoke      (pipelined depth-k halo exchange: 512² glider,
+#                       pipeline k=4 on a 1-D mesh bit-equal to
+#                       explicit k=1, with v8 halo blocks on every
+#                       chunk event)
+#  11. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/10] lint =="
+echo "== [1/11] lint =="
 bash scripts/lint.sh
 
-echo "== [2/10] static verifier (gol_tpu.analysis) =="
+echo "== [2/11] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/10] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/11] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/10] stats smoke (in-graph simulation statistics) =="
+echo "== [4/11] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -55,22 +59,25 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/10] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/11] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/10] batch smoke (docs/BATCHING.md) =="
+echo "== [6/11] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/10] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/11] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/10] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/11] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/10] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+echo "== [9/11] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
 JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
-echo "== [10/10] tier-1 tests =="
+echo "== [10/11] halo smoke (pipelined depth-k exchange, PR 9) =="
+JAX_PLATFORMS=cpu python scripts/halo_smoke.py
+
+echo "== [11/11] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

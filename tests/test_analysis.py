@@ -402,3 +402,68 @@ def test_cli_verify_list():
 
     rc = cli.main(["verify", "--list"])
     assert rc == 0
+
+
+# -- halo-pipeline matrix (PR 9) ---------------------------------------------
+
+
+def test_one_exchange_flags_degenerate_double_buffer():
+    """A 'pipelined' loop that exchanges twice per chunk has degenerated
+    to the serial form — the check must fail it."""
+    from gol_tpu.analysis import halocheck
+    from gol_tpu.parallel import halo
+
+    k = 2
+    phases = ((0, "rows", MESH_N),)
+    step = lambda ext: stencil.step_halo_rows(ext[1:-1], ext[0], ext[-1])
+
+    def local(blk):
+        def chunk(b):
+            halo.exchange_bands(b, phases, k)  # the wasted extra exchange
+            bands = halo.exchange_bands(b, phases, k)
+            return halo._consume_chunk(step, phases, b, bands, k)
+
+        return lax.fori_loop(0, 3, lambda _, b: chunk(b), blk)
+
+    jaxpr = walker.trace_jaxpr(_ring_program(local), _sharded_spec(_mesh()))
+    hcfg = halocheck.HaloConfig("fixture", "dense", "1d", halo_depth=k)
+    result = halocheck.check_one_exchange_per_chunk(jaxpr, hcfg, _mesh())
+    assert result.status == "FAIL"
+    assert any("4 in-loop ppermutes" in f.message for f in result.errors)
+
+
+def test_one_exchange_passes_real_pipeline():
+    from gol_tpu.analysis import halocheck
+    from gol_tpu.parallel import halo
+
+    phases = ((0, "rows", MESH_N),)
+    step = lambda ext: stencil.step_halo_rows(ext[1:-1], ext[0], ext[-1])
+    local = halo.pipelined_local_loop(step, phases, 12, 4)
+    jaxpr = walker.trace_jaxpr(_ring_program(local), _sharded_spec(_mesh()))
+    hcfg = halocheck.HaloConfig("fixture", "dense", "1d", halo_depth=4)
+    assert halocheck.check_one_exchange_per_chunk(
+        jaxpr, hcfg, _mesh()
+    ).status == "PASS"
+
+
+def test_halo_matrix_verifies_clean():
+    """The full pipeline matrix: ring soundness at depth k, one exchange
+    per chunk, executed equivalence, and the shallow-band teeth."""
+    from gol_tpu.analysis import halocheck
+
+    reports = halocheck.run_halo_checks()
+    failing = [r.config_name for r in reports if not r.ok]
+    assert not failing, f"halo matrix flagged: {failing}"
+    names = {r.config_name for r in reports}
+    # The matrix genuinely spans the tiers, both 2-D meshes, and 3-D.
+    assert any("pallas_bitpack" in n for n in names)
+    assert any("/2d/" in n for n in names)
+    assert any("3d" in n for n in names)
+    # The teeth ran: the dense/1d cell carries the shallow-band witness.
+    teeth = [
+        c
+        for r in reports
+        for c in r.checks
+        if c.check == "shallow-band"
+    ]
+    assert teeth and all(c.status == "PASS" for c in teeth)

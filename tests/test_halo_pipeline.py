@@ -1,0 +1,260 @@
+"""PR 9: pipelined depth-k halo exchange — bit-identity + trace pins.
+
+Every new chunk form (depth-k overlap split, cross-chunk pipelined
+double buffer) must be bit-identical to the explicit depth-1 path across
+tiers × meshes, including remainder chunks, 2-D corner crossings, the
+lane-folded narrow-shard Pallas form, and the 3-D packed ring — and the
+explicit paths themselves must be untouched (jaxpr byte-identity when
+the knob is off; the depth-1 1-D packed overlap keeps its hand-written
+program).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import packed, sharded
+
+from tests import oracle
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _mesh(kind):
+    if kind == "1d":
+        return mesh_mod.make_mesh_1d(4, devices=jax.devices()[:4])
+    return mesh_mod.make_mesh_2d((2, 2), devices=jax.devices()[:4])
+
+
+def _place(board, mesh):
+    return mesh_mod.place_private(
+        jnp.asarray(board), mesh_mod.board_sharding(mesh)
+    )
+
+
+# -- dense tier --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_kind", ["1d", "2d"])
+@pytest.mark.parametrize("mode", ["overlap", "pipeline"])
+@pytest.mark.parametrize(
+    "k,steps", [(2, 8), (4, 12), (4, 11), (3, 2)]
+)  # incl. remainder chunks and steps < k
+def test_dense_deep_modes_match_explicit_depth1(mesh_kind, mode, k, steps):
+    board = oracle.random_board(32, 32, seed=k * 100 + steps)
+    mesh = _mesh(mesh_kind)
+    ref = np.asarray(
+        sharded.compiled_evolve(mesh, steps, "explicit", 1)(
+            _place(board, mesh)
+        )
+    )
+    np.testing.assert_array_equal(ref, oracle.run_torus(board, steps))
+    got = np.asarray(
+        sharded.compiled_evolve(mesh, steps, mode, k)(_place(board, mesh))
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_dense_pipeline_glider_corner_crossing():
+    """A glider through the 2×2 corner: the pipelined band's corner
+    two-hop (phase-i operands extended with earlier phases' NEW bands)
+    must deliver the diagonal neighbors one chunk ahead."""
+    board = np.zeros((16, 16), np.uint8)
+    board[6:9, 6:9] = np.array(
+        [[0, 1, 0], [0, 0, 1], [1, 1, 1]], np.uint8
+    )
+    mesh = _mesh("2d")
+    got = np.asarray(
+        sharded.compiled_evolve(mesh, 12, "pipeline", 2)(_place(board, mesh))
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 12))
+    assert got.sum() == 5
+
+
+# -- bitpack tier ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh_kind", ["1d", "2d"])
+@pytest.mark.parametrize("mode", ["overlap", "pipeline"])
+@pytest.mark.parametrize("k,steps", [(2, 8), (4, 11)])
+def test_packed_deep_modes_match_oracle(mesh_kind, mode, k, steps):
+    # 4 words per shard column on the 2-D mesh (256 // 2 // 32) — the
+    # word axis ships k word-columns, so k=4 needs them all.
+    board = oracle.random_board(128, 256, seed=k + steps)
+    mesh = _mesh(mesh_kind)
+    got = np.asarray(
+        packed.compiled_evolve_packed(mesh, steps, k, mode=mode)(
+            _place(board, mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+def test_packed_depth1_overlap_keeps_handwritten_program():
+    """Depth-1 1-D overlap must still route to the hand-written packed
+    overlap program — byte-identical to every prior round."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="overlap",
+    )
+    fn, _, _ = rt._evolve_fn(4)
+    assert fn is packed.compiled_evolve_packed_overlap(rt.mesh, 4)
+
+
+def test_explicit_jaxpr_identical_with_mode_knob_off():
+    """Trace stability: the explicit program is byte-identical whether
+    built through the default or the explicit `mode` argument, and
+    building the deep forms does not perturb it."""
+    from gol_tpu.analysis import walker
+
+    mesh = _mesh("1d")
+    spec = jax.ShapeDtypeStruct(
+        (64, 64), jnp.uint8, sharding=mesh_mod.board_sharding(mesh)
+    )
+
+    def explicit_jaxprs():
+        return (
+            str(walker.trace_jaxpr(
+                packed.compiled_evolve_packed(mesh, 6, 2), spec
+            )),
+            str(walker.trace_jaxpr(
+                sharded.compiled_evolve(mesh, 6, "explicit", 2), spec
+            )),
+        )
+
+    before = explicit_jaxprs()
+    assert before == (
+        str(walker.trace_jaxpr(
+            packed.compiled_evolve_packed(mesh, 6, 2, mode="explicit"), spec
+        )),
+        str(walker.trace_jaxpr(
+            sharded.compiled_evolve(mesh, 6, "explicit", 2), spec
+        )),
+    )
+    # Building + running the deep forms must leave them untouched.
+    board = oracle.random_board(64, 64, seed=9)
+    packed.compiled_evolve_packed(mesh, 6, 2, mode="pipeline")(
+        _place(board, mesh)
+    )
+    sharded.compiled_evolve(mesh, 6, "overlap", 2)(_place(board, mesh))
+    assert explicit_jaxprs() == before
+
+
+# -- sharded Pallas tier (interpret mode on CPU) -----------------------------
+
+
+@pytest.mark.parametrize("steps", [16, 19])  # incl. the consume-only tail
+def test_pallas_pipeline_1d_matches_oracle(steps):
+    board = oracle.random_board(128, 128, seed=steps)
+    mesh = _mesh("1d")  # shard 32 rows >= 2*8 + 8
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, steps, pipeline=True)(
+            _place(board, mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, steps))
+
+
+def test_pallas_pipeline_2d_matches_oracle():
+    board = oracle.random_board(128, 128, seed=77)
+    mesh = _mesh("2d")  # shard 64x64: 2 words wide, edge-strip repair
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, 16, pipeline=True)(
+            _place(board, mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+
+
+def test_pallas_pipeline_folded_matches_oracle():
+    """Narrow shards run the pipelined loop lane-folded: the carried ring
+    ghosts ride unfolded [k, nw] while the group seams' band parts are
+    lane-shifted slices of the folded block itself."""
+    board = oracle.random_board(1024, 1024, seed=5, density=0.3)
+    mesh = mesh_mod.make_mesh_1d(8)  # shard 128x1024: nw=32, fold=4
+    got = np.asarray(
+        packed.compiled_evolve_packed_pallas(mesh, 16, pipeline=True)(
+            _place(board, mesh)
+        )
+    )
+    np.testing.assert_array_equal(got, oracle.run_torus(board, 16))
+
+
+def test_pallas_overlap_and_pipeline_are_exclusive():
+    with pytest.raises(ValueError, match="pick one"):
+        packed.compiled_evolve_packed_pallas(
+            _mesh("1d"), 8, overlap=True, pipeline=True
+        )
+
+
+# -- 3-D packed ring ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["overlap", "pipeline"])
+@pytest.mark.parametrize("steps", [6, 7])  # 7: remainder chunk at k=2
+def test_3d_packed_deep_modes_match_explicit(mode, steps):
+    from gol_tpu.ops import life3d
+    from gol_tpu.parallel import sharded3d
+
+    vol = np.random.default_rng(steps).integers(0, 2, (64, 64, 64), np.uint8)
+    mesh = mesh_mod.make_mesh_3d((2, 2, 1), devices=jax.devices()[:4])
+    ref = np.asarray(
+        sharded3d.evolve_sharded3d_packed(jnp.asarray(vol), steps, mesh)
+    )
+    np.testing.assert_array_equal(
+        ref, np.asarray(life3d.run3d(jnp.asarray(vol), steps))
+    )
+    got = np.asarray(
+        sharded3d.evolve_sharded3d_packed(
+            jnp.asarray(vol), steps, mesh, halo_depth=2, mode=mode
+        )
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+# -- runtime end to end ------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "bitpack"])
+def test_runtime_pipeline_end_to_end(engine):
+    from gol_tpu.models import patterns
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine=engine,
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="pipeline",
+        halo_depth=4,
+    )
+    _, state = rt.run(pattern=5, iterations=10)
+    board0 = patterns.init_global(5, 64, 1)
+    np.testing.assert_array_equal(
+        np.asarray(state.board), oracle.run_torus(board0, 10)
+    )
+
+
+def test_runtime_pipeline_depth_exceeding_shard_raises():
+    """Seam case: k greater than the shard extent must be rejected — the
+    ghost shell would need cells from beyond the ring neighbor."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    with pytest.raises(ValueError, match="exceeds the shard extent"):
+        GolRuntime(
+            geometry=Geometry(size=64, num_ranks=1),
+            engine="dense",
+            mesh=mesh_mod.make_mesh_1d(8),  # 8-row shards
+            shard_mode="pipeline",
+            halo_depth=9,
+        )

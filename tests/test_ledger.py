@@ -66,6 +66,52 @@ def test_halo_adapter_is_attribution_never_gated():
     assert ledger_mod.check_records(recs, backends=("all",)) == []
 
 
+def test_halo_sweep_artifact_ingests_with_mfu():
+    """HALO_r07.json: the PR 9 k-vs-MFU sweep — header-routed, one
+    record per (mode, k) cell with depth/mode in extra and the MFU
+    column carried; skipped cells (non-8-multiple Pallas depths) never
+    become records."""
+    recs = ledger_mod.normalize_artifact(str(REPO / "HALO_r07.json"))
+    assert recs and all(r["kind"] == "attribution" for r in recs)
+    modes = {r["extra"]["shard_mode"] for r in recs}
+    assert modes == {"explicit", "overlap", "pipeline"}
+    depths = {r["extra"]["halo_depth"] for r in recs}
+    assert {1, 2, 4, 8, 16} <= depths
+    assert any(r.get("mfu") is not None for r in recs)
+    assert all("skipped" not in r["fingerprint"] for r in recs)
+    # Idempotent on the committed ledger: everything already present.
+    assert ledger_mod.check_records(recs, backends=("all",)) == []
+
+
+def test_bare_module_emitter_outputs_ingest(tmp_path):
+    """The satellite: a bare `python -m gol_tpu.utils.halobench` /
+    scalebench capture (flat JSON + header stamp) ingests with zero
+    sniffing."""
+    halo = {
+        "header": {"schema": ledger_mod.ARTIFACT_SCHEMA,
+                   "tool": "halobench", "backend": "cpu", "argv": []},
+        "exchange_s": 1e-5, "step_s": 3e-5, "stencil_s": 2e-5,
+        "exposed_exchange_s": 1e-5, "size": 256, "steps": 8,
+        "mesh": {"rows": 4}, "devices": 4, "engine": "bitpack",
+    }
+    p = tmp_path / "halo.json"
+    p.write_text(json.dumps(halo))
+    recs = ledger_mod.normalize_artifact(str(p))
+    assert len(recs) == 1 and recs[0]["value"] == 3e-5
+    assert "bitpack" in recs[0]["fingerprint"]
+    scale = {
+        "header": {"schema": ledger_mod.ARTIFACT_SCHEMA,
+                   "tool": "scalebench", "backend": "cpu", "argv": []},
+        "size_per_chip": 256, "steps": 8, "engine": "dense",
+        "mesh_kind": "1d", "platform": "cpu", "processes": 1,
+        "rows": [{"devices": 2, "per_chip": 1e8, "efficiency": 0.9}],
+    }
+    p2 = tmp_path / "scale.json"
+    p2.write_text(json.dumps(scale))
+    recs2 = ledger_mod.normalize_artifact(str(p2))
+    assert len(recs2) == 1 and recs2[0]["value"] == 1e8
+
+
 def test_scale_and_multichip_adapters():
     scale = ledger_mod.normalize_artifact(str(REPO / "SCALE_r05.json"))
     assert any(r["fingerprint"].startswith("scale:tpu:") for r in scale)

@@ -1,0 +1,139 @@
+"""The (engine, shard-mode, halo-depth) legality matrix — message pins.
+
+``gol_tpu/parallel/modes.py`` is the single source of truth the runtime
+validates every sharded configuration through; these tests pin each
+cell's verdict AND its error text, so the stale-message drift that PR 9
+cleaned up (the ``halo_depth > 1 requires shard_mode 'explicit'`` chain
+that survived two releases after overlap learned deep bands) cannot
+quietly come back.
+"""
+
+from __future__ import annotations
+
+import jax
+import pytest
+
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import modes
+
+jax.config.update("jax_platforms", "cpu")
+
+
+# -- the positive matrix -----------------------------------------------------
+
+
+@pytest.mark.parametrize("engine,mode", [
+    (e, m) for e, ms in modes.ENGINE_MODES.items() for m in ms
+])
+def test_supported_cells_have_no_rejection(engine, mode):
+    assert modes.mode_rejection(engine, mode) is None
+
+
+@pytest.mark.parametrize("engine,mode,k", [
+    ("dense", "explicit", 4),
+    ("dense", "overlap", 4),
+    ("dense", "pipeline", 4),
+    ("bitpack", "explicit", 2),
+    ("bitpack", "overlap", 2),
+    ("bitpack", "pipeline", 2),
+    ("pallas_bitpack", "explicit", 8),
+    ("pallas_bitpack", "overlap", 16),
+    ("pallas_bitpack", "pipeline", 8),
+    ("activity", "explicit", 1),
+])
+def test_legal_combos_pass_check(engine, mode, k):
+    modes.check_combo(engine, mode, k)  # must not raise
+
+
+# -- per-combo rejection messages --------------------------------------------
+
+
+@pytest.mark.parametrize("engine,mode,match", [
+    ("bitpack", "auto", "no auto-SPMD program"),
+    ("pallas_bitpack", "auto", "explicit, overlap and pipeline ring "
+                               "programs only"),
+    ("activity", "overlap", "explicit ring program only"),
+    ("activity", "pipeline", "explicit ring program only"),
+    ("activity", "auto", "explicit ring program only"),
+])
+def test_unsupported_cells_pin_their_message(engine, mode, match):
+    assert match in modes.mode_rejection(engine, mode)
+    with pytest.raises(ValueError, match=match):
+        modes.check_combo(engine, mode, 1)
+
+
+def test_unknown_mode_rejected():
+    with pytest.raises(ValueError, match="unknown shard_mode"):
+        modes.check_combo("dense", "psychic", 1)
+
+
+def test_unknown_engine_passes_through():
+    # Engines outside the matrix (e.g. 'pallas' single-device) are not
+    # this module's business; the runtime rejects them elsewhere.
+    assert modes.mode_rejection("pallas", "explicit") is None
+
+
+@pytest.mark.parametrize("engine,mode,k,match", [
+    ("dense", "explicit", 0, "must be >= 1"),
+    ("dense", "auto", 2, "no band to deepen"),
+    ("pallas_bitpack", "pipeline", 12, "multiple of 8"),
+    ("pallas_bitpack", "explicit", 7, "multiple of 8"),
+    ("activity", "explicit", 2, "must be 1"),
+])
+def test_depth_rules_pin_their_message(engine, mode, k, match):
+    with pytest.raises(ValueError, match=match):
+        modes.check_combo(engine, mode, k)
+
+
+@pytest.mark.parametrize("two_d,shard_h,shard_w,k,ok", [
+    (False, 8, 1, 8, True),   # 1-D: width extent not a band axis
+    (False, 8, 1, 9, False),
+    (True, 8, 2, 2, True),
+    (True, 8, 2, 3, False),   # 2-D: min extent governs
+])
+def test_depth_vs_shard_extent(two_d, shard_h, shard_w, k, ok):
+    if ok:
+        modes.check_depth(k, shard_h, shard_w, two_d)
+    else:
+        with pytest.raises(ValueError, match="exceeds the shard extent"):
+            modes.check_depth(k, shard_h, shard_w, two_d)
+
+
+# -- the runtime validates THROUGH the matrix --------------------------------
+
+
+def _rt(**kw):
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    kw.setdefault("geometry", Geometry(size=64, num_ranks=1))
+    return GolRuntime(**kw)
+
+
+@pytest.mark.parametrize("engine,mode,k,match", [
+    ("bitpack", "auto", 1, "no auto-SPMD program"),
+    ("dense", "auto", 2, "no band to deepen"),
+    ("pallas_bitpack", "pipeline", 12, "multiple of 8"),
+    ("activity", "explicit", 2, "must be 1"),
+])
+def test_runtime_surfaces_canonical_messages(engine, mode, k, match):
+    with pytest.raises(ValueError, match=match):
+        _rt(
+            engine=engine,
+            mesh=mesh_mod.make_mesh_1d(4),
+            shard_mode=mode,
+            halo_depth=k,
+        )
+
+
+def test_runtime_rejects_pipeline_without_mesh():
+    with pytest.raises(ValueError, match="pass a mesh"):
+        _rt(shard_mode="pipeline")
+
+
+def test_runtime_accepts_every_dense_cell():
+    for mode in modes.ENGINE_MODES["dense"]:
+        rt = _rt(
+            engine="dense", mesh=mesh_mod.make_mesh_1d(4), shard_mode=mode
+        )
+        assert rt.shard_mode == mode

@@ -649,3 +649,82 @@ def test_reshard_resume_equals_straight_run(
     )
     _, st_out = rt.run(pattern=0, iterations=n, resume=path)
     np.testing.assert_array_equal(np.asarray(st_out.board), ref)
+
+
+# -- pipelined depth-k halo families (PR 9, docs/DESIGN.md) ------------------
+
+_halo_meshes = {}
+
+
+def _halo_mesh(kind):
+    """none = a degenerate 1-device ring (self-ppermute seam), 1d = 4-ring,
+    2d = 2×2 block grid.  Cached so the engine builders' lru_cache hits."""
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    if kind not in _halo_meshes:
+        if kind == "2d":
+            _halo_meshes[kind] = mesh_mod.make_mesh_2d(
+                (2, 2), devices=jax.devices()[:4]
+            )
+        else:
+            n = 1 if kind == "none" else 4
+            _halo_meshes[kind] = mesh_mod.make_mesh_1d(
+                n, devices=jax.devices()[:n]
+            )
+    return _halo_meshes[kind]
+
+
+@st.composite
+def _halo_cfgs(draw):
+    tier = draw(st.sampled_from(["dense", "bitpack"]))
+    mesh_kind = draw(st.sampled_from(["none", "1d", "2d"]))
+    h = draw(st.sampled_from([8, 16, 24, 48]))
+    words = draw(st.sampled_from([2, 4]))
+    k = draw(st.integers(1, 6))
+    n = draw(st.integers(1, 10))
+    mode = draw(st.sampled_from(["overlap", "pipeline"]))
+    seed = draw(seeds)
+    return tier, mesh_kind, h, words, k, n, mode, seed
+
+
+@given(cfg=_halo_cfgs())
+@settings(max_examples=20, deadline=None)
+def test_pipelined_depth_k_matches_explicit_and_oracle(cfg):
+    """Pipelined/overlap depth-k == explicit depth-1 == the sequential
+    oracle over random (size, k, mesh none/1d/2d, tier) — remainder
+    chunks, steps < k, and tiny shards (no interior to split) included;
+    a k deeper than the shard extent must raise, not corrupt (the seam
+    case where the ghost shell would cross two ring hops)."""
+    from gol_tpu.parallel import packed as packed_mod
+    from gol_tpu.parallel import sharded as sharded_mod
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    tier, mesh_kind, h, words, k, n, mode, seed = cfg
+    w = 32 * words
+    mesh = _halo_mesh(mesh_kind)
+    rows = mesh.shape["rows"]
+    cols = mesh.shape.get("cols", 1)
+    two_d = "cols" in mesh.axis_names
+    board = _board(h, w, seed)
+    place = lambda: mesh_mod.place_private(
+        jnp.asarray(board), mesh_mod.board_sharding(mesh)
+    )
+
+    if tier == "dense":
+        build = lambda m, kk: sharded_mod.compiled_evolve(mesh, n, m, kk)
+        limits = [h // rows] + ([w // cols] if two_d else [])
+    else:
+        build = lambda m, kk: packed_mod.compiled_evolve_packed(
+            mesh, n, kk, mode=m
+        )
+        limits = [h // rows] + ([words // cols] if two_d else [])
+
+    if k > min(limits):
+        with pytest.raises(ValueError, match="exceeds shard extent"):
+            build(mode, k)(place())
+        return
+
+    ref = np.asarray(build("explicit", 1)(place()))
+    np.testing.assert_array_equal(ref, oracle.run_torus(board, n))
+    got = np.asarray(build(mode, k)(place()))
+    np.testing.assert_array_equal(got, ref)

@@ -147,13 +147,20 @@ def test_runtime_packed_overlap_end_to_end():
         geometry=geom, mesh=mesh_mod.make_mesh_1d(4), shard_mode="overlap"
     )
     assert rt2._resolved == "bitpack"
-    # ...but 2-D overlap stays dense (packed overlap is 1-D only).
+    # ...and 2-D overlap now keeps the bit-packed ring too: the depth-k
+    # interior/boundary split (gol_tpu.parallel.halo) lifted the old
+    # 1-D-only restriction, so the dense cliff is gone.
     rt3 = GolRuntime(
         geometry=Geometry(size=256, num_ranks=1),
         mesh=mesh_mod.make_mesh_2d(),
         shard_mode="overlap",
     )
-    assert rt3._resolved == "dense"
+    assert rt3._resolved == "bitpack"
+    board0 = patterns.init_global(5, 256, 1)
+    _, state3 = rt3.run(pattern=5, iterations=5)
+    np.testing.assert_array_equal(
+        np.asarray(state3.board), oracle.run_torus(board0, 5)
+    )
 
 
 # -- fused Pallas kernel per shard (interpret mode on CPU) -------------------
@@ -878,33 +885,26 @@ def test_runtime_folded_overlap_end_to_end():
     )
 
 
-def test_auto_2d_overlap_dense_fallback_warns_on_tpu(monkeypatch):
-    """r4: when 2-D overlap has no packed program on TPU, auto must say
-    so (the r3 silent dense fallback hid an order-of-magnitude loss)."""
+def test_auto_2d_overlap_no_dense_cliff(monkeypatch):
+    """PR 9 ends the r3/r4 dense-fallback story: when 2-D overlap misses
+    the fused-Pallas gate, auto degrades to the BIT-PACKED ring (the
+    depth-k split covers 2-D packed overlap now) — no dense cliff, no
+    warning, on any backend."""
     import warnings as warnings_mod
 
     from gol_tpu.models.state import Geometry
     from gol_tpu.runtime import GolRuntime
 
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    mesh = mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8])
-    with pytest.warns(UserWarning, match="resolving to the DENSE"):
-        rt = GolRuntime(
-            geometry=Geometry(size=128, num_ranks=1),  # 1-word shards
-            mesh=mesh,
-            shard_mode="overlap",
-        )
-    assert rt._resolved == "dense"
-    # Off-TPU the gate never ran, so no (misleading) warning fires.
-    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
-    with warnings_mod.catch_warnings():
-        warnings_mod.simplefilter("error")
-        rt = GolRuntime(
-            geometry=Geometry(size=128, num_ranks=1),
-            mesh=mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8]),
-            shard_mode="overlap",
-        )
-    assert rt._resolved == "dense"
+    for backend in ("tpu", "cpu"):
+        monkeypatch.setattr(jax, "default_backend", lambda b=backend: b)
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error")
+            rt = GolRuntime(
+                geometry=Geometry(size=128, num_ranks=1),  # 1-word shards
+                mesh=mesh_mod.make_mesh_2d((2, 4), devices=jax.devices()[:8]),
+                shard_mode="overlap",
+            )
+        assert rt._resolved == "bitpack"
 
 
 def test_fold_feasible_predicate():

@@ -1,0 +1,193 @@
+"""Schema v8 (halo-exchange chunk block) + v1–v7 back-compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..7}.py.
+Here:
+
+- the v8 addition round-trips: sharded ring-engine chunks carry a
+  ``halo`` block — the exchange depth/mode the chunk program compiled,
+  the per-chunk exchange count, and the band traffic with its payload
+  share (docs/OBSERVABILITY.md);
+- a REAL pipelined runtime run emits the block on every chunk, with the
+  accounting matching the chunk schedule (exactly ⌈take/k⌉ exchanges);
+- **back-compat**: ALL SEVEN committed fixtures — PR 2 (v1) through
+  PR 9 (v7) — still load, and a directory holding v1–v7 + a fresh v8
+  stream merges and renders in one ``summarize`` pass (exit 0)
+  including the halo column, while a bogus schema still exits 2.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+}
+
+HALO_BLOCK = {
+    "depth": 4,
+    "mode": "pipeline",
+    "exchanges": 2,
+    "band_bytes": 2048,
+    "exchange_share": 0.015,
+}
+
+
+def _v8_stream(directory, run_id="v8"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "2d", "engine": "bitpack",
+             "resolved_engine": "bitpack", "shard_mode": "pipeline",
+             "halo_depth": 4, "height": 64, "width": 64,
+             "mesh": {"rows": 4}}
+        )
+        ev.compile_event(8, 0.01, 0.09)
+        ev.chunk_event(0, 8, 8, 0.002, 32768, None, halo=HALO_BLOCK)
+        return ev.path
+
+
+def test_v8_halo_block_roundtrip(tmp_path):
+    path = _v8_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION == 8
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= {1, 2, 3, 4, 5, 6, 7, 8}
+    chunk = recs[2]
+    assert chunk["event"] == "chunk"
+    assert chunk["halo"]["mode"] == "pipeline"
+    assert chunk["halo"]["depth"] == 4
+    assert chunk["halo"]["exchanges"] == 2
+
+
+def test_real_pipelined_run_stamps_halo_blocks(tmp_path):
+    """End to end through GolRuntime: every chunk of a pipelined sharded
+    run carries the v8 block, and the accounting matches the schedule."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="bitpack",
+        mesh=mesh_mod.make_mesh_1d(4),
+        shard_mode="pipeline",
+        halo_depth=4,
+        telemetry_dir=str(tmp_path),
+        run_id="halorun",
+    )
+    rt.run(pattern=5, iterations=10)
+    recs = [
+        json.loads(ln)
+        for ln in open(tmp_path / "halorun.rank0.jsonl")
+    ]
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert chunks
+    for c in chunks:
+        hb = c["halo"]
+        assert hb["mode"] == "pipeline" and hb["depth"] == 4
+        assert hb["exchanges"] == math.ceil(c["take"] / 4)
+        assert hb["band_bytes"] > 0
+        assert 0.0 < hb["exchange_share"] < 1.0
+
+
+def test_explicit_depth1_run_still_stamps_contract(tmp_path):
+    """The block is mode-agnostic ring accounting: explicit depth-1 runs
+    report one exchange per generation."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        mesh=mesh_mod.make_mesh_1d(4),
+        telemetry_dir=str(tmp_path),
+        run_id="exprun",
+    )
+    rt.run(pattern=5, iterations=6)
+    chunks = [
+        json.loads(ln)
+        for ln in open(tmp_path / "exprun.rank0.jsonl")
+        if '"chunk"' in ln
+    ]
+    chunks = [c for c in chunks if c["event"] == "chunk"]
+    assert chunks
+    for c in chunks:
+        assert c["halo"]["depth"] == 1
+        assert c["halo"]["mode"] == "explicit"
+        assert c["halo"]["exchanges"] == c["take"]
+
+
+def test_unsharded_run_has_no_halo_block(tmp_path):
+    """mesh none: no ring, no block — the stream stays v1-shaped there
+    (and the PR 2 trace-identity pin keeps holding)."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="bitpack",
+        telemetry_dir=str(tmp_path),
+        run_id="solo",
+    )
+    rt.run(pattern=5, iterations=6)
+    chunks = [
+        json.loads(ln)
+        for ln in open(tmp_path / "solo.rank0.jsonl")
+        if '"chunk"' in ln
+    ]
+    assert all("halo" not in c for c in chunks if c["event"] == "chunk")
+
+
+def test_committed_fixture_schemas_are_v1_to_v7():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v7_fixture_carries_reshard():
+    events = [json.loads(ln)["event"] for ln in FIXTURES[7].open()]
+    assert "reshard" in events
+
+
+def test_v1_to_v8_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v8_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "v8",
+    ):
+        assert run_id in out
+    assert "halo (mode k exch band)" in out
+    assert "pipeline k=4" in out
+
+
+def test_bogus_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": 99, "run_id": "bad",
+             "process_index": 0, "process_count": 1, "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
