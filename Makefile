@@ -1,11 +1,12 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
-# smoke + halo smoke + tier-1 tests (see scripts/check.sh).
+# smoke + halo smoke + chaos smoke + tier-1 tests (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
-	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep
+	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
+	chaos-smoke chaos-matrix
 
 lint:
 	bash scripts/lint.sh
@@ -92,6 +93,18 @@ halobench-sweep:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	    python -m gol_tpu.utils.halobench 1024 16 1d:4 \
 	    dense,bitpack,pallas --halo-depth-sweep 1,2,4,8,16
+
+# Unified-fault-plane smoke (docs/RESILIENCE.md): one plan file driving
+# bit-flip + torn-write + ENOSPC through a small guarded batch run —
+# detected, contained, recovered byte-equal, v9 records on the stream.
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+# The full committed scenario × tier × mesh grid (minutes on CPU; also
+# `pytest -m slow tests/test_chaos_matrix.py`).
+chaos-matrix:
+	JAX_PLATFORMS=cpu python -m gol_tpu.resilience chaos \
+	    --plan tests/data/fault_plans/chaos_matrix.json
 
 check:
 	bash scripts/check.sh

@@ -88,6 +88,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(cfg.name)
         if extras_on:
             from gol_tpu.analysis.batchcheck import default_batch_matrix
+            from gol_tpu.analysis.guardcheck import default_guard_matrix
             from gol_tpu.analysis.halocheck import default_halo_matrix
             from gol_tpu.analysis.reshardcheck import default_reshard_matrix
             from gol_tpu.analysis.sparsecheck import default_sparse_matrix
@@ -100,6 +101,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(rcfg.name)
             for hcfg in default_halo_matrix():
                 print(hcfg.name)
+            for gcfg in default_guard_matrix():
+                print(gcfg.name)
         return 0
 
     from gol_tpu.analysis.checks import run_config
@@ -109,6 +112,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report.engines.append(run_config(cfg))
     if extras_on:
         from gol_tpu.analysis.batchcheck import run_batch_checks
+        from gol_tpu.analysis.guardcheck import run_guard_checks
         from gol_tpu.analysis.halocheck import run_halo_checks
         from gol_tpu.analysis.reshardcheck import run_reshard_checks
         from gol_tpu.analysis.sparsecheck import run_sparse_checks
@@ -117,6 +121,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report.engines.extend(run_sparse_checks())
         report.engines.extend(run_reshard_checks())
         report.engines.extend(run_halo_checks())
+        report.engines.extend(run_guard_checks())
 
     if ns.json:
         print(report.to_json())

@@ -1,0 +1,298 @@
+"""Guard-coverage matrix: which tier detects which fault — with teeth.
+
+One report per (tier, mesh) cell, proving the three claims the guard's
+coverage table (docs/RESILIENCE.md "Guard coverage") makes:
+
+- **invariant-detects** — an injected *out-of-range* cell (the 0xA5
+  byte a real storage flip produces in uint8) fails the guard's 0/1
+  invariant audit, and the rollback-replay recovers the exact clean
+  grid.
+- **redundant-detects** — an injected *in-range* flip (0↔1: values the
+  rule itself could produce) fails the cross-engine redundancy audit
+  (``--guard-redundant``), and the recovery is byte-identical.
+- **audit-teeth** (the broken fixture) — the same in-range flip driven
+  through (a) an **un-audited** run and (b) a **plain** invariant-only
+  guard must be *missed* by both: the unguarded final grid must differ
+  from the clean run (the corruption is real and silent), and the plain
+  guard must report zero failures (the 0/1 invariant alone cannot see
+  an in-range value).  If either path "catches" it, the redundancy
+  audit's detection claim has lost its witness — a detector that fires
+  on corruption an oracle-free run would also reject is proving
+  nothing.
+
+Cells run the REAL runtimes (``run_guarded`` / the batch guard) with the
+fault plane (:mod:`gol_tpu.resilience.faults`) armed, on CPU — the same
+injection surface production uses, not a test double.
+
+Run as part of ``python -m gol_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from gol_tpu.analysis.report import (
+    ERROR,
+    FAIL,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+_PATTERN = 4  # deterministic soup
+_ITER = 6
+_EVERY = 2
+# The flip lands at the FINAL generation so it provably persists into
+# the output of an un-audited run (an earlier isolated flip can be
+# extinguished by the rule itself, which would fake "missed" results).
+_ROW, _COL = 10, 20
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardCellConfig:
+    """One (tier, mesh) cell of the coverage matrix."""
+
+    name: str
+    engine: str  # dense / bitpack / pallas / activity / batch
+    mesh: str = "none"
+    size: int = 64
+    shard_mode: str = "explicit"
+    halo_depth: int = 1
+
+
+def default_guard_matrix() -> List[GuardCellConfig]:
+    return [
+        GuardCellConfig("guard/dense/none", "dense"),
+        GuardCellConfig("guard/dense/1d", "dense", "1d", size=128),
+        GuardCellConfig("guard/bitpack/none", "bitpack"),
+        GuardCellConfig("guard/bitpack/2d", "bitpack", "2d", size=128),
+        GuardCellConfig(
+            "guard/bitpack/1d/pipeline/k=2", "bitpack", "1d", size=128,
+            shard_mode="pipeline", halo_depth=2,
+        ),
+        GuardCellConfig("guard/activity/none", "activity"),
+        GuardCellConfig("guard/batch/none", "batch"),
+    ]
+
+
+def _flip_plan(value: int):
+    from gol_tpu.resilience import faults
+
+    return faults.FaultPlan.from_obj(
+        [
+            {
+                "site": "board.bitflip",
+                "at": _ITER,
+                "world": 1,
+                "row": _ROW,
+                "col": _COL,
+                "value": value,
+            }
+        ]
+    )
+
+
+def _run(cfg: GuardCellConfig, *, guard: bool, redundant: bool = False,
+         plan=None):
+    """(final, guard_failures) through the real runtime dispatch."""
+    from gol_tpu.resilience import faults
+
+    faults.install(plan)
+    try:
+        if cfg.engine == "batch":
+            from gol_tpu.batch import GolBatchRuntime
+            from gol_tpu.models import patterns
+
+            worlds = [
+                patterns.init_global(_PATTERN, cfg.size, 1)
+                for _ in range(3)
+            ]
+            brt = GolBatchRuntime(
+                worlds=worlds,
+                engine="auto",
+                guard_every=_EVERY if guard else 0,
+                guard_redundant=redundant,
+            )
+            _, boards = brt.run(_ITER)
+            failures = brt.last_guard.failures if brt.last_guard else 0
+            return [np.asarray(b) for b in boards], failures
+        from gol_tpu.models.state import Geometry
+        from gol_tpu.runtime import GolRuntime, build_mesh
+        from gol_tpu.utils import guard as guard_mod
+
+        rt = GolRuntime(
+            geometry=Geometry(size=cfg.size, num_ranks=1),
+            engine=cfg.engine,
+            mesh=build_mesh(cfg.mesh),
+            shard_mode=cfg.shard_mode,
+            halo_depth=cfg.halo_depth,
+        )
+        if guard:
+            _, state, report = guard_mod.run_guarded(
+                rt,
+                pattern=_PATTERN,
+                iterations=_ITER,
+                config=guard_mod.GuardConfig(
+                    check_every=_EVERY, redundant=redundant
+                ),
+            )
+            return np.asarray(state.board), report.failures
+        _, state = rt.run(pattern=_PATTERN, iterations=_ITER)
+        return np.asarray(state.board), 0
+    finally:
+        faults.clear()
+
+
+def _equal(a, b) -> bool:
+    if isinstance(a, list):
+        return all(np.array_equal(x, y) for x, y in zip(a, b))
+    return np.array_equal(a, b)
+
+
+def check_invariant_detects(cfg, clean) -> CheckResult:
+    findings: List[Finding] = []
+    final, failures = _run(cfg, guard=True, plan=_flip_plan(0xA5))
+    if failures < 1:
+        findings.append(
+            Finding(
+                ERROR, "invariant-detects",
+                "an out-of-range cell (0xA5) passed the 0/1 invariant "
+                "audit — detection tier 1 is dead on this cell",
+            )
+        )
+    elif not _equal(final, clean):
+        findings.append(
+            Finding(
+                ERROR, "invariant-detects",
+                "the flip was detected but rollback-replay did not "
+                "recover the clean grid",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO, "invariant-detects",
+                f"out-of-range flip detected ({failures} audit "
+                "failure(s)) and recovered byte-identically",
+            )
+        )
+    return CheckResult.from_findings("invariant-detects", findings)
+
+
+def check_redundant_detects(cfg, clean) -> CheckResult:
+    findings: List[Finding] = []
+    final, failures = _run(
+        cfg, guard=True, redundant=True, plan=_flip_plan(-1)
+    )
+    if failures < 1:
+        findings.append(
+            Finding(
+                ERROR, "redundant-detects",
+                "an in-range flip survived the cross-engine redundancy "
+                "audit — the only in-run SDC oracle missed it",
+            )
+        )
+    elif not _equal(final, clean):
+        findings.append(
+            Finding(
+                ERROR, "redundant-detects",
+                "the in-range flip was detected but rollback-replay did "
+                "not recover the clean grid",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO, "redundant-detects",
+                "in-range flip caught by the redundancy audit and "
+                "recovered byte-identically",
+            )
+        )
+    return CheckResult.from_findings("redundant-detects", findings)
+
+
+def check_audit_teeth(cfg, clean) -> CheckResult:
+    """The broken fixture: the in-range flip MUST evade everything weaker."""
+    findings: List[Finding] = []
+    unaudited, _ = _run(cfg, guard=False, plan=_flip_plan(-1))
+    if _equal(unaudited, clean):
+        findings.append(
+            Finding(
+                ERROR, "audit-teeth",
+                "the un-audited run's final grid EQUALS the clean run "
+                "despite the injected in-range flip — the corruption "
+                "never landed, so the redundancy audit's catch proves "
+                "nothing on this cell",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO, "audit-teeth",
+                "the un-audited run silently carries the flip into its "
+                "final grid (corruption is real and invisible without "
+                "the audit)",
+            )
+        )
+    plain_final, plain_failures = _run(
+        cfg, guard=True, redundant=False, plan=_flip_plan(-1)
+    )
+    if plain_failures != 0:
+        findings.append(
+            Finding(
+                ERROR, "audit-teeth",
+                f"the PLAIN (invariant-only) guard reported "
+                f"{plain_failures} failure(s) on an in-range flip — the "
+                "0/1 invariant cannot legitimately see an in-range "
+                "value, so this detection is spurious and the "
+                "redundancy audit has no exclusive claim",
+            )
+        )
+    elif _equal(plain_final, clean):
+        findings.append(
+            Finding(
+                ERROR, "audit-teeth",
+                "the plain guard's final grid equals clean — the flip "
+                "vanished without a detection, witness lost",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO, "audit-teeth",
+                "the plain 0/1 guard misses the in-range flip (0 "
+                "failures, corrupted output) while the redundancy audit "
+                "catches it — the audit has teeth",
+            )
+        )
+    return CheckResult.from_findings("audit-teeth", findings)
+
+
+def run_guard_config(cfg: GuardCellConfig) -> EngineReport:
+    report = EngineReport(config_name=cfg.name)
+    try:
+        clean, _ = _run(cfg, guard=False, plan=None)
+    except Exception as e:
+        report.checks.append(
+            CheckResult("config", FAIL, [
+                Finding(
+                    ERROR, "config",
+                    f"guard cell failed to build/run clean: {e}",
+                )
+            ])
+        )
+        return report
+    report.checks.append(check_invariant_detects(cfg, clean))
+    report.checks.append(check_redundant_detects(cfg, clean))
+    report.checks.append(check_audit_teeth(cfg, clean))
+    return report
+
+
+def run_guard_checks(
+    matrix: Optional[List[GuardCellConfig]] = None,
+) -> List[EngineReport]:
+    return [run_guard_config(c) for c in (matrix or default_guard_matrix())]

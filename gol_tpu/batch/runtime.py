@@ -147,6 +147,18 @@ class GolBatchRuntime:
     # same contract as GolRuntime: Prometheus text fed by the event
     # stream, requires telemetry.
     metrics_port: Optional[int] = None
+    # Guarded batch runs (docs/RESILIENCE.md "Guard coverage"): audit
+    # every world of every bucket each ``guard_every`` generations (one
+    # vmapped fused reduce per bucket) and roll back ONLY the corrupted
+    # world's bucket to its last audited-good stack — the other buckets
+    # never replay.  ``guard_redundant`` recomputes each audited chunk
+    # on the bucket's counterpart engine (dense checks packed buckets
+    # and vice versa) and compares per-world fingerprints — the in-range
+    # SDC detector, same contract as the single-world guard.
+    guard_every: int = 0
+    guard_max_restores: int = 3
+    guard_redundant: bool = False
+    guard_redundant_every: int = 1
 
     def __post_init__(self) -> None:
         if self.engine not in batch_engines.BATCH_ENGINES:
@@ -184,9 +196,35 @@ class GolBatchRuntime:
             resolve_bucket_engine(self.engine, bk, self._shapes)
             for bk in self.buckets
         ]
+        if self.guard_every < 0:
+            raise ValueError(
+                f"guard_every must be >= 0, got {self.guard_every} "
+                "(0 disables the guard)"
+            )
+        if self.guard_redundant and self.guard_every <= 0:
+            raise ValueError(
+                "guard_redundant audits chunks, so it requires "
+                "guard_every > 0"
+            )
+        if self.guard_redundant_every != 1 and not self.guard_redundant:
+            raise ValueError(
+                "guard_redundant_every samples the redundancy audit, so "
+                "it requires guard_redundant"
+            )
+        if self.guard_redundant:
+            # Fail at construction, not mid-run: every bucket needs a
+            # second bit-exact engine for the cross-engine recompute.
+            for bucket_id in range(len(self.buckets)):
+                self._checker_engine(bucket_id)
+        # The last guarded run's report (None for unguarded runs).
+        self.last_guard = None
         self.generation = 0
         self._ckpt_writer = None
         self._resume_source: Optional[str] = None
+        # Checkpoint containment + live-events handle, same contract as
+        # GolRuntime (docs/RESILIENCE.md "Retry and shed").
+        self._ckpt_shed = False
+        self._live_events = None
         if self.metrics_port is not None and not self.telemetry_dir:
             raise ValueError(
                 "metrics_port serves the in-process event stream, so it "
@@ -244,6 +282,42 @@ class GolBatchRuntime:
             self._bucket_mesh(bucket),
         )
         return fn, masked
+
+    def _checker_engine(self, bucket_id: int) -> str:
+        """The redundant audit's second bit-exact engine for one bucket.
+
+        Mirrors ``guard._checker_runtime``: dense buckets check on the
+        bit-packed program (requires every member width to pack into
+        whole words), packed/Pallas buckets check on dense — two
+        independent programs a random flip cannot reproduce across.
+        """
+        bucket = self.buckets[bucket_id]
+        if self._engines[bucket_id] != "dense":
+            return "dense"
+        packable = bucket.shape[1] % bitlife.BITS == 0 and all(
+            self._shapes[i][1] % bitlife.BITS == 0 for i in bucket.indices
+        )
+        if not packable:
+            raise ValueError(
+                "the redundant audit needs a second engine, and the only "
+                f"check for a dense bucket is bit-packed: bucket "
+                f"{bucket.shape} has a world width that does not pack "
+                f"into {bitlife.BITS}-bit words"
+            )
+        return "bitpack"
+
+    def _checker_evolver(self, bucket_id: int, take: int):
+        """(compiled, masked) — the checker's chunk program for one
+        bucket (same call convention as the primary evolver)."""
+        bucket = self.buckets[bucket_id]
+        fn = batch_engines.compiled_batch_evolver(
+            self._checker_engine(bucket_id),
+            take,
+            bucket.masked,
+            self.tile_hint,
+            self._bucket_mesh(bucket),
+        )
+        return fn, bucket.masked
 
     def compile_evolvers(self, schedule, events=None) -> dict:
         """AOT-compile one program per (bucket, distinct chunk size).
@@ -367,8 +441,11 @@ class GolBatchRuntime:
         return sum(h * w for h, w in self._shapes)
 
     def _save_snapshot(self) -> None:
+        from gol_tpu.resilience import degrade as degrade_mod
         from gol_tpu.utils.guard import fingerprint_np
 
+        if self._ckpt_shed:
+            return
         path = ckpt_mod.batch_checkpoint_path(
             self.checkpoint_dir, self.generation
         )
@@ -377,7 +454,16 @@ class GolBatchRuntime:
         fps = [fingerprint_np(b) for b in boards]
 
         def write():
-            ckpt_mod.save_batch(path, boards, generation, fingerprints=fps)
+            ok = degrade_mod.write_with_retry(
+                lambda: ckpt_mod.save_batch(
+                    path, boards, generation, fingerprints=fps
+                ),
+                generation=generation,
+                shed_telemetry=self._shed_telemetry,
+            )
+            if not ok:
+                self._ckpt_shed = True
+                return
             if self.keep_snapshots > 0:
                 from gol_tpu.resilience import retention
 
@@ -392,6 +478,13 @@ class GolBatchRuntime:
             self._ckpt_writer.submit(write)
         else:
             write()
+
+    def _shed_telemetry(self, reason: str) -> None:
+        """Disk-full first sacrifice (docs/RESILIENCE.md): shed the
+        event stream before giving up on checkpoints."""
+        events = self._live_events
+        if events is not None:
+            events.request_shed("telemetry", reason)
 
     def _load_snapshot(self, resume: str) -> None:
         snap = ckpt_mod.load_batch(resume)
@@ -410,6 +503,136 @@ class GolBatchRuntime:
         self.generation = snap.generation
         self._resume_source = resume
 
+    def _guarded_bucket_chunk(
+        self, i, take, bucket_id, stacks, last_good, evolvers, checkers,
+        events, sc, sw, plan_on,
+    ) -> None:
+        """Step + audit + (rollback-replay) one bucket's chunk.
+
+        The batched translation of :func:`gol_tpu.utils.guard.
+        guarded_loop`'s body: the candidate stack is audited per world
+        in one vmapped reduce; any corrupted world rolls THIS bucket
+        back to its last audited-good stack (fingerprint-verified, like
+        the single-world rollback base) and replays — sibling buckets
+        never re-execute.  The redundancy audit recomputes the chunk
+        from the same base on the bucket's counterpart engine and
+        compares per-world fingerprints.  More than
+        ``guard_max_restores`` consecutive failures raise
+        :class:`~gol_tpu.utils.guard.GuardError` naming bucket + world.
+        """
+        import dataclasses as dc
+        import time as time_mod
+
+        from gol_tpu import telemetry as telemetry_mod
+        from gol_tpu.resilience import faults as faults_mod
+        from gol_tpu.utils import guard as guard_mod
+
+        bucket = self.buckets[bucket_id]
+        compiled, masked = evolvers[(bucket_id, take)]
+        guard = self.last_guard
+        gen_after = self.generation + take
+        sampled = i % self.guard_redundant_every == 0
+        restores = 0
+        while True:
+            stack, hs, ws = stacks[bucket_id]
+            with telemetry_mod.step_annotation("gol.batch.guard.chunk", i):
+                with sw.phase("total"):
+                    t0 = time_mod.perf_counter()
+                    candidate = (
+                        compiled(stack, hs, ws) if masked else compiled(stack)
+                    )
+                    t1 = time_mod.perf_counter()
+                    force_ready(candidate)
+                    dt = time_mod.perf_counter() - t0
+            if events is not None:
+                sc.add("dispatch", t1 - t0)
+                sc.add("ready", dt - (t1 - t0))
+                cells = sum(
+                    self._shapes[j][0] * self._shapes[j][1]
+                    for j in bucket.indices
+                )
+                block = self._batch_block(bucket_id)
+                block["per_world_updates_per_sec"] = (
+                    cells * take / dt / bucket.batch if dt > 0 else 0.0
+                )
+                spans = sc.take()
+                with sc.span("telemetry"):
+                    events.chunk_event(
+                        i, take, gen_after, dt, cells * take, None,
+                        batch=block, spans=spans,
+                        restores_this_chunk=restores,
+                    )
+            if plan_on:
+                candidate = faults_mod.apply_board_faults(
+                    candidate, gen_after, world_ids=bucket.indices
+                )
+            with sw.phase("audit"):
+                audits = guard_mod.audit_worlds(candidate, gen_after)
+            if checkers is not None and sampled and all(
+                a.ok for a in audits
+            ):
+                # Cross-engine recompute from the same base: two
+                # independent programs can only agree if neither run
+                # was corrupted (the in-range-flip oracle).
+                checker, cmasked = checkers[(bucket_id, take)]
+                with sw.phase("redundant"):
+                    base = guard_mod._device_copy(last_good[bucket_id][0])
+                    reference = (
+                        checker(base, hs, ws) if cmasked else checker(base)
+                    )
+                    ref_audits = guard_mod.audit_worlds(reference, gen_after)
+                audits = [
+                    dc.replace(
+                        a,
+                        ok=r.fingerprint == a.fingerprint,
+                        redundant_fingerprint=r.fingerprint,
+                    )
+                    for a, r in zip(audits, ref_audits)
+                ]
+            guard.audits.extend(audits)
+            if events is not None:
+                with sc.span("telemetry"):
+                    for k, a in enumerate(audits):
+                        events.guard_event(
+                            a, world=bucket.indices[k], bucket=bucket_id
+                        )
+            bad = [k for k, a in enumerate(audits) if not a.ok]
+            if not bad:
+                stacks[bucket_id] = (candidate, hs, ws)
+                with sw.phase("snapshot"):
+                    last_good[bucket_id] = (
+                        guard_mod._device_copy(candidate),
+                        [a.fingerprint for a in audits],
+                    )
+                return
+            guard.failures += 1
+            restores += 1
+            if restores > self.guard_max_restores:
+                a = audits[bad[0]]
+                raise guard_mod.GuardError(
+                    f"audit failed at generation {gen_after} for world "
+                    f"{bucket.indices[bad[0]]} (bucket {bucket_id}, "
+                    f"max cell {a.max_cell}, fingerprint "
+                    f"{a.fingerprint:#010x}) and the restore budget "
+                    f"({self.guard_max_restores}) is exhausted — "
+                    "persistent fault"
+                )
+            guard.restores += 1
+            with sw.phase("restore"):
+                base_stack, base_fps = last_good[bucket_id]
+                replay = guard_mod._device_copy(base_stack)
+                base_audits = guard_mod.audit_worlds(
+                    replay, self.generation
+                )
+                if [a.fingerprint for a in base_audits] != base_fps:
+                    raise guard_mod.GuardError(
+                        f"the rollback base of bucket {bucket_id} is "
+                        f"itself corrupt at generation {self.generation}; "
+                        "in-run recovery is impossible — resume from the "
+                        "last checkpoint"
+                    )
+                stacks[bucket_id] = (replay, hs, ws)
+
     # -- main entry ----------------------------------------------------------
     def run(
         self, iterations: int, resume: Optional[str] = None
@@ -420,12 +643,23 @@ class GolBatchRuntime:
         init / compile / chunked total (device execution only, fenced) /
         checkpoint, with the preemption poll at chunk boundaries and the
         async snapshot writer overlapping checkpoint I/O.
+
+        With ``guard_every`` set the loop is the guarded form: every
+        bucket's chunk is audited per world (vmapped fused reduce), a
+        corrupted world rolls back ONLY its bucket to the last
+        audited-good stack and replays under the restore budget, and
+        only audited boards ever reach a checkpoint.  ``last_guard``
+        holds the :class:`~gol_tpu.utils.guard.GuardReport`.
         """
         import time as time_mod
 
         from gol_tpu import resilience
         from gol_tpu import telemetry as telemetry_mod
+        from gol_tpu.resilience import degrade as degrade_mod
+        from gol_tpu.resilience import faults as faults_mod
 
+        plan_on = faults_mod.active() is not None
+        self._ckpt_shed = False
         sw = Stopwatch()
         with sw.phase("init"):
             if resume:
@@ -434,19 +668,43 @@ class GolBatchRuntime:
             for bucket_id, bucket in enumerate(self.buckets):
                 stacks[bucket_id] = self._stack(bucket)
 
-        schedule = chunk_schedule(
-            iterations,
-            self.checkpoint_every if self.checkpoint_every > 0 else iterations,
+        interval = (
+            self.guard_every
+            if self.guard_every > 0
+            else (
+                self.checkpoint_every
+                if self.checkpoint_every > 0
+                else iterations
+            )
         )
+        schedule = chunk_schedule(iterations, interval)
         events = self.open_event_log()
+        self._live_events = events
         # Span attribution (schema v6): with several buckets per chunk
         # index, each bucket's event carries its own dispatch/ready and
         # the clock's accumulated boundary phases drain into whichever
         # event is emitted next — aggregate per-phase totals stay exact.
         sc = telemetry_mod.SpanClock() if events is not None else None
+
+        def _drain_plane():
+            if events is None:
+                return
+            for f in faults_mod.drain_fired():
+                events.fault_event(**f)
+            for d in degrade_mod.drain_reports():
+                events.degraded_event(**d)
         try:
             with sw.phase("compile"):
                 evolvers = self.compile_evolvers(schedule, events)
+                checkers = None
+                if self.guard_redundant:
+                    checkers = {
+                        (bucket_id, take): self._checker_evolver(
+                            bucket_id, take
+                        )
+                        for bucket_id in range(len(self.buckets))
+                        for take in sorted(set(schedule))
+                    }
                 for stack, _, _ in stacks.values():
                     force_ready(stack)
 
@@ -454,11 +712,40 @@ class GolBatchRuntime:
             if self.checkpoint_every > 0:
                 writer = ckpt_mod.AsyncSnapshotWriter()
             self._ckpt_writer = writer
+            guarded = self.guard_every > 0
+            if guarded:
+                from gol_tpu.utils import guard as guard_mod
+
+                self.last_guard = guard_mod.GuardReport()
+                # Rollback bases: one audited-good device stack + its
+                # per-world fingerprints per bucket, resident like the
+                # single-world guard's last_good board.
+                last_good = {}
+                for bucket_id, (stack, _, _) in stacks.items():
+                    audits0 = guard_mod.audit_worlds(
+                        stack, self.generation
+                    )
+                    last_good[bucket_id] = (
+                        guard_mod._device_copy(stack),
+                        [a.fingerprint for a in audits0],
+                    )
+            next_ckpt = (
+                self.generation + self.checkpoint_every
+                if guarded and self.checkpoint_every > 0
+                else None
+            )
             try:
                 with telemetry_mod.trace_annotation("gol.batch.evolve"):
                     for i, take in enumerate(schedule):
                         with telemetry_mod.step_annotation("gol.batch.chunk", i):
                             for bucket_id, bucket in enumerate(self.buckets):
+                                if guarded:
+                                    self._guarded_bucket_chunk(
+                                        i, take, bucket_id, stacks,
+                                        last_good, evolvers, checkers,
+                                        events, sc, sw, plan_on,
+                                    )
+                                    continue
                                 compiled, masked = evolvers[(bucket_id, take)]
                                 stack, hs, ws = stacks[bucket_id]
                                 with sw.phase("total"):
@@ -470,6 +757,15 @@ class GolBatchRuntime:
                                     t1 = time_mod.perf_counter()
                                     force_ready(stack)
                                     dt = time_mod.perf_counter() - t0
+                                if plan_on:
+                                    # Un-audited SDC injection: the
+                                    # corruption this path must NOT
+                                    # catch (guard-coverage teeth).
+                                    stack = faults_mod.apply_board_faults(
+                                        stack,
+                                        self.generation + take,
+                                        world_ids=bucket.indices,
+                                    )
                                 stacks[bucket_id] = (stack, hs, ws)
                                 if events is not None:
                                     sc.add("dispatch", t1 - t0)
@@ -497,7 +793,19 @@ class GolBatchRuntime:
                                             spans=spans,
                                         )
                         self.generation += take
-                        if self.checkpoint_every > 0:
+                        due = (
+                            next_ckpt is not None
+                            and self.generation >= next_ckpt
+                        )
+                        if due:
+                            next_ckpt = (
+                                self.generation + self.checkpoint_every
+                            )
+                        if (
+                            self.checkpoint_every > 0
+                            and not self._ckpt_shed
+                            and (due or not guarded)
+                        ):
                             with sw.phase("init"):
                                 t0 = time_mod.perf_counter()
                                 # Host crop of every stepped stack: the
@@ -534,6 +842,9 @@ class GolBatchRuntime:
                                         self._world_cells(),
                                         overlapped=writer is not None,
                                     )
+                        if plan_on:
+                            faults_mod.crash_or_stall(self.generation)
+                        _drain_plane()
                         if i < len(schedule) - 1:
                             if sc is None:
                                 preempt_now = (
@@ -545,7 +856,23 @@ class GolBatchRuntime:
                                         resilience.agreed_preempt_requested()
                                     )
                             if preempt_now:
-                                checkpointed = self.checkpoint_every > 0
+                                checkpointed = (
+                                    self.checkpoint_every > 0
+                                    and not self._ckpt_shed
+                                )
+                                if checkpointed and guarded and not due:
+                                    # Guarded cadence: this boundary has
+                                    # no snapshot yet — write one from
+                                    # the audited stacks before exiting.
+                                    with sw.phase("init"):
+                                        for bid, bk in enumerate(
+                                            self.buckets
+                                        ):
+                                            self._unstack(
+                                                bk, stacks[bid][0]
+                                            )
+                                    with sw.phase("checkpoint"):
+                                        self._save_snapshot()
                                 if writer is not None and checkpointed:
                                     with sw.phase("checkpoint"):
                                         writer.flush()
@@ -571,10 +898,12 @@ class GolBatchRuntime:
             with sw.phase("init"):
                 for bucket_id, bucket in enumerate(self.buckets):
                     self._unstack(bucket, stacks[bucket_id][0])
+            _drain_plane()
             report = sw.report(self._world_cells() * iterations)
             if events is not None:
                 events.summary(report)
         finally:
+            self._live_events = None
             if events is not None:
                 events.close()
         return report, list(self._boards)

@@ -181,6 +181,12 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument(
         "--guard-redundant-every", type=int, default=1, metavar="N"
     )
+    # Declarative fault injection (docs/RESILIENCE.md "The fault
+    # plane"): PATH to a JSON FaultPlan, or inline JSON.  The
+    # GOL_FAULT_PLAN env var is the equivalent (supervised children
+    # inherit it); legacy GOL_CKPT_TEST_WRITE_DELAY keeps working as a
+    # documented alias for a checkpoint.rename_delay entry.
+    ext.add_argument("--fault-plan", default=None, metavar="PLAN")
     ns = ext.parse_args(list(argv))
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE)
@@ -228,6 +234,10 @@ def _run_batch(
             restart_attempt=restart_attempt,
             resume_info=resume_info,
             metrics_port=ns.metrics_port,
+            guard_every=ns.guard_every,
+            guard_max_restores=ns.guard_max_restores,
+            guard_redundant=ns.guard_redundant,
+            guard_redundant_every=ns.guard_redundant_every,
         )
         with resilience.preemption_guard():
             report, boards = brt.run(iterations, resume=resume)
@@ -244,6 +254,8 @@ def _run_batch(
         f"bucket(s), {report.updates_per_sec / max(ns.batch, 1):.4g} "
         "cell-updates/sec per world"
     )
+    if brt.last_guard is not None:
+        print(brt.last_guard.summary_line())
     accelerator = "GPU" if ns.compat_banner else "TPU"
     print(
         f"This is the Game of Life running in parallel on a {accelerator} "
@@ -270,6 +282,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ns = parse_args(argv)
     if ns is None:
         return 255  # exit(-1) in the reference (gol-main.c:46)
+
+    from gol_tpu.resilience import faults as faults_mod
+
+    try:
+        if ns.fault_plan:
+            faults_mod.install(faults_mod.FaultPlan.load(ns.fault_plan))
+        else:
+            faults_mod.install_from_env()
+    except faults_mod.FaultPlanError as e:
+        print(e)
+        return 255
 
     from gol_tpu.models import patterns
     from gol_tpu.models.state import Geometry
@@ -369,12 +392,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--stats applies to unguarded runs; drop --guard-every "
                 "(the guard's audit already reports population per chunk)"
             )
-        if ns.engine == "activity" and ns.guard_every > 0:
-            raise ValueError(
-                "--guard-every applies to the dense/bitpack/pallas "
-                "tiers; the activity engine runs unguarded (its gated "
-                "step is bit-pinned against the dense tiers)"
-            )
         if (ns.activity_tile or ns.activity_capacity != 0.25) \
                 and ns.engine != "activity":
             raise ValueError(
@@ -457,10 +474,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--batch runs the B3/S23 fast paths; --rule is a "
                     "single-world feature"
                 )
-            if ns.guard_every > 0 or ns.stats:
+            if ns.stats:
                 raise ValueError(
-                    "--guard-every/--stats are single-world observers; "
-                    "drop them in --batch mode"
+                    "--stats is a single-world observer; drop it in "
+                    "--batch mode (guarded batch runs report per-world "
+                    "audit populations instead)"
                 )
             if ns.profile:
                 raise ValueError(

@@ -334,6 +334,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # population/births/deaths/changed fused onto the chunk program —
     # same surface and constraints as the 2-D driver's --stats.
     ext.add_argument("--stats", action="store_true")
+    # Declarative fault injection, same surface as the 2-D driver
+    # (docs/RESILIENCE.md): PATH or inline JSON; GOL_FAULT_PLAN is the
+    # env equivalent.  3-D board.bitflip entries use plane/row/col.
+    ext.add_argument("--fault-plan", default=None, metavar="PLAN")
     ns = ext.parse_args(argv)
     if len(ns.positionals) != 5:
         sys.stdout.write(USAGE3D)
@@ -343,6 +347,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     iterations = atoi(ns.positionals[2])
     threads = atoi(ns.positionals[3])
     on_off = atoi(ns.positionals[4])
+
+    from gol_tpu.resilience import degrade as degrade_mod
+    from gol_tpu.resilience import faults as faults_mod
+
+    try:
+        if ns.fault_plan:
+            faults_mod.install(faults_mod.FaultPlan.load(ns.fault_plan))
+        else:
+            faults_mod.install_from_env()
+    except faults_mod.FaultPlanError as e:
+        print(e)
+        return 255
+    plan_on = faults_mod.active() is not None
 
     try:
         topo = multihost.init_multihost(
@@ -631,20 +648,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     protect=(resume_src,),
                 )
 
+        # Checkpoint containment (docs/RESILIENCE.md "Retry and shed"):
+        # transient write errors retry with backoff; persistent ENOSPC
+        # sheds telemetry first, then checkpointing — never the run.
+        ckpt_state = {"shed": False}
+
+        def shed_telemetry(reason):
+            if events is not None:
+                events.request_shed("telemetry", reason)
+
         def save_snapshot(b, g, fp=None):
+            if ckpt_state["shed"]:
+                return
             if mesh is not None:
-                ckpt_mod.save_sharded3d(
-                    ckpt_mod.sharded_checkpoint3d_path(
-                        ns.checkpoint_dir, g
+                ok = degrade_mod.write_with_retry(
+                    lambda: ckpt_mod.save_sharded3d(
+                        ckpt_mod.sharded_checkpoint3d_path(
+                            ns.checkpoint_dir, g
+                        ),
+                        b,
+                        g,
+                        rulestr,
+                        fingerprint=fp,
                     ),
-                    b,
-                    g,
-                    rulestr,
-                    fingerprint=fp,
+                    generation=g,
+                    shed_telemetry=shed_telemetry,
                 )
                 from jax.experimental import multihost_utils
 
+                # The barrier runs even on a shed write: a degraded
+                # rank must not strand its peers in the fence.
                 multihost_utils.sync_global_devices("gol3d_checkpoint")
+                if not ok:
+                    ckpt_state["shed"] = True
+                    return
                 # Retention after the barrier, one process sweeping.
                 if jax.process_index() == 0:
                     gc_old_snapshots()
@@ -658,7 +695,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 vol_np = np.asarray(b)
 
                 def write(p=path, v=vol_np, g=g, fp=fp):
-                    ckpt_mod.save3d(p, v, g, rulestr, fingerprint=fp)
+                    ok = degrade_mod.write_with_retry(
+                        lambda: ckpt_mod.save3d(
+                            p, v, g, rulestr, fingerprint=fp
+                        ),
+                        generation=g,
+                        shed_telemetry=shed_telemetry,
+                    )
+                    if not ok:
+                        ckpt_state["shed"] = True
+                        return
                     gc_old_snapshots()
 
                 if ckpt_writer is not None:
@@ -808,6 +854,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     board = out3
                                 force_ready(board)
                                 dt = time_mod.perf_counter() - t0
+                        if plan_on:
+                            # Fault-plane SDC injection (board.bitflip,
+                            # plane/row/col): host-side functional cell
+                            # update — the un-audited path takes the
+                            # corruption silently by design.
+                            board = faults_mod.apply_board_faults(
+                                board, generation + take
+                            )
                         generation += take
                         if events is not None:
                             sc.add("dispatch", t1 - t0)
@@ -844,7 +898,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                     generation,
                                     stats_mod.stats_values(dev_stats),
                                 )
-                        if ns.checkpoint_every > 0:
+                        if ns.checkpoint_every > 0 and not ckpt_state[
+                            "shed"
+                        ]:
                             with telemetry_mod.trace_annotation(
                                 "gol.checkpoint.save"
                             ), sw.phase("checkpoint"):
@@ -861,6 +917,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                         size**3,
                                         overlapped=ckpt_writer is not None,
                                     )
+                        if plan_on:
+                            faults_mod.crash_or_stall(generation)
+                        if events is not None:
+                            for frec in faults_mod.drain_fired():
+                                events.fault_event(**frec)
+                            for drec in degrade_mod.drain_reports():
+                                events.degraded_event(**drec)
                         if i < len(schedule) - 1:
                             if sc is None:
                                 preempt_now = (

@@ -21,8 +21,25 @@ With none of it requested (no ``--auto-resume``, no supervisor, no
 signal delivered) every piece is a strict no-op: the chunk programs'
 jaxprs are byte-identical to the resilience-free build (pinned by the
 trace-identity tests).
+
+The fault-injection plane (:mod:`~gol_tpu.resilience.faults`) and its
+containment policies (:mod:`~gol_tpu.resilience.degrade`) make every
+claimed recovery path fireable from one declarative JSON plan
+(``--fault-plan`` / ``GOL_FAULT_PLAN``); ``python -m gol_tpu.resilience
+chaos`` executes scenario × tier × mesh grids from a plan file and
+asserts detection + byte-identical recovery
+(:mod:`~gol_tpu.resilience.chaos`).
 """
 
+from gol_tpu.resilience.degrade import (  # noqa: F401
+    RetryPolicy,
+    write_with_retry,
+)
+from gol_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    FaultPlanError,
+    FaultSpec,
+)
 from gol_tpu.resilience.preempt import (  # noqa: F401
     EX_TEMPFAIL,
     Preempted,

@@ -1,6 +1,6 @@
-"""``python -m gol_tpu.resilience supervise [opts] -- <command ...>``.
+"""``python -m gol_tpu.resilience <supervise|chaos> ...``.
 
-The process-tier entry point (docs/RESILIENCE.md).  Example:
+The process-tier entry points (docs/RESILIENCE.md).  Examples:
 
     python -m gol_tpu.resilience supervise \\
         --max-restarts 5 --manifest runs/a/job.manifest.json \\
@@ -8,6 +8,9 @@ The process-tier entry point (docs/RESILIENCE.md).  Example:
         python -m gol_tpu 4 4096 10000 512 1 \\
             --checkpoint-every 200 --checkpoint-dir ck --auto-resume \\
             --telemetry runs/a --run-id a
+
+    python -m gol_tpu.resilience chaos \\
+        --plan tests/data/fault_plans/chaos_matrix.json
 """
 
 from __future__ import annotations
@@ -16,10 +19,18 @@ import argparse
 import sys
 from typing import Optional, Sequence
 
-from gol_tpu.resilience import supervisor as sup_mod
-
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "chaos":
+        # The chaos matrix owns its own argv (and must set XLA device
+        # flags before the first backend touch).
+        from gol_tpu.resilience import chaos as chaos_mod
+
+        return chaos_mod.main(argv[1:])
+
+    from gol_tpu.resilience import supervisor as sup_mod
+
     p = argparse.ArgumentParser(
         prog="gol_tpu.resilience",
         description="Supervise a gol run: restart on crash/preemption "
@@ -51,7 +62,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "child", nargs=argparse.REMAINDER,
         metavar="-- COMMAND ...",
     )
-    ns = p.parse_args(list(sys.argv[1:] if argv is None else argv))
+    ns = p.parse_args(argv)
     child = list(ns.child)
     if child and child[0] == "--":
         child = child[1:]

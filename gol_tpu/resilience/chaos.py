@@ -1,0 +1,545 @@
+"""The chaos matrix: scenario × tier × mesh, detection + byte-identical
+recovery, from one committed plan file.
+
+``python -m gol_tpu.resilience chaos --plan FILE`` executes every cell
+of the grid the plan describes (docs/RESILIENCE.md "The chaos matrix"):
+for each *scenario* (a named list of fault-plan entries plus an
+expectation class) crossed with each *tier* (dense / bitpack / pallas /
+batch / activity / 3-D) and *mesh* (none / 1d / 2d), the runner
+
+1. computes the tier's **clean** final grid once (cached per cell),
+2. re-runs with the scenario's faults armed through the real CLI/runtime
+   surfaces (:mod:`gol_tpu.resilience.faults`),
+3. asserts the scenario's **detection signal** fired — a guard failure,
+   a resume-walk fallback, a v9 ``fault``/``degraded`` telemetry record —
+   and that the recovered final grid is **byte-identical** to the clean
+   run.
+
+Illegal cells (an engine with no sharded path, a mesh the geometry
+cannot tile, a Pallas kernel the backend lacks) are *visibly skipped*
+with the refusing error as the reason — a skip is a recorded fact, not
+a silent hole in the matrix.
+
+Expectation classes (the ``kind`` field of a scenario):
+
+- ``guard``      — guarded run; the audit must fail >= once and the
+  rollback-replay must land the clean grid (``redundant: true`` arms the
+  cross-engine audit — required for in-range flips).
+- ``resume``     — checkpointed run whose newest snapshot the fault
+  corrupts on disk; the validated resume walk must *fall back* past it
+  and a resumed run must complete the clean grid.
+- ``contain``    — checkpointed+telemetry run; the fault (transient IO
+  error, torn tmp, rank stall) must be absorbed by retry/containment:
+  the run completes, every surviving snapshot verifies, and the stream
+  carries the v9 ``fault`` record.
+- ``shed``       — persistent disk-full: the run must complete anyway,
+  shedding telemetry before checkpoints (v9 ``degraded`` stamped).
+- ``telemetry``  — failing rank-file write: the run completes, the
+  stream degrades (warn once, drop, ``degraded`` stamp).
+
+``crash.exit`` scenarios need a supervisor and real process death; they
+live in the subprocess drills (tests/test_resilience_drill.py,
+scripts/chaos_smoke.py) rather than this in-process matrix — a plan may
+still restrict any scenario to a tier/mesh subset via per-scenario
+``tiers``/``meshes`` keys, rendered as explicit skips elsewhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+TIERS = ("dense", "bitpack", "pallas", "batch", "activity", "3d")
+MESHES = ("none", "1d", "2d")
+KINDS = ("guard", "resume", "contain", "shed", "telemetry")
+
+#: The committed grid (the acceptance surface of the chaos matrix).
+DEFAULT_PLAN_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "tests", "data", "fault_plans", "chaos_matrix.json",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    kind: str
+    faults: tuple  # FaultSpec dicts, installed verbatim for the cell
+    redundant: bool = False  # guard kind: arm the cross-engine audit
+    tiers: Optional[tuple] = None  # per-scenario restriction (else grid)
+    meshes: Optional[tuple] = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"scenario {self.name!r}: unknown kind {self.kind!r}; "
+                f"expected one of {KINDS}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosPlan:
+    scenarios: tuple
+    tiers: tuple = TIERS
+    meshes: tuple = MESHES
+    size: int = 128  # 2-D board edge (and batch world edge)
+    size3d: int = 32  # 3-D cube edge
+    iterations: int = 6
+    guard_every: int = 2
+    checkpoint_every: int = 2
+
+    @classmethod
+    def load(cls, path: str) -> "ChaosPlan":
+        with open(path) as f:
+            obj = json.load(f)
+        scenarios = tuple(
+            Scenario(
+                name=s["name"],
+                kind=s["kind"],
+                faults=tuple(s["faults"]),
+                redundant=bool(s.get("redundant", False)),
+                tiers=tuple(s["tiers"]) if "tiers" in s else None,
+                meshes=tuple(s["meshes"]) if "meshes" in s else None,
+            )
+            for s in obj["scenarios"]
+        )
+        return cls(
+            scenarios=scenarios,
+            tiers=tuple(obj.get("tiers", TIERS)),
+            meshes=tuple(obj.get("meshes", MESHES)),
+            size=int(obj.get("size", 128)),
+            size3d=int(obj.get("size3d", 32)),
+            iterations=int(obj.get("iterations", 6)),
+            guard_every=int(obj.get("guard_every", 2)),
+            checkpoint_every=int(obj.get("checkpoint_every", 2)),
+        )
+
+
+@dataclasses.dataclass
+class CellResult:
+    scenario: str
+    tier: str
+    mesh: str
+    status: str  # "ok" / "skip" / "fail"
+    reason: str = ""
+
+    @property
+    def label(self) -> str:
+        return f"{self.scenario} × {self.tier}/{self.mesh}"
+
+
+# -- per-tier run surface -----------------------------------------------------
+
+
+@dataclasses.dataclass
+class _RunCfg:
+    iterations: int
+    guard: bool = False
+    redundant: bool = False
+    checkpoint_dir: Optional[str] = None
+    telemetry_dir: Optional[str] = None
+    run_id: Optional[str] = None
+    resume: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _Outcome:
+    final: object  # np array (2-D/3-D) or list of arrays (batch)
+    guard_failures: int = 0
+
+
+_PATTERN = 4  # deterministic soup, every engine supports it
+
+
+def _run_2d(engine: str, mesh_kind: str, plan: ChaosPlan, cfg: _RunCfg):
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime, build_mesh
+    from gol_tpu.utils import guard as guard_mod
+
+    rt = GolRuntime(
+        geometry=Geometry(size=plan.size, num_ranks=1),
+        engine=engine,
+        mesh=build_mesh(mesh_kind),
+        checkpoint_every=(
+            plan.checkpoint_every if cfg.checkpoint_dir else 0
+        ),
+        checkpoint_dir=cfg.checkpoint_dir,
+        telemetry_dir=cfg.telemetry_dir,
+        run_id=cfg.run_id,
+    )
+    if cfg.guard:
+        _, state, report = guard_mod.run_guarded(
+            rt,
+            pattern=_PATTERN,
+            iterations=cfg.iterations,
+            config=guard_mod.GuardConfig(
+                check_every=plan.guard_every, redundant=cfg.redundant
+            ),
+            resume=cfg.resume,
+        )
+        return _Outcome(np.asarray(state.board), report.failures)
+    _, state = rt.run(
+        pattern=_PATTERN, iterations=cfg.iterations, resume=cfg.resume
+    )
+    return _Outcome(np.asarray(state.board))
+
+
+def _run_batch(mesh_kind: str, plan: ChaosPlan, cfg: _RunCfg):
+    import jax
+
+    from gol_tpu.batch import GolBatchRuntime, make_batch_mesh
+    from gol_tpu.models import patterns
+
+    # A 1-D worlds mesh only actually shards when B divides the device
+    # count — size the batch so the cell exercises what it claims.
+    nb = len(jax.devices()) if mesh_kind == "1d" else 3
+    worlds = [
+        patterns.init_global(_PATTERN, plan.size, 1) for _ in range(nb)
+    ]
+    brt = GolBatchRuntime(
+        worlds=worlds,
+        engine="auto",
+        mesh=make_batch_mesh() if mesh_kind == "1d" else None,
+        checkpoint_every=(
+            plan.checkpoint_every if cfg.checkpoint_dir else 0
+        ),
+        checkpoint_dir=cfg.checkpoint_dir,
+        telemetry_dir=cfg.telemetry_dir,
+        run_id=cfg.run_id,
+        guard_every=plan.guard_every if cfg.guard else 0,
+        guard_redundant=cfg.redundant,
+    )
+    _, boards = brt.run(cfg.iterations, resume=cfg.resume)
+    failures = brt.last_guard.failures if brt.last_guard else 0
+    return _Outcome([np.asarray(b) for b in boards], failures)
+
+
+def _run_3d(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
+    from gol_tpu import cli3d
+
+    outdir = os.path.join(workdir, "out3d")
+    os.makedirs(outdir, exist_ok=True)
+    argv = [
+        "2", str(plan.size3d), str(cfg.iterations), "64", "1",
+        "--outdir", outdir,
+    ]
+    if cfg.checkpoint_dir:
+        argv += [
+            "--checkpoint-every", str(plan.checkpoint_every),
+            "--checkpoint-dir", cfg.checkpoint_dir,
+        ]
+    if cfg.telemetry_dir:
+        argv += ["--telemetry", cfg.telemetry_dir]
+        if cfg.run_id:
+            argv += ["--run-id", cfg.run_id]
+    if cfg.guard:
+        argv += ["--guard-every", str(plan.guard_every)]
+        if cfg.redundant:
+            argv += ["--guard-redundant"]
+    if cfg.resume:
+        argv += ["--resume", cfg.resume]
+    import contextlib
+    import io
+
+    banner = io.StringIO()  # the driver's report lines, not the matrix's
+    with contextlib.redirect_stdout(banner):
+        rc = cli3d.main(argv)
+    if rc != 0:
+        raise RuntimeError(
+            f"cli3d exited {rc}: {banner.getvalue().strip()}"
+        )
+    out = np.load(os.path.join(outdir, "World3D_of_1.npy"))
+    # The in-process guard report is printed, not returned; the chaos
+    # detection signal for guarded 3-D cells rides the guard_audit
+    # telemetry records instead.
+    return _Outcome(out)
+
+
+def _run_cell(tier: str, mesh: str, plan: ChaosPlan, cfg: _RunCfg,
+              workdir: str) -> _Outcome:
+    if tier == "batch":
+        return _run_batch(mesh, plan, cfg)
+    if tier == "3d":
+        return _run_3d(plan, cfg, workdir)
+    engine = {"dense": "dense", "bitpack": "bitpack", "pallas": "pallas",
+              "activity": "activity"}[tier]
+    return _run_2d(engine, mesh, plan, cfg)
+
+
+def _legal(tier: str, mesh: str) -> Optional[str]:
+    """Static legality of a grid cell; a string is the skip reason."""
+    if tier == "pallas" and mesh != "none":
+        return "engine 'pallas' (dense kernel) has no sharded path"
+    if tier == "batch" and mesh == "2d":
+        return "--batch shards the world axis only (a 1-D ring)"
+    if tier == "3d" and mesh != "none":
+        return "the 3-D driver's mesh is its own (P,R,C) grid; the " \
+               "chaos matrix drives it unsharded"
+    return None
+
+
+def _equal(a, b) -> bool:
+    if isinstance(a, list):
+        return len(a) == len(b) and all(
+            np.array_equal(x, y) for x, y in zip(a, b)
+        )
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _events(telemetry_dir: str) -> List[dict]:
+    out = []
+    if not os.path.isdir(telemetry_dir):
+        return out
+    for name in sorted(os.listdir(telemetry_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(telemetry_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+    return out
+
+
+# -- scenario execution -------------------------------------------------------
+
+
+def _guard_failures(outcome: _Outcome, telemetry_dir: Optional[str]) -> int:
+    if outcome.guard_failures:
+        return outcome.guard_failures
+    if telemetry_dir:
+        return sum(
+            1
+            for r in _events(telemetry_dir)
+            if r.get("event") == "guard_audit" and not r.get("ok")
+        )
+    return 0
+
+
+def _run_scenario(
+    scenario: Scenario, tier: str, mesh: str, plan: ChaosPlan,
+    clean, workdir: str,
+) -> None:
+    """Execute one faulted cell; raise AssertionError on any miss."""
+    from gol_tpu.resilience import faults as faults_mod
+    from gol_tpu.utils import checkpoint as ckpt_mod
+
+    cell = tempfile.mkdtemp(prefix="cell_", dir=workdir)
+    ck = os.path.join(cell, "ck")
+    tm = os.path.join(cell, "tm")
+    fault_plan = faults_mod.FaultPlan.from_obj(list(scenario.faults))
+
+    def install():
+        faults_mod.install(fault_plan)
+
+    try:
+        if scenario.kind == "guard":
+            install()
+            out = _run_cell(
+                tier, mesh, plan,
+                _RunCfg(
+                    iterations=plan.iterations, guard=True,
+                    redundant=scenario.redundant, telemetry_dir=tm,
+                    run_id="chaos",
+                ),
+                cell,
+            )
+            failures = _guard_failures(out, tm)
+            assert failures >= 1, (
+                "the guard audit never failed — the injected corruption "
+                "was not detected"
+            )
+            assert _equal(out.final, clean), (
+                "rollback-replay did not recover the clean grid"
+            )
+        elif scenario.kind == "resume":
+            install()
+            out = _run_cell(
+                tier, mesh, plan,
+                _RunCfg(iterations=plan.iterations, checkpoint_dir=ck),
+                cell,
+            )
+            kind = {"batch": "batch", "3d": "3d"}.get(tier, "2d")
+            newest, skipped = ckpt_mod.latest_valid(ck, kind=kind)
+            assert skipped, (
+                "the resume walk skipped nothing — the on-disk snapshot "
+                "corruption was not detected"
+            )
+            assert newest is not None, "no valid snapshot survived"
+            gen = ckpt_mod.snapshot_generation(newest)
+            remaining = plan.iterations - gen
+            assert remaining > 0, (
+                f"nothing left to resume (valid snapshot at {gen})"
+            )
+            faults_mod.clear()
+            out2 = _run_cell(
+                tier, mesh, plan,
+                _RunCfg(iterations=remaining, resume=newest),
+                cell,
+            )
+            assert _equal(out2.final, clean), (
+                "resume past the corrupt snapshot did not recover the "
+                "clean grid"
+            )
+        elif scenario.kind in ("contain", "shed", "telemetry"):
+            install()
+            out = _run_cell(
+                tier, mesh, plan,
+                _RunCfg(
+                    iterations=plan.iterations,
+                    checkpoint_dir=(
+                        ck if scenario.kind != "telemetry" else None
+                    ),
+                    telemetry_dir=tm, run_id="chaos",
+                ),
+                cell,
+            )
+            assert _equal(out.final, clean), (
+                "the contained fault changed the computed grid"
+            )
+            recs = _events(tm)
+            if scenario.kind == "contain":
+                assert any(r.get("event") == "fault" for r in recs), (
+                    "no v9 fault record — the injection left no trace"
+                )
+                kind = {"batch": "batch", "3d": "3d"}.get(tier, "2d")
+                for path in ckpt_mod.list_snapshots(ck, kind=kind):
+                    ckpt_mod.verify_snapshot(path)
+            elif scenario.kind == "shed":
+                assert any(
+                    r.get("event") == "degraded"
+                    and r.get("action") == "shed"
+                    for r in recs
+                ), "no v9 degraded/shed record — the shed left no trace"
+            else:  # telemetry
+                assert any(
+                    r.get("event") == "degraded"
+                    and r.get("resource") == "telemetry"
+                    for r in recs
+                ), (
+                    "no degraded stamp — the telemetry write failure "
+                    "left no trace"
+                )
+        else:  # pragma: no cover - Scenario.__post_init__ rejects
+            raise AssertionError(f"unhandled kind {scenario.kind}")
+    finally:
+        faults_mod.clear()
+
+
+def run_matrix(
+    plan: ChaosPlan,
+    only_scenarios: Optional[Sequence[str]] = None,
+    out=None,
+) -> List[CellResult]:
+    """Execute the full grid; print one line per cell; return results."""
+    import sys
+
+    from gol_tpu.resilience import faults as faults_mod
+
+    out = out or sys.stdout
+    results: List[CellResult] = []
+    clean_cache: dict = {}
+    workdir = tempfile.mkdtemp(prefix="gol_chaos_")
+    for scenario in plan.scenarios:
+        if only_scenarios and scenario.name not in only_scenarios:
+            continue
+        for tier in plan.tiers:
+            for mesh in plan.meshes:
+                reason = _legal(tier, mesh)
+                if reason is None and scenario.tiers is not None \
+                        and tier not in scenario.tiers:
+                    reason = f"scenario restricted to {scenario.tiers}"
+                if reason is None and scenario.meshes is not None \
+                        and mesh not in scenario.meshes:
+                    reason = f"scenario restricted to {scenario.meshes}"
+                if reason is None and (tier, mesh) not in clean_cache:
+                    # Probe: the clean run decides environment-dependent
+                    # legality (Pallas off-TPU, geometry×mesh limits).
+                    faults_mod.clear()
+                    try:
+                        clean_cache[(tier, mesh)] = _run_cell(
+                            tier, mesh, plan,
+                            _RunCfg(iterations=plan.iterations), workdir,
+                        ).final
+                    except (ValueError, RuntimeError) as e:
+                        clean_cache[(tier, mesh)] = CellResult(
+                            "clean", tier, mesh, "skip", str(e)
+                        )
+                if reason is None:
+                    cached = clean_cache[(tier, mesh)]
+                    if isinstance(cached, CellResult):
+                        reason = cached.reason
+                if reason is not None:
+                    res = CellResult(
+                        scenario.name, tier, mesh, "skip", reason
+                    )
+                else:
+                    try:
+                        _run_scenario(
+                            scenario, tier, mesh, plan,
+                            clean_cache[(tier, mesh)], workdir,
+                        )
+                        res = CellResult(scenario.name, tier, mesh, "ok")
+                    except AssertionError as e:
+                        res = CellResult(
+                            scenario.name, tier, mesh, "fail", str(e)
+                        )
+                    except Exception as e:  # noqa: BLE001 — a cell crash is a FAIL, not a crash of the matrix
+                        res = CellResult(
+                            scenario.name, tier, mesh, "fail",
+                            f"{type(e).__name__}: {e}",
+                        )
+                results.append(res)
+                mark = {"ok": "OK  ", "skip": "SKIP", "fail": "FAIL"}[
+                    res.status
+                ]
+                line = f"  [{mark}] {res.label}"
+                if res.reason:
+                    line += f"  — {res.reason}"
+                print(line, file=out)
+    ok = sum(1 for r in results if r.status == "ok")
+    skip = sum(1 for r in results if r.status == "skip")
+    fail = sum(1 for r in results if r.status == "fail")
+    print(
+        f"chaos matrix: {ok} ok, {skip} skipped (visible above), "
+        f"{fail} failed",
+        file=out,
+    )
+    return results
+
+
+def main(argv=None) -> int:
+    """``python -m gol_tpu.resilience chaos`` entry (argv after 'chaos')."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="gol_tpu.resilience chaos",
+        description="execute the chaos matrix from a plan file "
+        "(docs/RESILIENCE.md)",
+    )
+    p.add_argument(
+        "--plan", default=DEFAULT_PLAN_PATH, metavar="FILE",
+        help="chaos plan JSON (default: the committed matrix)",
+    )
+    p.add_argument(
+        "--scenario", action="append", metavar="NAME",
+        help="restrict to named scenarios (repeatable)",
+    )
+    ns = p.parse_args(argv)
+
+    # Mesh cells need a virtual device ring on bare CPU hosts — must be
+    # set before the first backend touch (same move as the verifier).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    plan = ChaosPlan.load(ns.plan)
+    results = run_matrix(plan, only_scenarios=ns.scenario)
+    return 1 if any(r.status == "fail" for r in results) else 0

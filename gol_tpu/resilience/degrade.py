@@ -1,0 +1,154 @@
+"""Checkpoint-write containment: bounded retry, then shed — never die.
+
+The policy the fault plane (:mod:`gol_tpu.resilience.faults`) exists to
+exercise (docs/RESILIENCE.md "Retry and shed"):
+
+- **Transient IO errors** (EIO, a torn write, an NFS blip) get a
+  bounded retry with exponential backoff.  A snapshot that lands on
+  attempt 2 is a non-event for the run; the retries are recorded and
+  surface as a schema-v9 ``degraded`` telemetry event
+  (``action: "retried"``).
+- **Disk full** (ENOSPC) is not transient — retrying into a full disk
+  burns the run's time for nothing.  The shed order is fixed:
+  *telemetry before checkpoints* — the event stream is an observer, the
+  snapshots are the recovery path, so the stream is sacrificed first
+  (``EventLog.request_shed``) and the write retried once; if the disk
+  is still full, checkpointing itself is shed (the caller disables
+  further saves) and the run **continues to completion** — a computed
+  result with no snapshots beats no result.
+- Anything still failing after the retry budget re-raises, preserving
+  the CLIs' clean-exit contract for genuinely broken storage (unwritable
+  directory, permission errors).
+
+Decisions are recorded in a thread-safe ledger (`drain_reports`) because
+the write may run on the async snapshot writer's thread while telemetry
+emission must stay on the main loop's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as errno_mod
+import threading
+import time
+from typing import Callable, List, Optional
+
+_lock = threading.Lock()
+_reports: List[dict] = []
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry + backoff for checkpoint writes."""
+
+    retries: int = 3  # attempts AFTER the first try
+    backoff_base: float = 0.05  # seconds; doubles per retry
+    backoff_max: float = 2.0
+
+    def delay(self, attempt: int) -> float:
+        return min(self.backoff_base * (2 ** attempt), self.backoff_max)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def _record(report: dict) -> None:
+    with _lock:
+        _reports.append(report)
+
+
+def drain_reports() -> List[dict]:
+    """Containment decisions since the last drain — the run loops turn
+    them into schema-v9 ``degraded`` telemetry events."""
+    global _reports
+    with _lock:
+        out, _reports = _reports, []
+    return out
+
+
+def write_with_retry(
+    write: Callable[[], None],
+    what: str = "checkpoint",
+    generation: Optional[int] = None,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    shed_telemetry: Optional[Callable[[str], None]] = None,
+) -> bool:
+    """Run one snapshot ``write`` under the retry/shed policy.
+
+    Returns ``True`` when the write landed, ``False`` when it was shed
+    (persistent ENOSPC — the caller must stop attempting checkpoints).
+    Re-raises the last error when a non-ENOSPC failure survives the
+    retry budget.  ``shed_telemetry(reason)`` is the disk-full
+    first-sacrifice hook (``EventLog.request_shed`` bound by the run
+    loop); called at most once.
+    """
+    shed_done = False
+    enospc_seen = 0
+    attempt = 0
+    while True:
+        try:
+            write()
+            return True
+        except OSError as e:
+            if e.errno == errno_mod.ENOSPC:
+                enospc_seen += 1
+                if enospc_seen == 1:
+                    # A single ENOSPC may be transient (a neighbor's
+                    # file just got GC'd): retry once before
+                    # sacrificing anything.
+                    _record(
+                        dict(
+                            resource=what,
+                            action="retried",
+                            generation=generation,
+                            attempt=1,
+                            detail=str(e),
+                        )
+                    )
+                    continue
+                if shed_telemetry is not None and not shed_done:
+                    # Persistently full: telemetry before checkpoints —
+                    # drop the observer stream to relieve the disk,
+                    # then try the snapshot once more.
+                    shed_done = True
+                    shed_telemetry(f"disk full during {what} write: {e}")
+                    _record(
+                        dict(
+                            resource="telemetry",
+                            action="shed",
+                            generation=generation,
+                            detail=str(e),
+                        )
+                    )
+                    continue
+                # Still full: shed checkpointing, keep the run alive.
+                _record(
+                    dict(
+                        resource="checkpoint",
+                        action="shed",
+                        generation=generation,
+                        detail=str(e),
+                    )
+                )
+                import sys
+
+                print(
+                    f"gol: {what} shed: disk full and telemetry already "
+                    f"dropped ({e}); continuing WITHOUT further "
+                    "checkpoints",
+                    file=sys.stderr,
+                )
+                return False
+            if attempt >= policy.retries:
+                raise
+            time.sleep(policy.delay(attempt))
+            attempt += 1
+            _record(
+                dict(
+                    resource=what,
+                    action="retried",
+                    generation=generation,
+                    attempt=attempt,
+                    detail=str(e),
+                )
+            )

@@ -1,0 +1,493 @@
+"""The declarative fault-injection plane (docs/RESILIENCE.md).
+
+Every fault the framework claims to survive must be *fireable* — "a
+recovery path that has never fired is a recovery path that does not
+work" (utils/guard.py).  Before this module the injection hooks were
+ad-hoc and single-purpose: ``GOL_CKPT_TEST_WRITE_DELAY`` widened the
+tmp→rename window for the kill-9 drill, and the guard took a Python
+``fault_hook`` callable tests had to hand-build.  A :class:`FaultPlan`
+replaces them with one declarative surface spanning every layer:
+
+======================  =====================================================
+site                    what fires
+======================  =====================================================
+``board.bitflip``       corrupt one cell of the live board at a chosen
+                        generation/rank/world — ``value`` >= 0 writes that
+                        byte (out-of-range values are what the guard's 0/1
+                        invariant catches), ``value`` = -1 flips the cell
+                        in-range (0↔1: the SDC only the redundancy audit
+                        can see)
+``checkpoint.io_error``  transient ``OSError(EIO)`` on a snapshot write
+                        (``count`` times) — exercises the bounded
+                        retry+backoff in :mod:`gol_tpu.resilience.degrade`
+``checkpoint.torn_tmp``  the snapshot ``.tmp`` is written truncated and the
+                        write raises — the torn file must never become a
+                        resume candidate, and the retry must land a clean one
+``checkpoint.disk_full`` persistent ``OSError(ENOSPC)`` on snapshot writes —
+                        exercises the shed policy (telemetry first, then
+                        checkpoints; the run itself never dies)
+``checkpoint.rename_delay``  widen the tmp→rename window by ``delay_s``
+                        (the ``GOL_CKPT_TEST_WRITE_DELAY`` back-compat
+                        alias — the env var keeps working)
+``snapshot.bitflip``    flip one byte of the just-renamed snapshot file ON
+                        DISK — bit rot; the fingerprint verification of the
+                        resume walk must refuse it
+``telemetry.write_error``  ``OSError`` on the next rank-file write — the
+                        stream must degrade (warn once, drop, stamp
+                        ``degraded``), never kill the run
+``crash.exit``          ``os._exit`` at the first chunk boundary reaching
+                        ``at`` — the supervisor-child crash; armed only on
+                        restart attempt < ``attempts``, so the relaunch
+                        completes
+``rank.stall``          sleep ``delay_s`` at a chunk boundary on the chosen
+                        rank — the slow-rank hang
+======================  =====================================================
+
+Plans load from JSON — ``--fault-plan PATH`` on both CLIs, or the
+``GOL_FAULT_PLAN`` environment variable holding a path *or* inline JSON
+(the supervisor's children inherit it, which is how the chaos drills arm
+relaunches).  Everything here is host-side: with no plan installed every
+hook is one ``None`` check, and the compiled chunk programs are
+byte-identical either way (the trace-identity pin in
+tests/test_faults.py).  Fired injections are recorded in a ledger the
+run loops drain into schema-v9 ``fault`` telemetry events.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as errno_mod
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+SITES = (
+    "board.bitflip",
+    "checkpoint.io_error",
+    "checkpoint.torn_tmp",
+    "checkpoint.disk_full",
+    "checkpoint.rename_delay",
+    "snapshot.bitflip",
+    "telemetry.write_error",
+    "crash.exit",
+    "rank.stall",
+)
+
+#: The documented back-compat alias for a
+#: ``{"site": "checkpoint.rename_delay", "delay_s": S}`` plan entry.
+RENAME_DELAY_ENV = "GOL_CKPT_TEST_WRITE_DELAY"
+PLAN_ENV = "GOL_FAULT_PLAN"
+
+
+class FaultPlanError(ValueError):
+    """A fault plan fails to parse or names an unknown site/field."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed injection.  Fields beyond ``site`` select where/when:
+
+    - ``at``: the generation at (or after) which the spec arms; sites
+      with no generation context (telemetry writes) ignore it.
+    - ``count``: how many times the spec fires (-1 = unlimited).
+    - ``rank``: the ``jax.process_index`` that injects (-1 = every rank).
+    - ``attempts``: arm only while ``GOL_RESTART_ATTEMPT`` < attempts
+      (-1 = every supervised relaunch; the default 1 arms the first
+      attempt only, so a crash spec cannot re-kill its own recovery).
+    - ``world``: the batch world a ``board.bitflip`` targets (0 for
+      single-world runs); ``plane``/``row``/``col`` the cell; ``value``
+      the byte to write (-1 = in-range 0↔1 flip).
+    - ``delay_s``: seconds for ``rank.stall`` / ``checkpoint.rename_delay``.
+    """
+
+    site: str
+    at: int = 0
+    count: int = 1
+    rank: int = -1
+    attempts: int = 1
+    world: int = 0
+    plane: int = 0
+    row: int = 0
+    col: int = 0
+    value: int = -1
+    delay_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.site not in SITES:
+            raise FaultPlanError(
+                f"unknown fault site {self.site!r}; expected one of {SITES}"
+            )
+        if self.count == 0 or self.count < -1:
+            raise FaultPlanError(
+                f"{self.site}: count must be positive or -1 (unlimited), "
+                f"got {self.count}"
+            )
+        if self.delay_s < 0:
+            raise FaultPlanError(
+                f"{self.site}: delay_s must be >= 0, got {self.delay_s}"
+            )
+
+    @classmethod
+    def from_dict(cls, obj: dict) -> "FaultSpec":
+        if not isinstance(obj, dict) or "site" not in obj:
+            raise FaultPlanError(
+                f"fault entry must be an object with a 'site', got {obj!r}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        extra = set(obj) - known
+        if extra:
+            raise FaultPlanError(
+                f"{obj.get('site')}: unknown fault fields {sorted(extra)} "
+                f"(known: {sorted(known)})"
+            )
+        return cls(**obj)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """An ordered list of :class:`FaultSpec` entries.
+
+    JSON form: either a bare list of entries or ``{"faults": [...]}``
+    (the object form leaves room for chaos-matrix metadata next to the
+    entries — :mod:`gol_tpu.resilience.chaos` uses it).
+    """
+
+    faults: List[FaultSpec] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_obj(cls, obj) -> "FaultPlan":
+        if isinstance(obj, dict):
+            obj = obj.get("faults", [])
+        if not isinstance(obj, list):
+            raise FaultPlanError(
+                "a fault plan is a list of entries or {'faults': [...]}, "
+                f"got {type(obj).__name__}"
+            )
+        return cls(faults=[FaultSpec.from_dict(e) for e in obj])
+
+    @classmethod
+    def loads(cls, text: str) -> "FaultPlan":
+        try:
+            return cls.from_obj(json.loads(text))
+        except json.JSONDecodeError as e:
+            raise FaultPlanError(f"fault plan is not valid JSON: {e}") from e
+
+    @classmethod
+    def load(cls, path_or_json: str) -> "FaultPlan":
+        """A path to a JSON file, or inline JSON (starts with '[' / '{')."""
+        text = path_or_json.strip()
+        if text.startswith("[") or text.startswith("{"):
+            return cls.loads(text)
+        try:
+            with open(path_or_json) as f:
+                return cls.loads(f.read())
+        except OSError as e:
+            raise FaultPlanError(
+                f"cannot read fault plan {path_or_json!r}: {e}"
+            ) from e
+
+    def to_json(self) -> str:
+        return json.dumps({"faults": [s.to_dict() for s in self.faults]})
+
+
+# -- the active plane --------------------------------------------------------
+#
+# One plan per process.  Mutable fire-count state lives in _remaining
+# (parallel to the plan's specs), the fired ledger in _fired; all three
+# behind one lock because checkpoint faults fire on the async writer
+# thread while board faults fire on the main loop.
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_remaining: List[int] = []
+_fired: List[dict] = []
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Arm ``plan`` for this process (None = clear).  Resets fire counts
+    and the fired ledger, and (un)hooks the telemetry write site."""
+    global _plan, _remaining, _fired, _telemetry_writes
+    from gol_tpu import telemetry as telemetry_mod
+
+    with _lock:
+        _plan = plan
+        _remaining = [] if plan is None else [s.count for s in plan.faults]
+        _fired = []
+        _telemetry_writes = 0
+    telemetry_mod._telemetry_write_hook = (
+        _telemetry_hook
+        if plan is not None
+        and any(s.site == "telemetry.write_error" for s in plan.faults)
+        else None
+    )
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    return _plan
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    """Install the plan named by ``GOL_FAULT_PLAN`` (path or inline
+    JSON), if set.  Both CLIs call this at startup, so supervised
+    children inherit the plan through the environment."""
+    text = os.environ.get(PLAN_ENV)
+    if not text:
+        return None
+    plan = FaultPlan.load(text)
+    install(plan)
+    return plan
+
+
+def drain_fired() -> List[dict]:
+    """Fired-injection records accumulated since the last drain — the
+    run loops turn them into schema-v9 ``fault`` telemetry events."""
+    global _fired
+    with _lock:
+        out, _fired = _fired, []
+    return out
+
+
+def _restart_attempt() -> int:
+    try:
+        return int(os.environ.get("GOL_RESTART_ATTEMPT", "0"))
+    except ValueError:
+        return 0
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax not initialized
+        return 0
+
+
+def _matching(site: str, generation: Optional[int]):
+    """Indices of armed specs for ``site`` at ``generation`` (no consume)."""
+    if _plan is None:
+        return []
+    out = []
+    for i, spec in enumerate(_plan.faults):
+        if spec.site != site or _remaining[i] == 0:
+            continue
+        if generation is not None and generation < spec.at:
+            continue
+        if spec.rank >= 0 and spec.rank != _process_index():
+            continue
+        if spec.attempts >= 0 and _restart_attempt() >= spec.attempts:
+            continue
+        out.append(i)
+    return out
+
+
+def _consume(i: int, generation: Optional[int], **detail) -> FaultSpec:
+    spec = _plan.faults[i]
+    if _remaining[i] > 0:
+        _remaining[i] -= 1
+    _fired.append(
+        dict(site=spec.site, generation=generation, **detail)
+    )
+    return spec
+
+
+def fire(site: str, generation: Optional[int] = None, **detail):
+    """Consume the first armed spec for ``site``, or return ``None``."""
+    with _lock:
+        hits = _matching(site, generation)
+        if not hits:
+            return None
+        return _consume(hits[0], generation, **detail)
+
+
+# -- site: checkpoint writes -------------------------------------------------
+
+
+def rename_gap() -> None:
+    """The tmp→rename window hook (``checkpoint.rename_delay``).
+
+    Honors both plan entries and the documented legacy alias
+    ``GOL_CKPT_TEST_WRITE_DELAY`` (seconds), so pre-plan drills keep
+    working unchanged.
+    """
+    delay = 0.0
+    spec = fire("checkpoint.rename_delay")
+    if spec is not None:
+        delay = spec.delay_s
+    env = os.environ.get(RENAME_DELAY_ENV)
+    if env:
+        try:
+            delay = max(delay, float(env))
+        except ValueError:
+            pass
+    if delay > 0:
+        time.sleep(delay)
+
+
+def checkpoint_write_fault(tmp_path: str, generation: Optional[int]) -> None:
+    """Fire any armed checkpoint-write fault for this snapshot.
+
+    Called by every snapshot writer immediately before the ``.tmp``
+    write.  ``torn_tmp`` additionally leaves a truncated garbage tmp on
+    disk — the artifact a mid-write crash produces — which must stay
+    invisible to the resume walk.  Raises ``OSError`` (EIO or ENOSPC);
+    the containment layer (:mod:`gol_tpu.resilience.degrade`) decides
+    whether that means retry, shed, or surface.
+    """
+    spec = fire("checkpoint.torn_tmp", generation, path=tmp_path)
+    if spec is not None:
+        with open(tmp_path, "wb") as f:
+            f.write(b"PK\x03\x04torn")  # a zip header, then nothing
+        raise OSError(
+            errno_mod.EIO, f"injected torn checkpoint write: {tmp_path}"
+        )
+    spec = fire("checkpoint.io_error", generation, path=tmp_path)
+    if spec is not None:
+        raise OSError(
+            errno_mod.EIO, f"injected transient checkpoint IO error: {tmp_path}"
+        )
+    spec = fire("checkpoint.disk_full", generation, path=tmp_path)
+    if spec is not None:
+        raise OSError(
+            errno_mod.ENOSPC, f"injected disk-full checkpoint write: {tmp_path}"
+        )
+
+
+def corrupt_snapshot_file(path: str, generation: Optional[int]) -> None:
+    """``snapshot.bitflip``: flip one byte of the just-renamed snapshot
+    ON DISK (bit rot).  A corrupted archive member or zip structure —
+    either way the fingerprint/readability verification of the resume
+    walk must refuse the file."""
+    spec = fire("snapshot.bitflip", generation, path=path)
+    if spec is None:
+        return
+    size = os.path.getsize(path)
+    if size == 0:  # pragma: no cover - snapshots are never empty
+        return
+    # Land in the member data, not the zip end-of-central-directory —
+    # the flip should read as a corrupt *snapshot*, deterministically.
+    offset = min(max(size // 2, 1), size - 1)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+# -- site: telemetry writes --------------------------------------------------
+
+# Telemetry writes have no generation context, so the site's ``at``
+# counts RECORDS written by this process instead (0 = the first write,
+# the run_header) — a spec with ``at: 5`` lets five records land and
+# fails the sixth.
+_telemetry_writes = 0
+
+
+def _telemetry_hook() -> None:
+    global _telemetry_writes
+    n = _telemetry_writes
+    _telemetry_writes += 1
+    spec = fire("telemetry.write_error", generation=n)
+    if spec is not None:
+        raise OSError(
+            errno_mod.EIO
+            if spec.value < 0
+            else spec.value,
+            "injected telemetry rank-file write error",
+        )
+
+
+# -- site: the live board ----------------------------------------------------
+
+
+def has_board_faults() -> bool:
+    """Whether any ``board.bitflip`` spec is still armed — loops check
+    this once per chunk so the no-plan path never imports jax here."""
+    with _lock:
+        if _plan is None:
+            return False
+        return any(
+            s.site == "board.bitflip" and _remaining[i] != 0
+            for i, s in enumerate(_plan.faults)
+        )
+
+
+def _flip_cell(board, idx, value: int):
+    import jax.numpy as jnp
+
+    if value >= 0:
+        return board.at[idx].set(jnp.uint8(value))
+    # In-range flip (0↔1): the silent corruption the 0/1 invariant
+    # passes and only the redundancy audit can catch.
+    return board.at[idx].set(jnp.uint8(1) - board[idx])
+
+
+def apply_board_faults(board, generation: int, world_ids=None):
+    """Apply every armed ``board.bitflip`` due at ``generation``.
+
+    ``board`` is the dense uint8 state every chunk boundary holds: a 2-D
+    grid, a 3-D volume, or — with ``world_ids`` (the bucket's world
+    indices) — a batched ``[B, H, W]`` stack, where each spec's
+    ``world`` selects the stack slot (specs whose world lives in another
+    bucket are left armed for it).  Functional cell updates, outside the
+    chunk programs — the evolver jaxprs never see the plane.
+    """
+    with _lock:
+        hits = _matching("board.bitflip", generation)
+        todo = []
+        for i in hits:
+            spec = _plan.faults[i]
+            if world_ids is not None:
+                if spec.world not in world_ids:
+                    continue
+                idx = (world_ids.index(spec.world), spec.row, spec.col)
+                detail = dict(world=spec.world, row=spec.row, col=spec.col)
+            elif getattr(board, "ndim", 2) == 3:
+                idx = (spec.plane, spec.row, spec.col)
+                detail = dict(plane=spec.plane, row=spec.row, col=spec.col)
+            else:
+                idx = (spec.row, spec.col)
+                detail = dict(row=spec.row, col=spec.col)
+            detail["value"] = spec.value
+            _consume(i, generation, **detail)
+            todo.append((idx, spec.value))
+    for idx, value in todo:
+        board = _flip_cell(board, idx, value)
+    return board
+
+
+def board_fault_hook():
+    """A guard-style ``fault_hook(board, generation) -> board`` over the
+    plan's ``board.bitflip`` entries, or ``None`` when none are armed —
+    what :func:`gol_tpu.utils.guard.guarded_loop` composes with any
+    caller-provided hook."""
+    if not has_board_faults():
+        return None
+    return apply_board_faults
+
+
+# -- site: the process -------------------------------------------------------
+
+
+def crash_or_stall(generation: int) -> None:
+    """Chunk-boundary process faults: ``rank.stall`` sleeps ``delay_s``
+    (recorded, so telemetry shows the stall), ``crash.exit`` dies on the
+    spot via ``os._exit`` — no flushes, no atexit: the closest
+    in-process stand-in for a machine loss, and exactly what the
+    supervisor's restart budget exists for."""
+    spec = fire("rank.stall", generation)
+    if spec is not None and spec.delay_s > 0:
+        time.sleep(spec.delay_s)
+    spec = fire("crash.exit", generation)
+    if spec is not None:
+        code = spec.value if spec.value >= 0 else 1
+        os._exit(code)

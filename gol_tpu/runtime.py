@@ -384,6 +384,14 @@ class GolRuntime:
         # loops are live (single-process runs only — see
         # checkpoint.AsyncSnapshotWriter).
         self._ckpt_writer = None
+        # Checkpoint containment (docs/RESILIENCE.md "Retry and shed"):
+        # once a snapshot write hits persistent ENOSPC past the retry
+        # budget, checkpointing is shed for the rest of the run (the run
+        # itself never dies for an observer/persistence failure).
+        # _live_events is the run's EventLog while a loop is live — the
+        # shed policy's telemetry-first sacrifice goes through it.
+        self._ckpt_shed = False
+        self._live_events = None
         # The snapshot this run resumed from — protected from retention
         # GC for the whole run (a rollback may still need it).
         self._resume_source: Optional[str] = None
@@ -879,7 +887,16 @@ class GolRuntime:
         jobs write the sharded format (each process its own pieces) and
         fence with a global barrier so no host races into the next chunk
         while files are mid-write.
+
+        Writes run under the containment policy
+        (:func:`gol_tpu.resilience.degrade.write_with_retry`): transient
+        IO errors get bounded retry+backoff; persistent disk-full sheds
+        telemetry first, then checkpointing itself — never the run.
         """
+        from gol_tpu.resilience import degrade as degrade_mod
+
+        if self._ckpt_shed:
+            return
         top0, bottom0 = self._halos if self._halos is not None else (None, None)
         multi = jax.process_count() > 1
         rule = None if self._rule is None else self._rule.rulestring()
@@ -893,22 +910,31 @@ class GolRuntime:
             # resume on another topology can name the reshard it does.
             from gol_tpu.resilience import reshard as reshard_mod
 
-            ckpt_mod.save_sharded(
-                ckpt_mod.sharded_checkpoint_path(
-                    self.checkpoint_dir, int(state.generation)
+            ok = degrade_mod.write_with_retry(
+                lambda: ckpt_mod.save_sharded(
+                    ckpt_mod.sharded_checkpoint_path(
+                        self.checkpoint_dir, int(state.generation)
+                    ),
+                    state.board,
+                    int(state.generation),
+                    self.geometry.num_ranks,
+                    rule=rule,
+                    fingerprint=fingerprint,
+                    mesh_layout=reshard_mod.MeshLayout.from_mesh(
+                        self.mesh
+                    ).to_dict(),
                 ),
-                state.board,
-                int(state.generation),
-                self.geometry.num_ranks,
-                rule=rule,
-                fingerprint=fingerprint,
-                mesh_layout=reshard_mod.MeshLayout.from_mesh(
-                    self.mesh
-                ).to_dict(),
+                generation=int(state.generation),
+                shed_telemetry=self._shed_telemetry,
             )
             from jax.experimental import multihost_utils
 
+            # The barrier runs even on a shed write: a rank that stopped
+            # persisting must not strand its peers in the fence.
             multihost_utils.sync_global_devices("gol_checkpoint")
+            if not ok:
+                self._ckpt_shed = True
+                return
             # Retention: after the barrier (every host's pieces are
             # durably renamed) exactly one process sweeps old snapshots.
             if self.keep_snapshots > 0 and jax.process_index() == 0:
@@ -943,7 +969,16 @@ class GolRuntime:
         board_np = np.asarray(state.board)
 
         def write():
-            ckpt_mod.save(path, board_np, generation, ranks, **kwargs)
+            ok = degrade_mod.write_with_retry(
+                lambda: ckpt_mod.save(
+                    path, board_np, generation, ranks, **kwargs
+                ),
+                generation=generation,
+                shed_telemetry=self._shed_telemetry,
+            )
+            if not ok:
+                self._ckpt_shed = True
+                return
             if self.keep_snapshots > 0:
                 # GC rides the same thread as the save (the writer's, or
                 # this one) so it always runs after the rename it follows
@@ -961,6 +996,13 @@ class GolRuntime:
             self._ckpt_writer.submit(write)
         else:
             write()
+
+    def _shed_telemetry(self, reason: str) -> None:
+        """The disk-full first sacrifice: ask the live event stream to
+        shed (thread-safe; the stamp happens on the emitting thread)."""
+        events = self._live_events
+        if events is not None:
+            events.request_shed("telemetry", reason)
 
     def _preempt(
         self,
@@ -1289,10 +1331,14 @@ class GolRuntime:
         import time as time_mod
 
         from gol_tpu import telemetry as telemetry_mod
+        from gol_tpu.resilience import degrade as degrade_mod
+        from gol_tpu.resilience import faults as faults_mod
 
+        plan_on = faults_mod.active() is not None
         sw = Stopwatch()
         self.last_stats = []
         self.last_activity = []
+        self._ckpt_shed = False
         with sw.phase("init"):
             state = self.initial_state(pattern, resume)
             board = state.board
@@ -1312,11 +1358,20 @@ class GolRuntime:
             board = mesh_mod.shard_board(board, self.mesh)
 
         events = self.open_event_log()
+        self._live_events = events
         # Span attribution (schema v6): host-phase seconds between
         # force_ready fences, emitted as the `spans` block on each chunk
         # event.  Telemetry-off runs never construct the clock, so the
         # off path stays byte-for-byte the old one.
         sc = telemetry_mod.SpanClock() if events is not None else None
+
+        def _drain_plane():
+            if events is None:
+                return
+            for f in faults_mod.drain_fired():
+                events.fault_event(**f)
+            for d in degrade_mod.drain_reports():
+                events.degraded_event(**d)
         try:
             with sw.phase("compile"):
                 evolvers = self.compile_evolvers(board, schedule, events)
@@ -1367,6 +1422,15 @@ class GolRuntime:
                             # fence.  Together they partition wall_s.
                             sc.add("dispatch", t1 - t0)
                             sc.add("ready", dt - (t1 - t0))
+                        if plan_on:
+                            # Fault-plane SDC injection (board.bitflip):
+                            # a host-side functional cell update between
+                            # chunk programs — the un-audited path takes
+                            # the corruption silently, which is exactly
+                            # what the guard-coverage matrix proves.
+                            board = faults_mod.apply_board_faults(
+                                board, int(state.generation) + take
+                            )
                         state = GolState.create(
                             board, int(state.generation) + take
                         )
@@ -1432,7 +1496,7 @@ class GolRuntime:
                                     events.stats_event(
                                         i, take, int(state.generation), vals
                                     )
-                        if self.checkpoint_every > 0:
+                        if self.checkpoint_every > 0 and not self._ckpt_shed:
                             with telemetry_mod.trace_annotation(
                                 "gol.checkpoint.save"
                             ):
@@ -1450,6 +1514,11 @@ class GolRuntime:
                                         int(state.board.size),
                                         overlapped=writer is not None,
                                     )
+                        if plan_on:
+                            faults_mod.crash_or_stall(
+                                int(state.generation)
+                            )
+                        _drain_plane()
                         if i < len(schedule) - 1:
                             # Chunk-boundary preemption poll: host-side
                             # only (the compiled programs never see it).
@@ -1495,10 +1564,14 @@ class GolRuntime:
                 if writer is not None:
                     writer.close()
 
+            # Writer-thread faults fired during the final flush surface
+            # before the stream closes.
+            _drain_plane()
             report = sw.report(self.geometry.cell_updates(iterations))
             if events is not None:
                 events.summary(report)
         finally:
+            self._live_events = None
             if events is not None:
                 events.close()
         return report, state

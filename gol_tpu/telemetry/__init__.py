@@ -55,7 +55,17 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 8 (this round) adds the halo-exchange chunk block
+# Version 9 (this round) adds the fault-plane events
+# (docs/RESILIENCE.md): a ``fault`` record marks one fired injection of
+# the declarative fault plan (``--fault-plan`` / ``GOL_FAULT_PLAN``,
+# :mod:`gol_tpu.resilience.faults`) — the site name, the generation it
+# fired at (null for sites with no generation context), and the spec
+# detail — and a ``degraded`` record marks a containment decision
+# (:mod:`gol_tpu.resilience.degrade`): a checkpoint write that needed
+# retries, a disk-full run shedding telemetry before checkpoints, or a
+# telemetry stream that dropped events after a write failure instead of
+# killing the run.
+# Version 8 added the halo-exchange chunk block
 # (docs/OBSERVABILITY.md): ``chunk`` events of a sharded ring-engine run
 # carry a ``halo`` block — ``{depth, mode, exchanges, band_bytes,
 # exchange_share}`` — the exchange depth/mode the chunk program actually
@@ -90,11 +100,11 @@ from typing import Dict, Optional
 # resilience events — ``preempt``, ``resume``, ``restart``
 # (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
-# readable: every v1-v7 event type and field survives unchanged, so
+# readable: every v1-v8 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5/v6/v7 fixture tests).
-SCHEMA_VERSION = 8
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8)
+# committed v1/v2/v3/v4/v5/v6/v7/v8 fixture tests).
+SCHEMA_VERSION = 9
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -148,11 +158,25 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     "reshard": frozenset(
         {"generation", "src_mesh", "dst_mesh", "bytes_moved"}
     ),
+    # v9: one fired injection of the declarative fault plan
+    # (gol_tpu/resilience/faults.py).  ``generation`` is null for sites
+    # with no generation context (e.g. a telemetry write fault).
+    "fault": frozenset({"site", "generation"}),
+    # v9: a containment decision fired (gol_tpu/resilience/degrade.py):
+    # ``resource`` names what degraded (checkpoint / telemetry),
+    # ``action`` what was done about it (retried / shed / dropped).
+    "degraded": frozenset({"resource", "action"}),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
     ),
 }
+
+# Injection hook for the fault plane (gol_tpu/resilience/faults.py
+# installs/clears it): called before every rank-file write, may raise
+# ``OSError`` to simulate a failing telemetry disk.  ``None`` (no plan
+# active) costs one attribute check per record.
+_telemetry_write_hook = None
 
 
 class SchemaError(ValueError):
@@ -236,13 +260,79 @@ class EventLog:
         self._f = open(self.path, "w")
         self.observer = None
         self.metrics_server = None
+        # IO containment (docs/RESILIENCE.md): a failing rank-file write
+        # must never kill the run — telemetry is an observer, not a
+        # participant.  After the first write failure (real ENOSPC/EIO or
+        # an injected ``telemetry.write_error`` fault) the stream warns
+        # once on stderr, stamps a best-effort ``degraded`` record, and
+        # sheds: subsequent records are dropped from the file but still
+        # reach ``observer`` (the live metrics endpoint stays truthful).
+        # ``degraded`` records the shed decision for the caller/tests.
+        self.degraded: Optional[dict] = None
+        self._shed = False
+        # Thread-safe shed request (the disk-full checkpoint policy runs
+        # on the async writer thread; file writes stay on this one).
+        self._pending_shed: Optional[tuple] = None
 
     # -- envelope -----------------------------------------------------------
     def emit(self, event: str, **fields) -> None:
         rec = {"event": event, "t": time.time(), **fields}
         validate_record(rec)
-        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
-        self._f.flush()
+        self._write_contained(rec)
+        if self.observer is not None:
+            self.observer(rec)
+
+    def _write_contained(self, rec: dict) -> None:
+        if self._pending_shed is not None:
+            resource, reason = self._pending_shed
+            self._pending_shed = None
+            self._stamp_degraded(resource, "shed", reason)
+        if self._shed:
+            return
+        try:
+            if _telemetry_write_hook is not None:
+                _telemetry_write_hook()
+            self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._f.flush()
+        # ValueError covers a file handle that died under us ("I/O
+        # operation on closed file") — same containment as a disk error.
+        except (OSError, ValueError) as e:
+            import sys
+
+            print(
+                f"gol: telemetry degraded: rank-file write failed ({e}); "
+                "dropping further events (the run continues)",
+                file=sys.stderr,
+            )
+            self._stamp_degraded("telemetry", "dropped", str(e))
+
+    def request_shed(self, resource: str, reason: str) -> None:
+        """Ask the stream to shed (stop file writes) at the next emit —
+        callable from any thread; the degraded stamp and the shed itself
+        happen on the emitting thread (file writes are single-threaded).
+        The disk-full checkpoint policy uses this: telemetry is the
+        first thing sacrificed when the disk fills."""
+        if not self._shed and self._pending_shed is None:
+            self._pending_shed = (resource, reason)
+
+    def _stamp_degraded(self, resource: str, action: str, detail: str) -> None:
+        """Best-effort final ``degraded`` record, then shed.  The stamp
+        itself may fail (the disk that broke the stream is still broken)
+        — then it survives only in :attr:`degraded` and the observer."""
+        rec = {
+            "event": "degraded",
+            "t": time.time(),
+            "resource": resource,
+            "action": action,
+            "detail": detail,
+        }
+        self.degraded = rec
+        self._shed = True
+        try:
+            self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._f.flush()
+        except (OSError, ValueError):
+            pass
         if self.observer is not None:
             self.observer(rec)
 
@@ -320,8 +410,9 @@ class EventLog:
             **extra,
         )
 
-    def guard_event(self, audit) -> None:
-        """One :class:`gol_tpu.utils.guard.Audit`'s scalars."""
+    def guard_event(self, audit, **extra) -> None:
+        """One :class:`gol_tpu.utils.guard.Audit`'s scalars.  ``extra``
+        labels batched audits (``world``/``bucket``, schema v9)."""
         self.emit(
             "guard_audit",
             generation=audit.generation,
@@ -330,6 +421,7 @@ class EventLog:
             population=audit.population,
             fingerprint=audit.fingerprint,
             redundant_fingerprint=audit.redundant_fingerprint,
+            **extra,
         )
 
     def checkpoint_event(
@@ -400,6 +492,21 @@ class EventLog:
             bytes_moved=bytes_moved,
             **extra,
         )
+
+    def fault_event(
+        self, site: str, generation: Optional[int], **extra
+    ) -> None:
+        """One fired fault-plan injection (v9).  ``extra`` carries the
+        spec detail the plane recorded (row/col/value/world/path...)."""
+        self.emit("fault", site=site, generation=generation, **extra)
+
+    def degraded_event(
+        self, resource: str, action: str, **extra
+    ) -> None:
+        """One containment decision (v9): ``resource`` checkpoint/
+        telemetry, ``action`` retried/shed/dropped; ``extra`` carries
+        generation/errno/attempt detail."""
+        self.emit("degraded", resource=resource, action=action, **extra)
 
     def stats_event(
         self, index: int, take: int, generation: int, values: dict

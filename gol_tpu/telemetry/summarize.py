@@ -230,6 +230,20 @@ def find_anomalies(run: Run) -> List[str]:
                 "another rank forced an earlier generation"
             )
 
+    # Containment watchdog (schema v9): a degraded run finished, but
+    # something was sacrificed to get there — retried checkpoint
+    # writes, a shed telemetry stream, shed checkpointing.
+    for r in run.records("degraded"):
+        flags.append(
+            f"degraded: {r['resource']} {r['action']}"
+            + (
+                f" at generation {r['generation']}"
+                if r.get("generation") is not None
+                else ""
+            )
+            + (f" — {r['detail']}" if r.get("detail") else "")
+        )
+
     # Per-chunk walls must account for the summary's total phase.
     summ = run.summary_record
     if summ is not None and chunks:
@@ -603,6 +617,20 @@ def render_run(run: Run, out) -> None:
                 else ""
             )
             + ("  [legacy manifest]" if r.get("legacy_manifest") else ""),
+            file=out,
+        )
+
+    faults_fired = run.records("fault", rank=rank0)
+    if faults_fired:
+        sites: Dict[str, int] = {}
+        for r in faults_fired:
+            sites[r["site"]] = sites.get(r["site"], 0) + 1
+        detail = ", ".join(
+            f"{site}×{n}" for site, n in sorted(sites.items())
+        )
+        print(
+            f"  faults: {len(faults_fired)} injection(s) fired "
+            f"({detail}) — fault plan active (docs/RESILIENCE.md)",
             file=out,
         )
 

@@ -43,21 +43,67 @@ class CorruptSnapshotError(ValueError):
     """The snapshot's stored fingerprint does not match its board."""
 
 
+def _archive_errors() -> tuple:
+    """Every exception a flipped byte can surface while READING an npz.
+
+    A single corrupted byte can land in zip structure
+    (``BadZipFile``/``struct.error``), a compressed stream
+    (``zlib.error``), a member header (numpy's header parse raises
+    ``ValueError``/``SyntaxError``/``tokenize.TokenError`` — the chaos
+    matrix's ``snapshot.bitflip`` site found the latter two for real),
+    or truncate the payload (``EOFError``/``KeyError``).  All of them
+    mean "this snapshot is corrupt", never a traceback.
+    """
+    import struct
+    import tokenize
+    import zipfile
+    import zlib
+
+    return (
+        zipfile.BadZipFile,
+        zlib.error,
+        struct.error,
+        tokenize.TokenError,
+        SyntaxError,
+        KeyError,
+        ValueError,
+        EOFError,
+    )
+
+
 def _tmp_rename_gap() -> None:
     """Chaos-drill hook: widen the window between the ``.tmp`` write and
     the atomic rename.
 
     The kill-9 drill (tests/test_resilience_drill.py) must land SIGKILL
     *inside* a checkpoint write to prove a torn ``.tmp`` file is never
-    resumed from; real writes close that window in microseconds, so the
-    drill sets ``GOL_CKPT_TEST_WRITE_DELAY`` (seconds) to hold it open.
-    Unset (production), this is a no-op.
+    resumed from; real writes close that window in microseconds.  Now a
+    site of the declarative fault plane
+    (``{"site": "checkpoint.rename_delay", "delay_s": S}``,
+    :mod:`gol_tpu.resilience.faults`); the original
+    ``GOL_CKPT_TEST_WRITE_DELAY`` env var keeps working as a documented
+    alias.  With neither set (production), this is a no-op.
     """
-    delay = os.environ.get("GOL_CKPT_TEST_WRITE_DELAY")
-    if delay:
-        import time
+    from gol_tpu.resilience import faults
 
-        time.sleep(float(delay))
+    faults.rename_gap()
+
+
+def _write_fault(tmp: str, generation) -> None:
+    """Fault-plane site for the snapshot ``.tmp`` write (io_error /
+    torn_tmp / disk_full; no-op without an armed plan)."""
+    from gol_tpu.resilience import faults
+
+    if faults.active() is not None:
+        faults.checkpoint_write_fault(tmp, int(generation))
+
+
+def _post_rename_fault(path: str, generation) -> None:
+    """Fault-plane site for on-disk rot of a just-renamed snapshot."""
+    from gol_tpu.resilience import faults
+
+    if faults.active() is not None:
+        faults.corrupt_snapshot_file(path, int(generation))
 
 
 class AsyncSnapshotWriter:
@@ -223,9 +269,11 @@ def save(
             fingerprint_np(_halo_plane(arrays["top0"], arrays["bottom0"]))
         )
     tmp = path + ".tmp.npz"
+    _write_fault(tmp, generation)
     np.savez_compressed(tmp, **arrays)
     _tmp_rename_gap()
     os.replace(tmp, path)
+    _post_rename_fault(path, generation)
     return path
 
 
@@ -237,12 +285,9 @@ def load(path: str) -> Snapshot:
     :class:`CorruptSnapshotError` like a bad fingerprint does — the
     auto-resume walk treats every malformation as "skip this candidate".
     """
-    import zipfile
-    import zlib
-
     try:
         data = np.load(path)
-    except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+    except _archive_errors() as e:
         raise CorruptSnapshotError(
             f"{path}: not a readable snapshot archive ({e})"
         ) from e
@@ -251,9 +296,7 @@ def load(path: str) -> Snapshot:
             return _read_snapshot(path, data)
         except CorruptSnapshotError:
             raise
-        except (
-            zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
-        ) as e:
+        except _archive_errors() as e:
             # A flipped byte can land in zip structure, a compressed
             # stream, or a member header — all of them are "this snapshot
             # is corrupt", never a traceback.
@@ -344,9 +387,11 @@ def save_batch(
     for i, b in enumerate(boards):
         arrays[f"world_{i:05d}"] = b
     tmp = path + ".tmp.npz"
+    _write_fault(tmp, generation)
     np.savez_compressed(tmp, **arrays)
     _tmp_rename_gap()
     os.replace(tmp, path)
+    _post_rename_fault(path, generation)
     return path
 
 
@@ -358,14 +403,11 @@ def load_batch(path: str) -> BatchSnapshot:
     auto-resume walk (``kind='batch'``) falls back past it exactly as it
     does for the single-world formats.
     """
-    import zipfile
-    import zlib
-
     from gol_tpu.utils.guard import fingerprint_np
 
     try:
         data = np.load(path)
-    except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+    except _archive_errors() as e:
         raise CorruptSnapshotError(
             f"{path}: not a readable batch snapshot archive ({e})"
         ) from e
@@ -393,9 +435,7 @@ def load_batch(path: str) -> BatchSnapshot:
             )
         except CorruptSnapshotError:
             raise
-        except (
-            zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
-        ) as e:
+        except _archive_errors() as e:
             raise CorruptSnapshotError(
                 f"{path}: batch snapshot archive is corrupt ({e})"
             ) from e
@@ -482,6 +522,7 @@ def save3d(
     if fingerprint is None:
         fingerprint = _vol_fingerprint(vol)
     tmp = path + ".tmp.npz"
+    _write_fault(tmp, generation)
     np.savez_compressed(
         tmp,
         volume=vol,
@@ -491,6 +532,7 @@ def save3d(
     )
     _tmp_rename_gap()
     os.replace(tmp, path)
+    _post_rename_fault(path, generation)
     return path
 
 
@@ -501,12 +543,9 @@ def load3d(path: str) -> Snapshot3D:
     ValueError), so the CLI's clean-error handling covers truncated
     files and wrong-format archives too — not just bad fingerprints.
     """
-    import zipfile
-    import zlib
-
     try:
         data = np.load(path)
-    except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+    except _archive_errors() as e:
         raise CorruptSnapshotError(
             f"{path}: not a readable snapshot archive ({e})"
         ) from e
@@ -525,9 +564,7 @@ def load3d(path: str) -> Snapshot3D:
             generation = int(data["generation"])
             rule = str(data["rule"])
             stored = int(data["fingerprint"])
-        except (
-            zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
-        ) as e:
+        except _archive_errors() as e:
             raise CorruptSnapshotError(
                 f"{path}: snapshot archive is corrupt ({e})"
             ) from e
@@ -723,9 +760,11 @@ def _save_sharded_nd(dirpath: str, arr, box_key: str, manifest_fields):
         arrays[f"piece_{i}"] = data
     path = os.path.join(dirpath, f"shards_{me:05d}.npz")
     tmp = path + ".tmp.npz"
+    _write_fault(tmp, manifest_fields["generation"])
     np.savez_compressed(tmp, **arrays)
     _tmp_rename_gap()
     os.replace(tmp, path)
+    _post_rename_fault(path, manifest_fields["generation"])
     written.append(path)
     if me == 0:
         table = sorted(owner.items())
@@ -870,8 +909,6 @@ def load_sharded_meta(dirpath: str, verify_stamp: bool = True) -> ShardedMeta:
     board data.  ``verify_stamp=False`` skips the global-stamp sweep (it
     reads every shard file — a multi-host auto-resume validates only its
     own process's pieces instead, see :func:`verify_snapshot`)."""
-    import zipfile
-
     try:
         with np.load(os.path.join(dirpath, _MANIFEST)) as data:
             layout = None
@@ -898,7 +935,7 @@ def load_sharded_meta(dirpath: str, verify_stamp: bool = True) -> ShardedMeta:
                     else None
                 ),
             )
-    except (KeyError, ValueError, zipfile.BadZipFile) as e:
+    except _archive_errors() as e:
         raise CorruptSnapshotError(
             f"{dirpath}: not a 2-D sharded checkpoint manifest ({e}); a "
             f"3-D {SHARD3D_DIR_SUFFIX} directory belongs to the 3-D driver"
@@ -918,8 +955,6 @@ def load_sharded3d_meta(
     dirpath: str, verify_stamp: bool = True
 ) -> Sharded3DMeta:
     """3-D counterpart of :func:`load_sharded_meta` (same validation)."""
-    import zipfile
-
     try:
         with np.load(os.path.join(dirpath, _MANIFEST)) as data:
             meta = Sharded3DMeta(
@@ -937,7 +972,7 @@ def load_sharded3d_meta(
                     else None
                 ),
             )
-    except (KeyError, ValueError, zipfile.BadZipFile) as e:
+    except _archive_errors() as e:
         raise CorruptSnapshotError(
             f"{dirpath}: not a 3-D sharded checkpoint manifest ({e}); a "
             f"2-D {SHARD_DIR_SUFFIX} directory belongs to the 2-D driver"
@@ -1112,9 +1147,6 @@ def _verify_pieces_nd(
     *it* wrote, and the ranks then agree on min(newest valid) so nobody
     resumes ahead of a rank whose pieces failed.
     """
-    import zipfile
-    import zlib
-
     per_proc: dict = {}
     for row, proc in zip(boxes, procs):
         proc = int(proc)
@@ -1125,7 +1157,7 @@ def _verify_pieces_nd(
         fpath = os.path.join(dirpath, f"shards_{proc:05d}.npz")
         try:
             sf = np.load(fpath)
-        except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+        except _archive_errors() as e:
             raise CorruptSnapshotError(
                 f"{fpath}: not a readable shard archive ({e})"
             ) from e
@@ -1163,9 +1195,7 @@ def _verify_pieces_nd(
                         )
             except CorruptSnapshotError:
                 raise
-            except (
-                zipfile.BadZipFile, zlib.error, KeyError, ValueError, EOFError
-            ) as e:
+            except _archive_errors() as e:
                 raise CorruptSnapshotError(
                     f"{fpath}: shard archive is corrupt ({e})"
                 ) from e

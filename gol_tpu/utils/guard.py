@@ -137,6 +137,13 @@ def _audit_device3(vol: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
 
 _audit_jit = jax.jit(_audit_device)
 _audit3_jit = jax.jit(_audit_device3)
+# Batched audit: one fused reduce per world of a [B, H, W] stack (the
+# batch runtime's guarded loop).  vmap keeps it a single launch; the
+# per-world scalars are tiny.  Padded bucket cells are forced dead every
+# generation by the masked engines, so a padded world's fingerprint
+# equals its cropped board's (zero cells contribute nothing to the
+# position-weighted sum).
+_audit_batch_jit = jax.jit(jax.vmap(_audit_device))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -175,6 +182,29 @@ def audit_board(board, generation: int = 0) -> Audit:
         population=int(pop),
         fingerprint=int(fp),
     )
+
+
+def audit_worlds(stack, generation: int) -> List["Audit"]:
+    """Per-world detection pass over a batched ``[B, H, W]`` stack.
+
+    One vmapped fused reduce; returns one :class:`Audit` per world so
+    the batch guard can name (and roll back) exactly the corrupted
+    world's bucket.
+    """
+    max_cells, pops, fps = _audit_batch_jit(stack)
+    max_cells = np.asarray(max_cells)
+    pops = np.asarray(pops)
+    fps = np.asarray(fps)
+    return [
+        Audit(
+            generation=generation,
+            ok=int(max_cells[i]) <= 1,
+            max_cell=int(max_cells[i]),
+            population=int(pops[i]),
+            fingerprint=int(fps[i]),
+        )
+        for i in range(len(max_cells))
+    ]
 
 
 def inject_bitflip(board, row: int, col: int, value: int = 0xA5):
@@ -341,14 +371,6 @@ def run_guarded(
             "replay consumes the evolvers' donated buffers that stats "
             "mode must keep alive"
         )
-    if getattr(rt, "_resolved", None) == "activity":
-        raise ValueError(
-            "--guard-every applies to the dense/bitpack/pallas tiers: "
-            "the activity engine's chunk programs carry the changed-tile "
-            "mask, which the guard's rollback-replay does not thread; "
-            "run it unguarded (the gated step is bit-pinned against the "
-            "dense tiers)"
-        )
     sw = Stopwatch()
     guard = GuardReport()
     with sw.phase("init"):
@@ -360,6 +382,10 @@ def run_guarded(
     schedule: List[int] = rt.chunk_schedule(iterations, config.check_every)
 
     events = rt.open_event_log()
+    # The containment policies need the live stream: the disk-full shed
+    # sacrifices telemetry before checkpoints (docs/RESILIENCE.md).
+    rt._ckpt_shed = False
+    rt._live_events = events
     try:
         with sw.phase("compile"):
             evolvers = rt.compile_evolvers(board, schedule, events)
@@ -368,6 +394,33 @@ def run_guarded(
                 checker_evolvers = _checker_runtime(rt).compile_evolvers(
                     board, schedule
                 )
+        on_restore = None
+        if getattr(rt, "_resolved", None) == "activity":
+            # Activity tier under guard (docs/SPARSE.md): the chunk
+            # programs carry the changed-tile mask — fn(board, mask) ->
+            # (board, mask, activity).  The adapter threads the mask
+            # outside the guarded loop's view (the audit rides the
+            # board the worklist produced), and a rollback reconstructs
+            # it all-active — the same sound superset rule as resume,
+            # collapsing to the true activity after one generation, so
+            # the replayed board stays bit-identical to the dense tiers.
+            mask_holder = [rt._initial_activity_mask()]
+
+            def _wrap_activity(compiled):
+                def call(b):
+                    nb, nm, _act = compiled(b, mask_holder[0])
+                    mask_holder[0] = nm
+                    return nb
+
+                return call
+
+            evolvers = {
+                take: (_wrap_activity(c), dynamic)
+                for take, (c, dynamic) in evolvers.items()
+            }
+
+            def on_restore():
+                mask_holder[0] = rt._initial_activity_mask()
 
         generation = int(state.generation)
         writer = None
@@ -404,6 +457,7 @@ def run_guarded(
                         fingerprint=fp,
                         already_saved=saved,
                     ),
+                    on_restore=on_restore,
                 )
             if writer is not None:
                 with sw.phase("checkpoint"):
@@ -417,6 +471,7 @@ def run_guarded(
         if events is not None:
             events.summary(report)
     finally:
+        rt._live_events = None
         if events is not None:
             events.close()
     return report, GolState.create(board, generation), guard
@@ -437,6 +492,7 @@ def guarded_loop(
     chunk_utilization=None,
     checkpoint_overlapped: bool = False,
     preempt_hook=None,
+    on_restore=None,
 ):
     """The chunk/audit/rollback core, shared by the 2-D and 3-D drivers.
 
@@ -463,10 +519,40 @@ def guarded_loop(
     checkpoint landed — when a preemption was requested and work
     remains.  The hook persists/fences a final snapshot and raises
     ``Preempted``; only audited-good boards ever reach it.
+
+    ``on_restore`` (optional) runs after every rollback, before the
+    replay — the activity tier resets its carried changed-tile mask to
+    the all-active superset here.  The pipelined shard mode needs no
+    analog: its ``(block, bands)`` double buffer lives entirely inside
+    one compiled chunk program (each chunk re-exchanges its prologue
+    band from the board it is given), so restoring the board restores
+    the carried pair by construction — pinned by the guard×pipeline
+    rollback tests.
+
+    An active fault plan (:mod:`gol_tpu.resilience.faults`) composes
+    with ``config.fault_hook``: plan ``board.bitflip`` entries apply
+    after the hook, ``crash.exit``/``rank.stall`` fire at the chunk
+    boundary, and fired injections / containment decisions drain into
+    schema-v9 ``fault``/``degraded`` events when telemetry is on.
     """
     import time as time_mod
 
     from gol_tpu import telemetry as telemetry_mod
+    from gol_tpu.resilience import degrade as degrade_mod
+    from gol_tpu.resilience import faults as faults_mod
+
+    plan_on = faults_mod.active() is not None
+
+    def _drain_plane():
+        # Fault-plane ledgers -> v9 telemetry (no-ops when empty; fired
+        # checkpoint faults accumulate on the writer thread and surface
+        # at the next boundary here).
+        if events is None:
+            return
+        for f in faults_mod.drain_fired():
+            events.fault_event(**f)
+        for d in degrade_mod.drain_reports():
+            events.degraded_event(**d)
     # The rollback base lives on device (in the same fault domain as the
     # board — the price of not all-gathering per chunk), so its audit
     # fingerprint is recorded at snapshot time and re-verified before any
@@ -516,6 +602,10 @@ def guarded_loop(
                 )
         if config.fault_hook is not None:
             candidate = config.fault_hook(candidate, generation + take)
+        if plan_on:
+            candidate = faults_mod.apply_board_faults(
+                candidate, generation + take
+            )
         with telemetry_mod.trace_annotation("gol.guard.audit"):
             with sw.phase("audit"), _span("audit"):
                 audit = audit_board(candidate, generation + take)
@@ -576,6 +666,8 @@ def guarded_loop(
                         f"!= recorded {last_good[2]:#010x}); in-run recovery "
                         "is impossible — resume from the last checkpoint"
                     )
+                if on_restore is not None:
+                    on_restore()
             continue  # replay the same chunk
         restores_this_chunk = 0
         board = candidate
@@ -606,6 +698,9 @@ def guarded_loop(
                     )
             next_ckpt = generation + checkpoint_every
             just_checkpointed = True
+        if plan_on:
+            faults_mod.crash_or_stall(generation)
+        _drain_plane()
         if preempt_hook is not None and i < len(schedule) - 1:
             from gol_tpu import resilience
 

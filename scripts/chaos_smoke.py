@@ -1,0 +1,145 @@
+"""Chaos-smoke gate: one fault plan through a small guarded batch run.
+
+The check.sh stage for the unified fault plane (docs/RESILIENCE.md).
+ONE plan file arms three faults at once against a guarded, checkpointed,
+telemetry-on ``--batch`` CLI run:
+
+- an in-graph **bit-flip** at the final generation (the SDC the guard
+  must catch and roll back),
+- a **torn checkpoint write** (the ``.tmp`` must never become a resume
+  candidate; the bounded retry must land a clean snapshot),
+- a transient **ENOSPC** on a later snapshot (absorbed by the
+  shed-telemetry-first policy's retry path).
+
+Assertions: the CLI exits 0 with a guard line showing the detection,
+every surviving snapshot fully verifies, the v9 ``fault``/``degraded``
+records are on the stream, and each world's recovered final grid is
+**byte-equal** to a clean (fault-free) run's.  Exits non-zero with a
+message on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+WORLD = ["4", "64", "12", "512", "1"]
+BATCH = ["--batch", "3", "--batch-sizes", "64,96"]
+
+PLAN = {
+    "faults": [
+        {"site": "board.bitflip", "at": 12, "world": 1, "row": 10,
+         "col": 20, "value": 165},
+        {"site": "checkpoint.torn_tmp", "at": 4},
+        {"site": "checkpoint.disk_full", "at": 8, "count": 1},
+    ]
+}
+
+
+def _run(outdir: str, extra, env) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *WORLD, *BATCH,
+         "--outdir", outdir, *extra],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+    )
+
+
+def main() -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    env.pop("XLA_FLAGS", None)
+    with tempfile.TemporaryDirectory() as tmp:
+        ref = os.path.join(tmp, "ref")
+        out = os.path.join(tmp, "out")
+        ck = os.path.join(tmp, "ck")
+        tm = os.path.join(tmp, "tm")
+        clean = _run(ref, [], env)
+        if clean.returncode != 0:
+            sys.exit(
+                f"chaos smoke FAILED: clean run exited "
+                f"{clean.returncode}:\n{clean.stdout}{clean.stderr}"
+            )
+        faulted = _run(
+            out,
+            ["--guard-every", "2", "--guard-redundant",
+             "--checkpoint-every", "4", "--checkpoint-dir", ck,
+             "--telemetry", tm, "--run-id", "chaossmoke",
+             "--fault-plan", json.dumps(PLAN)],
+            env,
+        )
+        if faulted.returncode != 0:
+            sys.exit(
+                f"chaos smoke FAILED: faulted run exited "
+                f"{faulted.returncode}:\n{faulted.stdout}{faulted.stderr}"
+            )
+        # Detection: the guard line reports the failure + restore.
+        guard_lines = [
+            ln for ln in faulted.stdout.splitlines()
+            if ln.startswith("GUARD")
+        ]
+        if not guard_lines or " 0 failures" in guard_lines[0]:
+            sys.exit(
+                "chaos smoke FAILED: the guard never detected the "
+                f"injected flip (stdout:\n{faulted.stdout})"
+            )
+        print(f"chaos smoke: {guard_lines[0].strip()}")
+
+        # Containment: every surviving snapshot verifies (the torn tmp
+        # was retried to a clean file, never promoted).
+        from gol_tpu.utils import checkpoint as ckpt
+
+        snaps = ckpt.list_snapshots(ck, kind="batch")
+        if not snaps:
+            sys.exit("chaos smoke FAILED: no snapshots survived")
+        for s in snaps:
+            ckpt.verify_snapshot(s)
+        print(
+            f"chaos smoke: {len(snaps)} snapshot(s) verify after torn "
+            "write + ENOSPC"
+        )
+
+        # The v9 records are on the stream.
+        recs = []
+        with open(os.path.join(tm, "chaossmoke.rank0.jsonl")) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        sites = sorted(
+            {r["site"] for r in recs if r["event"] == "fault"}
+        )
+        for want in (
+            "board.bitflip", "checkpoint.disk_full", "checkpoint.torn_tmp",
+        ):
+            if want not in sites:
+                sys.exit(
+                    f"chaos smoke FAILED: no v9 fault record for {want} "
+                    f"(got {sites})"
+                )
+        if not any(r["event"] == "degraded" for r in recs):
+            sys.exit(
+                "chaos smoke FAILED: no v9 degraded record for the "
+                "retried writes"
+            )
+        print(f"chaos smoke: v9 fault records for {', '.join(sites)}")
+
+        # Recovery: every world's dump byte-equal to the clean run's.
+        for w in range(3):
+            name = os.path.join(f"world_{w:04d}", "Rank_0_of_1.txt")
+            a = open(os.path.join(ref, name), "rb").read()
+            b = open(os.path.join(out, name), "rb").read()
+            if a != b:
+                sys.exit(
+                    f"chaos smoke FAILED: world {w} final grid differs "
+                    "from the clean run"
+                )
+        print("chaos smoke: all 3 worlds byte-equal to the clean run")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

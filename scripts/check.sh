@@ -31,26 +31,30 @@
 #                       pipeline k=4 on a 1-D mesh bit-equal to
 #                       explicit k=1, with v8 halo blocks on every
 #                       chunk event)
-#  11. tier-1 tests    (the exact ROADMAP.md command)
+#  11. chaos smoke     (unified fault plane: one plan driving
+#                       bit-flip + torn-write + ENOSPC through a small
+#                       guarded batch run — detected, contained, and
+#                       recovered byte-equal; docs/RESILIENCE.md)
+#  12. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/11] lint =="
+echo "== [1/12] lint =="
 bash scripts/lint.sh
 
-echo "== [2/11] static verifier (gol_tpu.analysis) =="
+echo "== [2/12] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/11] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/12] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/11] stats smoke (in-graph simulation statistics) =="
+echo "== [4/12] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -59,25 +63,28 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/11] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/12] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/11] batch smoke (docs/BATCHING.md) =="
+echo "== [6/12] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/11] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/12] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/11] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/12] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/11] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+echo "== [9/12] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
 JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
-echo "== [10/11] halo smoke (pipelined depth-k exchange, PR 9) =="
+echo "== [10/12] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/11] tier-1 tests =="
+echo "== [11/12] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+echo "== [12/12] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

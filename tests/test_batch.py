@@ -457,7 +457,8 @@ def test_cli_batch_rejections(tmp_path, capsys):
         (["--batch", "2", "--rule", "B36/S23"], "B3/S23"),
         (["--batch", "2", "--stats", "--telemetry", str(tmp_path)],
          "single-world"),
-        (["--batch", "2", "--guard-every", "2"], "single-world"),
+        # (--batch + --guard-every is now a supported combination —
+        # PR 10's batched guard; see tests/test_guard_tiers.py.)
         (["--batch", "2", "--mesh", "2d"], "1-D"),
         (["--batch", "2", "--engine", "pallas"], "no batched tier"),
         (["--batch", "2", "--batch-sizes", "xyz"], "no sizes"),

@@ -1,0 +1,153 @@
+"""The chaos matrix runner (python -m gol_tpu.resilience chaos).
+
+The fast tests pin the runner's own behavior — plan loading, legality
+skips being visible, a detection miss reading as FAIL — on a small
+sub-grid; the full committed scenario × tier × mesh grid (the
+acceptance surface: every cell detected + recovered byte-identically,
+illegal cells visibly skipped) runs under ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import io
+
+import jax
+import pytest
+
+from gol_tpu import compat
+from gol_tpu.resilience import chaos, faults
+
+jax.config.update("jax_platforms", "cpu")
+compat.set_cpu_device_count(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def test_committed_plan_loads_and_covers_the_grid():
+    plan = chaos.ChaosPlan.load(chaos.DEFAULT_PLAN_PATH)
+    assert set(plan.tiers) == set(chaos.TIERS)
+    assert set(plan.meshes) == set(chaos.MESHES)
+    names = {s.name for s in plan.scenarios}
+    # The fault-site catalog is represented: SDC both flavors, the
+    # checkpoint write sites, rot, telemetry, and a process stall.
+    assert {
+        "sdc-oob", "sdc-inrange", "torn-write", "ckpt-io", "disk-full",
+        "snapshot-rot", "telemetry-io", "rank-stall",
+    } <= names
+    sites = {f["site"] for s in plan.scenarios for f in s.faults}
+    assert {
+        "board.bitflip", "checkpoint.torn_tmp", "checkpoint.io_error",
+        "checkpoint.disk_full", "snapshot.bitflip",
+        "telemetry.write_error", "rank.stall",
+    } <= sites
+
+
+def test_bad_scenario_kind_rejected():
+    with pytest.raises(ValueError, match="unknown kind"):
+        chaos.Scenario(name="x", kind="explode", faults=())
+
+
+def test_illegal_cells_are_visibly_skipped():
+    plan = chaos.ChaosPlan(
+        scenarios=(
+            chaos.Scenario(
+                name="sdc",
+                kind="guard",
+                faults=(
+                    {"site": "board.bitflip", "at": 4, "row": 3,
+                     "col": 3, "value": 165},
+                ),
+            ),
+        ),
+        tiers=("pallas", "batch"),
+        meshes=("1d", "2d"),
+        size=64,
+        iterations=4,
+    )
+    out = io.StringIO()
+    results = chaos.run_matrix(plan, out=out)
+    skips = [r for r in results if r.status == "skip"]
+    assert any(
+        r.tier == "pallas" and "no sharded path" in r.reason for r in skips
+    )
+    assert any(
+        r.tier == "batch" and r.mesh == "2d" for r in skips
+    )
+    text = out.getvalue()
+    assert "[SKIP]" in text and "no sharded path" in text
+
+
+def test_small_grid_detects_and_recovers():
+    """One guard cell + one contain cell end to end through the runner."""
+    plan = chaos.ChaosPlan(
+        scenarios=(
+            chaos.Scenario(
+                name="sdc",
+                kind="guard",
+                faults=(
+                    {"site": "board.bitflip", "at": 6, "row": 3,
+                     "col": 3, "value": 165},
+                ),
+            ),
+            chaos.Scenario(
+                name="ckpt-io",
+                kind="contain",
+                faults=(
+                    {"site": "checkpoint.io_error", "at": 2, "count": 1},
+                ),
+            ),
+        ),
+        tiers=("bitpack",),
+        meshes=("none",),
+        size=64,
+        iterations=6,
+    )
+    results = chaos.run_matrix(plan, out=io.StringIO())
+    assert [r.status for r in results] == ["ok", "ok"], [
+        (r.label, r.reason) for r in results
+    ]
+
+
+def test_a_missed_detection_reads_as_fail():
+    """An in-range flip with a PLAIN guard (no redundancy) must be
+    reported as a FAIL by the matrix — the runner's teeth."""
+    plan = chaos.ChaosPlan(
+        scenarios=(
+            chaos.Scenario(
+                name="sdc-inrange-noredundant",
+                kind="guard",
+                redundant=False,  # deliberately too weak for the fault
+                faults=(
+                    {"site": "board.bitflip", "at": 6, "row": 3,
+                     "col": 3, "value": -1},
+                ),
+            ),
+        ),
+        tiers=("dense",),
+        meshes=("none",),
+        size=64,
+        iterations=6,
+    )
+    results = chaos.run_matrix(plan, out=io.StringIO())
+    assert results[0].status == "fail"
+    assert "not detected" in results[0].reason
+
+
+@pytest.mark.slow
+def test_full_committed_matrix_is_green():
+    """The acceptance grid: every scenario × tier × mesh cell of the
+    committed plan either passes (detected + byte-identical recovery)
+    or is a visible legality skip — zero failures."""
+    plan = chaos.ChaosPlan.load(chaos.DEFAULT_PLAN_PATH)
+    out = io.StringIO()
+    results = chaos.run_matrix(plan, out=out)
+    fails = [r for r in results if r.status == "fail"]
+    assert not fails, "\n" + "\n".join(
+        f"{r.label}: {r.reason}" for r in fails
+    ) + "\n" + out.getvalue()
+    assert sum(1 for r in results if r.status == "ok") >= 60

@@ -1,0 +1,421 @@
+"""The declarative fault-injection plane + containment policies.
+
+Covers (docs/RESILIENCE.md "The fault plane" / "Retry and shed"):
+
+- plan parsing/validation (unknown sites and fields are loud),
+  inline-vs-path loading, the GOL_FAULT_PLAN env install, and the
+  legacy GOL_CKPT_TEST_WRITE_DELAY alias;
+- the trace-identity pin: an installed plan leaves every engine's chunk
+  program byte-identical (injection is host-side, between programs);
+- checkpoint-write containment: transient IO errors retry to a clean
+  snapshot, torn tmps never become candidates, persistent disk-full
+  sheds telemetry before checkpoints and NEVER kills the run;
+- telemetry-writer containment: a failing rank-file write degrades the
+  stream (warn once, drop, ``degraded`` stamp) instead of killing the
+  run;
+- on-disk snapshot rot is refused by the validated resume walk;
+- process faults: crash.exit kills a real child at a chunk boundary and
+  an auto-resumed relaunch completes byte-identically; rank.stall fires
+  and is recorded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gol_tpu.models.state import Geometry
+from gol_tpu.resilience import degrade, faults
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.utils import checkpoint as ckpt
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.clear()
+    degrade.drain_reports()
+    yield
+    faults.clear()
+    degrade.drain_reports()
+
+
+def _plan(*entries):
+    return faults.FaultPlan.from_obj(list(entries))
+
+
+def _flip(at, value=-1, **kw):
+    return dict(site="board.bitflip", at=at, value=value, row=5, col=7, **kw)
+
+
+def _clean_board(engine="dense", iters=6):
+    rt = GolRuntime(geometry=Geometry(size=64, num_ranks=1), engine=engine)
+    _, state = rt.run(pattern=4, iterations=iters)
+    return np.asarray(state.board)
+
+
+# -- plan surface ------------------------------------------------------------
+
+
+def test_unknown_site_rejected():
+    with pytest.raises(faults.FaultPlanError, match="unknown fault site"):
+        _plan({"site": "board.melt"})
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(faults.FaultPlanError, match="unknown fault fields"):
+        _plan({"site": "rank.stall", "speling": 1})
+
+
+def test_bad_count_and_delay_rejected():
+    with pytest.raises(faults.FaultPlanError, match="count"):
+        _plan({"site": "rank.stall", "count": 0})
+    with pytest.raises(faults.FaultPlanError, match="delay_s"):
+        _plan({"site": "rank.stall", "delay_s": -1})
+
+
+def test_load_inline_and_path_and_env(tmp_path, monkeypatch):
+    inline = '[{"site": "rank.stall", "delay_s": 0.5}]'
+    assert faults.FaultPlan.load(inline).faults[0].delay_s == 0.5
+    p = tmp_path / "plan.json"
+    p.write_text('{"faults": ' + inline + "}")
+    assert faults.FaultPlan.load(str(p)).faults[0].site == "rank.stall"
+    with pytest.raises(faults.FaultPlanError, match="cannot read"):
+        faults.FaultPlan.load(str(tmp_path / "missing.json"))
+    monkeypatch.setenv(faults.PLAN_ENV, inline)
+    plan = faults.install_from_env()
+    assert plan is not None and faults.active() is plan
+
+
+def test_attempt_gating(monkeypatch):
+    """attempts=1 (default) arms only the first supervised attempt, so
+    a crash spec cannot re-kill its own recovery relaunch."""
+    faults.install(_plan({"site": "rank.stall", "delay_s": 0.0}))
+    monkeypatch.setenv("GOL_RESTART_ATTEMPT", "1")
+    assert faults.fire("rank.stall") is None
+    monkeypatch.setenv("GOL_RESTART_ATTEMPT", "0")
+    assert faults.fire("rank.stall") is not None
+    faults.install(
+        _plan({"site": "rank.stall", "delay_s": 0.0, "attempts": -1})
+    )
+    monkeypatch.setenv("GOL_RESTART_ATTEMPT", "7")
+    assert faults.fire("rank.stall") is not None
+
+
+def test_count_consumes_and_drain_ledger():
+    faults.install(_plan({"site": "rank.stall", "count": 2}))
+    assert faults.fire("rank.stall", 3) is not None
+    assert faults.fire("rank.stall", 3) is not None
+    assert faults.fire("rank.stall", 3) is None
+    fired = faults.drain_fired()
+    assert len(fired) == 2 and all(
+        f["site"] == "rank.stall" for f in fired
+    )
+    assert faults.drain_fired() == []
+
+
+# -- trace identity ----------------------------------------------------------
+
+
+def test_fault_plan_never_changes_the_traced_program():
+    """The jaxpr pin of the acceptance criteria: injection happens
+    BETWEEN chunk programs, so an armed plan cannot change a trace."""
+    from gol_tpu.analysis import walker
+
+    for engine in ("dense", "bitpack"):
+        jaxprs = []
+        for armed in (False, True):
+            faults.clear()
+            if armed:
+                faults.install(_plan(_flip(4, value=165)))
+            rt = GolRuntime(
+                geometry=Geometry(size=64, num_ranks=1), engine=engine
+            )
+            fn, dynamic, static = rt._evolve_fn(4)
+            spec = jax.ShapeDtypeStruct((64, 64), np.uint8)
+            jaxprs.append(str(walker.trace_jaxpr(fn, spec, *dynamic, *static)))
+        assert jaxprs[0] == jaxprs[1], f"engine {engine} trace diverged"
+
+
+# -- rename-delay site + legacy alias ----------------------------------------
+
+
+def test_rename_delay_plan_entry_gaps_the_rename(tmp_path):
+    faults.install(
+        _plan({"site": "checkpoint.rename_delay", "delay_s": 0.25})
+    )
+    t0 = time.perf_counter()
+    ckpt.save(str(tmp_path / "a.gol.npz"), np.zeros((4, 4), np.uint8), 0, 1)
+    assert time.perf_counter() - t0 >= 0.25
+    # count=1 default: the second save is gap-free.
+    t0 = time.perf_counter()
+    ckpt.save(str(tmp_path / "b.gol.npz"), np.zeros((4, 4), np.uint8), 0, 1)
+    assert time.perf_counter() - t0 < 0.25
+
+
+def test_legacy_env_alias_still_works(tmp_path, monkeypatch):
+    monkeypatch.setenv(faults.RENAME_DELAY_ENV, "0.25")
+    t0 = time.perf_counter()
+    ckpt.save(str(tmp_path / "a.gol.npz"), np.zeros((4, 4), np.uint8), 0, 1)
+    assert time.perf_counter() - t0 >= 0.25
+
+
+# -- checkpoint-write containment --------------------------------------------
+
+
+def test_transient_io_error_retries_to_clean_snapshots(tmp_path):
+    clean = _clean_board()
+    faults.install(
+        _plan({"site": "checkpoint.io_error", "at": 2, "count": 2})
+    )
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+        telemetry_dir=str(tmp_path / "tm"),
+        run_id="r",
+    )
+    _, state = rt.run(pattern=4, iterations=6)
+    assert np.array_equal(np.asarray(state.board), clean)
+    snaps = ckpt.list_snapshots(str(tmp_path / "ck"))
+    assert len(snaps) == 3  # every cadence boundary landed
+    for s in snaps:
+        ckpt.verify_snapshot(s)
+    recs = [
+        json.loads(ln) for ln in open(tmp_path / "tm" / "r.rank0.jsonl")
+    ]
+    assert any(
+        r["event"] == "fault" and r["site"] == "checkpoint.io_error"
+        for r in recs
+    )
+    assert any(
+        r["event"] == "degraded" and r["action"] == "retried"
+        for r in recs
+    )
+
+
+def test_torn_tmp_never_becomes_a_candidate(tmp_path):
+    clean = _clean_board()
+    faults.install(_plan({"site": "checkpoint.torn_tmp", "at": 2}))
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    _, state = rt.run(pattern=4, iterations=6)
+    assert np.array_equal(np.asarray(state.board), clean)
+    for s in ckpt.list_snapshots(str(tmp_path / "ck")):
+        ckpt.verify_snapshot(s)
+
+
+def test_persistent_disk_full_sheds_but_finishes(tmp_path, capsys):
+    clean = _clean_board()
+    faults.install(
+        _plan({"site": "checkpoint.disk_full", "at": 2, "count": -1})
+    )
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+        telemetry_dir=str(tmp_path / "tm"),
+        run_id="r",
+    )
+    _, state = rt.run(pattern=4, iterations=6)
+    # The run completed with the right grid despite a disk that never
+    # accepted one snapshot.
+    assert np.array_equal(np.asarray(state.board), clean)
+    assert rt._ckpt_shed
+    assert ckpt.list_snapshots(str(tmp_path / "ck")) == []
+    # The shed order is telemetry first: the stream's last record is
+    # its own degraded stamp.
+    recs = [
+        json.loads(ln) for ln in open(tmp_path / "tm" / "r.rank0.jsonl")
+    ]
+    assert recs[-1]["event"] == "degraded"
+    assert recs[-1]["resource"] == "telemetry"
+    assert recs[-1]["action"] == "shed"
+    assert "continuing WITHOUT further checkpoints" in (
+        capsys.readouterr().err
+    )
+
+
+def test_genuinely_broken_storage_still_raises(tmp_path):
+    """Non-ENOSPC failures past the retry budget surface as before —
+    containment is for faults, not for an unwritable directory."""
+    faults.install(
+        _plan({"site": "checkpoint.io_error", "at": 2, "count": -1})
+    )
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    with pytest.raises(OSError, match="injected transient"):
+        rt.run(pattern=4, iterations=6)
+
+
+# -- on-disk rot -------------------------------------------------------------
+
+
+def test_snapshot_rot_is_refused_by_the_resume_walk(tmp_path):
+    faults.install(_plan({"site": "snapshot.bitflip", "at": 6}))
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+    )
+    rt.run(pattern=4, iterations=6)
+    faults.clear()
+    newest, skipped = ckpt.latest_valid(str(tmp_path / "ck"))
+    assert skipped and "000000000006" in skipped[0]
+    assert newest is not None and "000000000004" in newest
+    with pytest.raises(ckpt.CorruptSnapshotError):
+        ckpt.verify_snapshot(skipped[0])
+
+
+# -- telemetry-writer containment (satellite) --------------------------------
+
+
+def test_telemetry_write_failure_degrades_not_kills(tmp_path, capsys):
+    clean = _clean_board()
+    # ``at`` counts records for this site: let a few land, fail the next.
+    faults.install(_plan({"site": "telemetry.write_error", "at": 3}))
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        telemetry_dir=str(tmp_path),
+        run_id="r",
+    )
+    _, state = rt.run(pattern=4, iterations=6)
+    assert np.array_equal(np.asarray(state.board), clean)
+    err = capsys.readouterr().err
+    assert err.count("telemetry degraded") == 1  # warned exactly once
+    recs = [json.loads(ln) for ln in open(tmp_path / "r.rank0.jsonl")]
+    # The stream holds everything before the failure, then the stamp.
+    assert recs[0]["event"] == "run_header"
+    assert recs[-1]["event"] == "degraded"
+    assert recs[-1]["resource"] == "telemetry"
+    assert recs[-1]["action"] == "dropped"
+    assert all(r["event"] != "summary" for r in recs)  # shed, not written
+
+
+def test_real_write_failure_in_stream_is_contained(tmp_path, capsys):
+    """The containment is not injection-specific: a file handle that
+    dies under the stream degrades instead of raising."""
+    from gol_tpu.telemetry import EventLog
+
+    ev = EventLog(str(tmp_path), run_id="x", process_index=0)
+    ev.run_header({"driver": "test"})
+    ev._f.close()  # the "disk" breaks mid-run
+    ev.compile_event(1, 0.0, 0.0)  # must not raise
+    ev.compile_event(2, 0.0, 0.0)  # further events silently dropped
+    assert ev.degraded is not None
+    assert ev.degraded["action"] == "dropped"
+    assert capsys.readouterr().err.count("telemetry degraded") == 1
+    recs = [json.loads(ln) for ln in open(tmp_path / "x.rank0.jsonl")]
+    assert [r["event"] for r in recs] == ["run_header"]
+    ev.close()
+
+
+# -- process faults ----------------------------------------------------------
+
+
+def test_rank_stall_fires_and_is_recorded(tmp_path):
+    faults.install(
+        _plan({"site": "rank.stall", "at": 2, "delay_s": 0.05})
+    )
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1),
+        engine="dense",
+        checkpoint_every=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+        telemetry_dir=str(tmp_path / "tm"),
+        run_id="r",
+    )
+    rt.run(pattern=4, iterations=6)
+    recs = [
+        json.loads(ln) for ln in open(tmp_path / "tm" / "r.rank0.jsonl")
+    ]
+    assert any(
+        r["event"] == "fault" and r["site"] == "rank.stall" for r in recs
+    )
+
+
+def test_crash_exit_then_auto_resume_completes(tmp_path):
+    """A real child process dies at a chunk boundary (os._exit — no
+    flush, no atexit) and an auto-resumed relaunch finishes
+    byte-identically: the supervisor-child crash site end to end."""
+    ref = tmp_path / "ref"
+    out = tmp_path / "out"
+    ck = str(tmp_path / "ck")
+    ref.mkdir()
+    out.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    world = ["4", "64", "8", "512", "1"]
+    subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *world, "--outdir", str(ref)],
+        env=env, cwd=REPO, check=True,
+    )
+    plan = json.dumps(
+        {"faults": [{"site": "crash.exit", "at": 4, "value": 17}]}
+    )
+    crashed = subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *world, "--outdir", str(out),
+         "--checkpoint-every", "2", "--checkpoint-dir", ck,
+         "--auto-resume", "--fault-plan", plan],
+        env=env, cwd=REPO,
+    )
+    assert crashed.returncode == 17
+    # The relaunch (same argv, attempt 1 — the crash spec is disarmed
+    # by its attempts gate) completes the remaining generations.
+    env2 = dict(env, GOL_RESTART_ATTEMPT="1")
+    subprocess.run(
+        [sys.executable, "-m", "gol_tpu", *world, "--outdir", str(out),
+         "--checkpoint-every", "2", "--checkpoint-dir", ck,
+         "--auto-resume", "--fault-plan", plan],
+        env=env2, cwd=REPO, check=True,
+    )
+    a = (ref / "Rank_0_of_1.txt").read_bytes()
+    b = (out / "Rank_0_of_1.txt").read_bytes()
+    assert a == b
+
+
+# -- archive-error hardening (found by the chaos matrix) ---------------------
+
+
+def test_header_corruption_reads_as_corrupt_snapshot(tmp_path):
+    """A flipped byte inside a .npy member header makes numpy's header
+    parser raise SyntaxError/TokenError — those must read as 'corrupt
+    snapshot', never a traceback (the chaos matrix found this live)."""
+    path = str(tmp_path / "a.gol.npz")
+    ckpt.save(path, np.zeros((16, 16), np.uint8), 0, 1)
+    size = os.path.getsize(path)
+    hits = 0
+    for offset in range(40, min(size - 1, 200), 7):
+        data = bytearray(open(path, "rb").read())
+        data[offset] ^= 0xFF
+        broken = str(tmp_path / f"b{offset}.gol.npz")
+        open(broken, "wb").write(bytes(data))
+        try:
+            ckpt.load(broken)
+        except ckpt.CorruptSnapshotError:
+            hits += 1
+        # a lucky flip may still load (e.g. in zip padding) — fine;
+        # what must NEVER happen is any other exception type.
+    assert hits > 0

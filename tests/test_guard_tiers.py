@@ -1,0 +1,285 @@
+"""Guard coverage across the tiers built since the guard (PR 10).
+
+The single-world 2-D guard is covered by tests/test_guard.py; here the
+extensions the unified fault plane drove (docs/RESILIENCE.md "Guard
+coverage"):
+
+- **activity** (``--engine activity``): the audit rides the worklist
+  path's board output, rollback reconstructs the changed-tile mask
+  all-active (the resume rule), and a guarded fault-free run stays
+  bit-identical to the dense tiers;
+- **batch** (``--batch``): per-world fingerprints from one vmapped
+  audit, rollback replays ONLY the corrupted world's bucket, and the
+  cross-engine redundancy audit catches per-world in-range flips;
+- **pipelined shard mode**: rollback restores the carried state by
+  construction (each chunk program re-exchanges its prologue band from
+  the board it is given), pinned by flip-inject-recover on 1-D and 2-D
+  meshes with every audit scalar agreeing across shards.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from gol_tpu import compat
+from gol_tpu.batch import GolBatchRuntime
+from gol_tpu.models import patterns
+from gol_tpu.models.state import Geometry
+from gol_tpu.resilience import faults
+from gol_tpu.runtime import GolRuntime, build_mesh
+from gol_tpu.utils import guard as guard_mod
+
+jax.config.update("jax_platforms", "cpu")
+compat.set_cpu_device_count(8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _flip_plan(at, value, **kw):
+    return faults.FaultPlan.from_obj(
+        [dict(site="board.bitflip", at=at, value=value, row=10, col=20,
+              **kw)]
+    )
+
+
+def _clean(size=64, iters=6):
+    rt = GolRuntime(geometry=Geometry(size=size, num_ranks=1), engine="dense")
+    _, state = rt.run(pattern=4, iterations=iters)
+    return np.asarray(state.board)
+
+
+def _guarded(engine, size=64, iters=6, mesh=None, redundant=False,
+             shard_mode="explicit", halo_depth=1):
+    rt = GolRuntime(
+        geometry=Geometry(size=size, num_ranks=1),
+        engine=engine,
+        mesh=mesh,
+        shard_mode=shard_mode,
+        halo_depth=halo_depth,
+    )
+    _, state, report = guard_mod.run_guarded(
+        rt, pattern=4, iterations=iters,
+        config=guard_mod.GuardConfig(check_every=2, redundant=redundant),
+    )
+    return np.asarray(state.board), report
+
+
+# -- activity tier -----------------------------------------------------------
+
+
+def test_activity_guarded_faultfree_matches_dense():
+    clean = _clean()
+    board, report = _guarded("activity")
+    assert report.failures == 0 and report.checks == 3
+    assert np.array_equal(board, clean)
+
+
+def test_activity_guard_detects_and_recovers_oob_flip():
+    clean = _clean()
+    faults.install(_flip_plan(6, 0xA5))
+    board, report = _guarded("activity")
+    assert report.failures >= 1 and report.restores >= 1
+    assert np.array_equal(board, clean)
+
+
+def test_activity_guard_redundant_catches_inrange_flip():
+    clean = _clean()
+    faults.install(_flip_plan(6, -1))
+    board, report = _guarded("activity", redundant=True)
+    assert report.failures >= 1
+    assert np.array_equal(board, clean)
+
+
+def test_activity_guard_mid_run_flip_recovers():
+    """A flip at a mid-run audit boundary: the rollback resets the mask
+    all-active, and the replayed evolution reconverges exactly."""
+    clean = _clean()
+    faults.install(_flip_plan(4, 0xA5))
+    board, report = _guarded("activity")
+    assert report.failures >= 1
+    assert np.array_equal(board, clean)
+
+
+def test_activity_guard_sharded():
+    clean = _clean(size=128)
+    faults.install(_flip_plan(6, 0xA5))
+    board, report = _guarded("activity", size=128, mesh=build_mesh("1d"))
+    assert report.failures >= 1
+    assert np.array_equal(board, clean)
+
+
+def test_activity_stats_still_excluded():
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=1), engine="activity",
+    )
+    rt.stats = True
+    with pytest.raises(ValueError, match="--stats applies to unguarded"):
+        guard_mod.run_guarded(
+            rt, pattern=4, iterations=4,
+            config=guard_mod.GuardConfig(check_every=2),
+        )
+
+
+# -- batch tier --------------------------------------------------------------
+
+
+def _worlds(sizes):
+    return [patterns.init_global(4, s, 1) for s in sizes]
+
+
+def _clean_batch(sizes, iters=6):
+    brt = GolBatchRuntime(worlds=_worlds(sizes), engine="auto")
+    _, boards = brt.run(iters)
+    return [np.asarray(b) for b in boards]
+
+
+def test_batch_guard_faultfree_matches_unguarded():
+    sizes = [64, 64, 96]
+    clean = _clean_batch(sizes)
+    brt = GolBatchRuntime(
+        worlds=_worlds(sizes), engine="auto", guard_every=2
+    )
+    _, boards = brt.run(6)
+    assert brt.last_guard.failures == 0
+    # one audit per world per chunk
+    assert brt.last_guard.checks == 3 * len(sizes)
+    assert all(np.array_equal(a, b) for a, b in zip(boards, clean))
+
+
+def test_batch_guard_rolls_back_only_the_corrupt_worlds_bucket():
+    # Two buckets (64² and 96²-padded); the flip lands in world 2 (the
+    # second bucket), so only that bucket replays.
+    sizes = [64, 64, 96]
+    clean = _clean_batch(sizes)
+    faults.install(_flip_plan(6, 0xA5, world=2))
+    brt = GolBatchRuntime(
+        worlds=_worlds(sizes), engine="auto", guard_every=2
+    )
+    _, boards = brt.run(6)
+    rep = brt.last_guard
+    assert rep.failures == 1 and rep.restores == 1
+    # The failed audit names world 2's generation; worlds 0/1 audited
+    # clean every chunk (3 chunks × 2 worlds) plus world 2's replay.
+    bad = [a for a in rep.audits if not a.ok]
+    assert len(bad) == 1 and bad[0].max_cell == 0xA5
+    assert all(np.array_equal(a, b) for a, b in zip(boards, clean))
+
+
+def test_batch_guard_redundant_catches_per_world_inrange_flip():
+    sizes = [64, 64]
+    clean = _clean_batch(sizes)
+    faults.install(_flip_plan(6, -1, world=1))
+    brt = GolBatchRuntime(
+        worlds=_worlds(sizes), engine="auto", guard_every=2,
+        guard_redundant=True,
+    )
+    _, boards = brt.run(6)
+    assert brt.last_guard.failures >= 1
+    bad = [a for a in brt.last_guard.audits if not a.ok]
+    assert bad and bad[0].redundant_fingerprint is not None
+    assert all(np.array_equal(a, b) for a, b in zip(boards, clean))
+
+
+def test_batch_guard_budget_exhaustion_names_world_and_bucket():
+    faults.install(
+        faults.FaultPlan.from_obj(
+            [dict(site="board.bitflip", at=2, value=0xA5, row=1, col=1,
+                  world=1, count=-1)]
+        )
+    )
+    brt = GolBatchRuntime(
+        worlds=_worlds([64, 64]), engine="auto", guard_every=2,
+        guard_max_restores=1,
+    )
+    with pytest.raises(guard_mod.GuardError, match="world 1"):
+        brt.run(6)
+
+
+def test_batch_guard_knob_validation():
+    with pytest.raises(ValueError, match="guard_every"):
+        GolBatchRuntime(worlds=_worlds([64]), guard_every=-1)
+    with pytest.raises(ValueError, match="requires"):
+        GolBatchRuntime(worlds=_worlds([64]), guard_redundant=True)
+    with pytest.raises(ValueError, match="second engine"):
+        # 48 does not pack into 32-bit words: a dense bucket with no
+        # bit-packed counterpart must refuse the redundant audit up
+        # front, not mid-run.
+        GolBatchRuntime(
+            worlds=_worlds([48]), engine="dense", guard_every=2,
+            guard_redundant=True,
+        )
+
+
+def test_batch_guard_checkpoints_only_audited_states(tmp_path):
+    from gol_tpu.utils import checkpoint as ckpt
+
+    sizes = [64, 64]
+    clean = _clean_batch(sizes)
+    faults.install(_flip_plan(4, 0xA5, world=0))
+    brt = GolBatchRuntime(
+        worlds=_worlds(sizes), engine="auto", guard_every=2,
+        checkpoint_every=2, checkpoint_dir=str(tmp_path / "ck"),
+    )
+    _, boards = brt.run(6)
+    assert brt.last_guard.failures == 1
+    assert all(np.array_equal(a, b) for a, b in zip(boards, clean))
+    snaps = ckpt.list_snapshots(str(tmp_path / "ck"), kind="batch")
+    assert snaps
+    for s in snaps:
+        ckpt.verify_snapshot(s)
+    # The gen-4 snapshot was written AFTER the failed audit's replay:
+    # it must hold the clean world, not the corrupted candidate.
+    snap4 = [s for s in snaps if "000000000004" in s]
+    assert snap4
+    loaded = ckpt.load_batch(snap4[0])
+    assert int(loaded.boards[0].max()) <= 1
+
+
+# -- pipelined shard mode ----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "mesh_kind,engine,depth",
+    [("1d", "bitpack", 2), ("1d", "dense", 4), ("2d", "dense", 2)],
+)
+def test_pipeline_guard_flip_on_one_shard_recovers(mesh_kind, engine, depth):
+    """Injected flip lands on one shard; the audit scalars replicate,
+    every shard takes the same rollback, and the final grid is
+    byte-identical to the clean run — the carried (block, bands) pair
+    is rebuilt from the restored board by the chunk program's prologue
+    exchange."""
+    clean = _clean(size=128)
+    faults.install(_flip_plan(6, 0xA5))
+    board, report = _guarded(
+        engine, size=128, mesh=build_mesh(mesh_kind),
+        shard_mode="pipeline", halo_depth=depth,
+    )
+    assert report.failures >= 1 and report.restores >= 1
+    assert np.array_equal(board, clean)
+
+
+def test_pipeline_guard_redundant_inrange_2d():
+    clean = _clean(size=128)
+    faults.install(_flip_plan(6, -1))
+    board, report = _guarded(
+        "dense", size=128, mesh=build_mesh("2d"),
+        shard_mode="pipeline", halo_depth=2, redundant=True,
+    )
+    assert report.failures >= 1
+    assert np.array_equal(board, clean)
+
+
+def test_pipeline_guard_faultfree_matches_explicit():
+    board, report = _guarded(
+        "bitpack", size=128, mesh=build_mesh("1d"),
+        shard_mode="pipeline", halo_depth=4,
+    )
+    assert report.failures == 0
+    assert np.array_equal(board, _clean(size=128))

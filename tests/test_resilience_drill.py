@@ -7,9 +7,12 @@ child is:
 1. **SIGTERM'd at a random chunk** (after a random 1–3 checkpoints have
    landed) — it must exit 75 with a final boundary checkpoint;
 2. relaunched, then **SIGKILL'd mid-checkpoint-write** — the drill holds
-   the tmp→rename window open with ``GOL_CKPT_TEST_WRITE_DELAY`` and
-   fires the moment a ``.tmp.npz`` appears, so the kill lands inside an
-   actual write and leaves a torn tmp on disk;
+   the tmp→rename window open with a ``checkpoint.rename_delay`` fault
+   plan entry (``GOL_FAULT_PLAN``, inherited by every supervised child;
+   the old ``GOL_CKPT_TEST_WRITE_DELAY`` env var remains a documented
+   alias, pinned by tests/test_faults.py) and fires the moment a
+   ``.tmp.npz`` appears, so the kill lands inside an actual write and
+   leaves a torn tmp on disk;
 3. relaunched again and left to finish.
 
 The assertion is the whole point of the tier: the final dump is
@@ -40,7 +43,17 @@ def _env(write_delay=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
     if write_delay is not None:
-        env["GOL_CKPT_TEST_WRITE_DELAY"] = str(write_delay)
+        # The rename-gap hook as a declarative fault-plan entry
+        # (armed on every attempt and every save — the kill window
+        # must stay open whichever relaunch the SIGKILL phase hits).
+        env["GOL_FAULT_PLAN"] = json.dumps(
+            {
+                "faults": [
+                    {"site": "checkpoint.rename_delay",
+                     "delay_s": write_delay, "count": -1, "attempts": -1}
+                ]
+            }
+        )
     return env
 
 

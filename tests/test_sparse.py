@@ -303,14 +303,17 @@ def test_cli_activity_flag_validation(capsys):
         == 255
     )
     assert "--engine activity" in capsys.readouterr().out
+    # --engine activity + --guard-every is now a supported combination
+    # (PR 10, docs/RESILIENCE.md "Guard coverage"): a guarded run
+    # completes with an audit trail instead of a rejection.
     assert (
         cli.main(
             ["0", "64", "8", "512", "0", "--engine", "activity",
              "--guard-every", "4"]
         )
-        == 255
+        == 0
     )
-    assert "unguarded" in capsys.readouterr().out
+    assert "GUARD" in capsys.readouterr().out
     assert (
         cli.main(
             ["0", "64", "8", "512", "0", "--engine", "activity",
@@ -362,15 +365,22 @@ def test_activity_sharded_rejections():
         )
 
 
-def test_guard_rejects_activity_runtime():
+def test_guard_composes_with_activity_runtime():
+    """PR 10 lifted the activity-tier guard rejection: a guarded
+    fault-free activity run audits clean and stays bit-identical to the
+    dense tier (the full flip/rollback coverage lives in
+    tests/test_guard_tiers.py)."""
     from gol_tpu.utils import guard as guard_mod
 
+    ref = GolRuntime(geometry=Geometry(size=64, num_ranks=1), engine="dense")
+    _, ref_state = ref.run(pattern=4, iterations=8)
     rt = GolRuntime(geometry=Geometry(size=64, num_ranks=1), engine="activity")
-    with pytest.raises(ValueError, match="unguarded"):
-        guard_mod.run_guarded(
-            rt, pattern=4, iterations=8,
-            config=guard_mod.GuardConfig(check_every=4),
-        )
+    _, state, report = guard_mod.run_guarded(
+        rt, pattern=4, iterations=8,
+        config=guard_mod.GuardConfig(check_every=4),
+    )
+    assert report.failures == 0 and report.checks == 2
+    assert np.array_equal(np.asarray(state.board), np.asarray(ref_state.board))
 
 
 # -- mask unit properties ----------------------------------------------------

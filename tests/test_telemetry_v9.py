@@ -1,0 +1,154 @@
+"""Schema v9 (fault-plane events) + v1–v8 back-compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..8}.py.
+Here:
+
+- the v9 additions round-trip: ``fault`` records one fired injection of
+  the declarative fault plan, ``degraded`` one containment decision
+  (docs/RESILIENCE.md);
+- a REAL faulted guarded run emits ``fault`` records alongside the
+  failing ``guard_audit`` it caused, through the run loops' drain;
+- **back-compat**: ALL EIGHT committed fixtures — PR 2 (v1) through
+  PR 10 (v8, a real pipelined run with halo blocks) — still load, and a
+  directory holding v1–v8 + a fresh v9 stream merges and renders in one
+  ``summarize`` pass (exit 0) with the fault line and the degraded
+  anomaly, while a bogus schema still exits 2.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+}
+
+
+def _v9_stream(directory, run_id="v9"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "2d", "engine": "bitpack",
+             "resolved_engine": "bitpack", "height": 64, "width": 64}
+        )
+        ev.compile_event(4, 0.01, 0.05)
+        ev.chunk_event(0, 4, 4, 0.002, 16384, None)
+        ev.fault_event(
+            "board.bitflip", 4, row=5, col=7, value=-1
+        )
+        ev.degraded_event(
+            "checkpoint", "retried", generation=4, attempt=1,
+            detail="injected transient checkpoint IO error",
+        )
+        return ev.path
+
+
+def test_v9_fault_degraded_roundtrip(tmp_path):
+    path = _v9_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 9
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 10))
+    fault = next(r for r in recs if r["event"] == "fault")
+    assert fault["site"] == "board.bitflip" and fault["generation"] == 4
+    deg = next(r for r in recs if r["event"] == "degraded")
+    assert deg["resource"] == "checkpoint" and deg["action"] == "retried"
+
+
+def test_real_faulted_guarded_run_stamps_v9_records(tmp_path):
+    """End to end: a guarded run with an armed fault plan drains the
+    fired injection into a ``fault`` record, next to the failing audit."""
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.resilience import faults
+    from gol_tpu.runtime import GolRuntime
+    from gol_tpu.utils import guard as guard_mod
+
+    faults.install(
+        faults.FaultPlan.from_obj(
+            [{"site": "board.bitflip", "at": 6, "row": 5, "col": 7,
+              "value": 165}]
+        )
+    )
+    try:
+        rt = GolRuntime(
+            geometry=Geometry(size=64, num_ranks=1),
+            engine="bitpack",
+            telemetry_dir=str(tmp_path),
+            run_id="faulted",
+        )
+        _, _, report = guard_mod.run_guarded(
+            rt, pattern=4, iterations=6,
+            config=guard_mod.GuardConfig(check_every=2),
+        )
+    finally:
+        faults.clear()
+    assert report.failures >= 1
+    recs = [
+        json.loads(ln) for ln in open(tmp_path / "faulted.rank0.jsonl")
+    ]
+    fault = [r for r in recs if r["event"] == "fault"]
+    assert fault and fault[0]["site"] == "board.bitflip"
+    assert any(
+        r["event"] == "guard_audit" and not r["ok"] for r in recs
+    )
+
+
+def test_committed_fixture_schemas_are_v1_to_v8():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v8_fixture_is_a_real_pipelined_run():
+    recs = [json.loads(ln) for ln in FIXTURES[8].open()]
+    head = recs[0]
+    assert head["config"]["shard_mode"] == "pipeline"
+    assert head["config"]["halo_depth"] == 4
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert chunks
+    for c in chunks:
+        assert c["halo"]["mode"] == "pipeline"
+        assert c["halo"]["depth"] == 4
+
+
+def test_v1_to_v9_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v9_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "v9",
+    ):
+        assert run_id in out
+    assert "faults: 1 injection(s) fired" in out
+    assert "degraded: checkpoint retried" in out
+
+
+def test_bogus_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": 99, "run_id": "bad",
+             "process_index": 0, "process_count": 1, "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
