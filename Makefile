@@ -1,12 +1,13 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
-# smoke + halo smoke + chaos smoke + tier-1 tests (see scripts/check.sh).
+# smoke + halo smoke + chaos smoke + serve smoke + tier-1 tests
+# (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
-	chaos-smoke chaos-matrix
+	chaos-smoke chaos-matrix serve-smoke servebench
 
 lint:
 	bash scripts/lint.sh
@@ -105,6 +106,18 @@ chaos-smoke:
 chaos-matrix:
 	JAX_PLATFORMS=cpu python -m gol_tpu.resilience chaos \
 	    --plan tests/data/fault_plans/chaos_matrix.json
+
+# Serving-tier smoke (docs/SERVING.md): a supervised server crashed
+# mid-batch completes every accepted request exactly once from the
+# journal, byte-equal to the sequential oracle; then a SIGTERM drain.
+serve-smoke:
+	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# Open-loop serving load curve -> SERVE_r{N}.json (CPU: admission /
+# queue dynamics; the TPU headline command is pinned in the note).
+servebench:
+	JAX_PLATFORMS=cpu python benchmarks/servebench.py \
+	    --rates 4,16,64,400,2000 --requests 48 --generations 24 --round 1
 
 check:
 	bash scripts/check.sh

@@ -1,0 +1,216 @@
+"""servebench — open-loop load curve for the serving tier.
+
+Method (docs/SERVING.md "Measuring the tier"): start a REAL server — the
+HTTP front end, the continuous-batching scheduler, the journal — on an
+ephemeral localhost port, then for each offered request rate submit N
+small worlds open-loop (fixed spacing, never waiting for completions —
+the honest way to expose queueing) and record what the tier actually
+did: how many were admitted vs explicitly rejected (429 backpressure is
+a *feature* being measured, not an error), the achieved completion
+rate, and the p50/p99 end-to-end latency from the server's own
+``latency_s`` stamps.  The queue-depth trace is sampled during the
+submission window; its max shows how deep the bounded buffer actually
+ran.
+
+The committed artifact (SERVE_rNN.json at the repo root) carries the
+ledger header so ``python -m gol_tpu.telemetry ledger ingest`` routes it
+(tool=servebench): each row lands as one throughput record (req/s,
+higher-is-better) and one latency record (p99 seconds,
+lower-is-better), so ``ledger check`` gates p99 regressions on TPU
+rounds the same way it gates cell rates.
+
+CPU rounds pin the curve SHAPE (admission behavior, queue dynamics);
+the TPU headline row is the note's pinned command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import threading
+import time
+from typing import Optional, Sequence
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct-script invocation from anywhere
+    sys.path.insert(0, str(REPO))
+
+
+def _percentile(sorted_vals, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def run_curve(
+    rates: Sequence[float],
+    n_requests: int,
+    size: int,
+    generations: int,
+    slots: int,
+    queue_depth: int,
+    chunk: int,
+    workdir: str,
+) -> list:
+    from gol_tpu.serve.client import Backpressure, SimClient
+    from gol_tpu.serve.scheduler import ServeScheduler
+    from gol_tpu.serve.server import ServeServer
+
+    rows = []
+    for r_i, rate in enumerate(rates):
+        state = str(pathlib.Path(workdir) / f"rate{r_i}")
+        sched = ServeScheduler(
+            state, slots=slots, queue_depth=queue_depth, chunk=chunk,
+        )
+        srv = ServeServer(sched, 0)
+        stop = threading.Event()
+
+        def loop():
+            while not (stop.is_set() and sched.outstanding() == 0):
+                if not sched.run_once():
+                    time.sleep(0.001)
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        client = SimClient(f"http://127.0.0.1:{srv.port}")
+        gap = 1.0 / rate
+        accepted, rejected = [], 0
+        max_queue = 0
+        stats_lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def submit_one(i: int) -> None:
+            # Open loop: the schedule, not the server, decides when each
+            # request goes out.  A pool of submitters keeps that true
+            # past the point where one client's HTTP round-trip would
+            # silently turn the bench closed-loop.
+            nonlocal rejected, max_queue
+            target = t0 + i * gap
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            rid = f"b{r_i}-{i}"
+            try:
+                client.submit(
+                    {"id": rid, "pattern": 4, "size": size,
+                     "generations": generations}
+                )
+                with stats_lock:
+                    accepted.append(rid)
+            except Backpressure:
+                with stats_lock:
+                    rejected += 1
+            depth = sched._depths()["queue_depth"]
+            with stats_lock:
+                max_queue = max(max_queue, depth)
+
+        pool = min(16, max(1, int(rate * 0.05) or 1))
+        idx = iter(range(n_requests))
+
+        def worker():
+            for i in idx:  # shared iterator: each index submits once
+                submit_one(i)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(pool)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        submit_wall = time.perf_counter() - t0
+        for rid in accepted:
+            client.wait_for(rid, timeout_s=300.0)
+        wall = time.perf_counter() - t0
+        stop.set()
+        t.join(timeout=30.0)
+        srv.close()
+        sched.close()
+        lats = sorted(
+            sched.get_result(rid).result["latency_s"] for rid in accepted
+        )
+        rows.append(
+            {
+                "offered_rps": rate,
+                "submitted": n_requests,
+                "completed": len(accepted),
+                "rejected": rejected,
+                "achieved_rps": len(accepted) / wall if wall > 0 else 0.0,
+                "submit_window_s": round(submit_wall, 4),
+                "wall_s": round(wall, 4),
+                "p50_s": _percentile(lats, 0.50),
+                "p99_s": _percentile(lats, 0.99),
+                "max_queue_depth": max_queue,
+            }
+        )
+        print(
+            f"  offered {rate:>6.1f}/s  completed {len(accepted):>3} "
+            f"rejected {rejected:>3}  achieved "
+            f"{rows[-1]['achieved_rps']:.1f}/s  "
+            f"p50 {rows[-1]['p50_s']:.3f}s p99 {rows[-1]['p99_s']:.3f}s "
+            f"maxq {max_queue}"
+        )
+    return rows
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="servebench", description=__doc__)
+    ap.add_argument("--size", type=int, default=32)
+    ap.add_argument("--generations", type=int, default=8)
+    ap.add_argument(
+        "--rates", default="4,16,64", metavar="R1,R2,...",
+        help="offered request rates (req/s), one row each",
+    )
+    ap.add_argument("--requests", type=int, default=24,
+                    help="requests submitted per rate row")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--queue-depth", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--round", type=int, default=1)
+    ap.add_argument("--out", default=None)
+    ns = ap.parse_args(argv)
+
+    import tempfile
+
+    from gol_tpu.telemetry import ledger as ledger_mod
+
+    rates = [float(r) for r in ns.rates.split(",") if r]
+    workdir = tempfile.mkdtemp(prefix="servebench_")
+    rows = run_curve(
+        rates, ns.requests, ns.size, ns.generations, ns.slots,
+        ns.queue_depth, ns.chunk, workdir,
+    )
+    payload = dict(
+        header=ledger_mod.artifact_header("servebench"),
+        note=(
+            "open-loop serving-tier load curve (docs/SERVING.md). "
+            "Each row: N small worlds offered at a fixed rate to a real "
+            "HTTP server (ephemeral port, journal on tmpfs); completed "
+            "vs 429-rejected counts, achieved req/s over the full "
+            "drain, and p50/p99 end-to-end latency from the server's "
+            "latency_s stamps. CPU rounds pin the curve shape "
+            "(admission + queue dynamics); the TPU headline is: "
+            "python benchmarks/servebench.py --size 256 "
+            "--generations 64 --rates 16,64,256 --requests 96 "
+            "--slots 8 --queue-depth 16"
+        ),
+        size=ns.size,
+        generations=ns.generations,
+        slots=ns.slots,
+        queue_depth=ns.queue_depth,
+        chunk=ns.chunk,
+        requests_per_rate=ns.requests,
+        rows=rows,
+    )
+    out = ns.out or str(REPO / f"SERVE_r{ns.round:02d}.json")
+    pathlib.Path(out).write_text(json.dumps(payload, indent=1) + "\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

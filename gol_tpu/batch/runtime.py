@@ -21,7 +21,7 @@ per world to B sequential single-world runs, for every tier × mesh
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -54,6 +54,25 @@ class Bucket:
     @property
     def batch(self) -> int:
         return len(self.indices)
+
+
+def stack_worlds(
+    boards: Sequence[np.ndarray], shape: Tuple[int, int]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad per-world boards into one host ``[B, H, W]`` stack + the
+    true-extent vectors the masked programs take.  Shared by the batch
+    runtime's bucket stacks and the serve scheduler's slot stacks
+    (``gol_tpu/serve/scheduler.py``), so both tiers pad identically —
+    padding cells are dead zeros, which B3/S23 keeps dead, so the masked
+    programs are bit-exact regardless of the padding."""
+    H, W = shape
+    stack = np.zeros((len(boards), H, W), dtype=np.uint8)
+    hs = np.empty(len(boards), np.int32)
+    ws = np.empty(len(boards), np.int32)
+    for k, b in enumerate(boards):
+        stack[k, : b.shape[0], : b.shape[1]] = b
+        hs[k], ws[k] = b.shape
+    return stack, hs, ws
 
 
 def bucketize(
@@ -159,6 +178,12 @@ class GolBatchRuntime:
     guard_max_restores: int = 3
     guard_redundant: bool = False
     guard_redundant_every: int = 1
+    # Per-world completion callback ``(world_index, board, generation)``,
+    # invoked for every world at the final host crop — the hook the serve
+    # tier's continuous-batching scheduler generalizes into refilling a
+    # freed slot the moment a world finishes (gol_tpu/serve/scheduler.py;
+    # in a one-shot batch run all worlds share the final generation).
+    on_world_complete: Optional[Callable[[int, np.ndarray, int], None]] = None
 
     def __post_init__(self) -> None:
         if self.engine not in batch_engines.BATCH_ENGINES:
@@ -243,14 +268,9 @@ class GolBatchRuntime:
 
     def _stack(self, bucket: Bucket):
         """The bucket's padded device stack + true-extent vectors."""
-        H, W = bucket.shape
-        stack = np.zeros((bucket.batch, H, W), dtype=np.uint8)
-        hs = np.empty(bucket.batch, np.int32)
-        ws = np.empty(bucket.batch, np.int32)
-        for k, i in enumerate(bucket.indices):
-            b = self._boards[i]
-            stack[k, : b.shape[0], : b.shape[1]] = b
-            hs[k], ws[k] = b.shape
+        stack, hs, ws = stack_worlds(
+            [self._boards[i] for i in bucket.indices], bucket.shape
+        )
         mesh = self._bucket_mesh(bucket)
         if mesh is not None:
             sharding = batch_engines.batch_sharding(mesh)
@@ -898,6 +918,12 @@ class GolBatchRuntime:
             with sw.phase("init"):
                 for bucket_id, bucket in enumerate(self.buckets):
                     self._unstack(bucket, stacks[bucket_id][0])
+                if self.on_world_complete is not None:
+                    for bucket in self.buckets:
+                        for i in bucket.indices:
+                            self.on_world_complete(
+                                i, self._boards[i], self.generation
+                            )
             _drain_plane()
             report = sw.report(self._world_cells() * iterations)
             if events is not None:

@@ -5,7 +5,7 @@ recovery, from one committed plan file.
 of the grid the plan describes (docs/RESILIENCE.md "The chaos matrix"):
 for each *scenario* (a named list of fault-plan entries plus an
 expectation class) crossed with each *tier* (dense / bitpack / pallas /
-batch / activity / 3-D) and *mesh* (none / 1d / 2d), the runner
+batch / activity / 3-D / serve) and *mesh* (none / 1d / 2d), the runner
 
 1. computes the tier's **clean** final grid once (cached per cell),
 2. re-runs with the scenario's faults armed through the real CLI/runtime
@@ -54,7 +54,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-TIERS = ("dense", "bitpack", "pallas", "batch", "activity", "3d")
+TIERS = ("dense", "bitpack", "pallas", "batch", "activity", "3d", "serve")
 MESHES = ("none", "1d", "2d")
 KINDS = ("guard", "resume", "contain", "shed", "telemetry")
 
@@ -260,12 +260,55 @@ def _run_3d(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
     return _Outcome(out)
 
 
+def _run_serve(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
+    """One serving-tier cell: three same-bucket requests (the fault
+    plans' ``world`` axis = admission ordinal), all submitted BEFORE the
+    drive loop runs — the journal record sequence and the chunk schedule
+    are deterministic, so one committed plan file means one behavior.
+    Crash.exit drills need real process death and live in
+    scripts/serve_smoke.py; this cell covers the in-process plane
+    (board faults, journal IO faults, disk-full shedding, stalls)."""
+    from gol_tpu.serve.scheduler import ServeScheduler
+
+    state_dir = cfg.checkpoint_dir or os.path.join(
+        tempfile.mkdtemp(prefix="serve_", dir=workdir), "state"
+    )
+    sched = ServeScheduler(
+        state_dir,
+        slots=4,
+        queue_depth=8,
+        chunk=plan.guard_every,
+        guard=cfg.guard,
+        telemetry_dir=cfg.telemetry_dir,
+        run_id=cfg.run_id,
+    )
+    try:
+        ids = []
+        for i in range(3):
+            st = sched.submit(
+                {
+                    "id": f"w{i}",
+                    "pattern": _PATTERN,
+                    "size": plan.size,
+                    "generations": cfg.iterations,
+                }
+            )
+            ids.append(st.request.id)
+        sched.run_until_drained()
+        boards = [sched.result_board(rid) for rid in ids]
+        return _Outcome(boards, sched.guard_failures)
+    finally:
+        sched.close()
+
+
 def _run_cell(tier: str, mesh: str, plan: ChaosPlan, cfg: _RunCfg,
               workdir: str) -> _Outcome:
     if tier == "batch":
         return _run_batch(mesh, plan, cfg)
     if tier == "3d":
         return _run_3d(plan, cfg, workdir)
+    if tier == "serve":
+        return _run_serve(plan, cfg, workdir)
     engine = {"dense": "dense", "bitpack": "bitpack", "pallas": "pallas",
               "activity": "activity"}[tier]
     return _run_2d(engine, mesh, plan, cfg)
@@ -280,6 +323,8 @@ def _legal(tier: str, mesh: str) -> Optional[str]:
     if tier == "3d" and mesh != "none":
         return "the 3-D driver's mesh is its own (P,R,C) grid; the " \
                "chaos matrix drives it unsharded"
+    if tier == "serve" and mesh != "none":
+        return "the serve scheduler runs bucket groups unsharded (v1)"
     return None
 
 

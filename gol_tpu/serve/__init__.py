@@ -1,0 +1,39 @@
+"""The serving tier: a long-lived simulation server with continuous
+batching (docs/SERVING.md).
+
+``python -m gol_tpu.serve --state-dir DIR [--port P]`` runs a persistent
+rank-0 process that accepts simulation requests over local HTTP, admits
+them into the PR 5 batch size buckets, and **refills batch slots as
+individual worlds finish** — continuous batching.  The robustness plane
+is the point: bounded admission queues with explicit 429 backpressure,
+per-request deadlines cancelled at chunk boundaries, a crash-safe
+fsync'd request journal replayed by supervised restarts
+(``python -m gol_tpu.resilience supervise -- python -m gol_tpu.serve
+...``) so every accepted request completes exactly once, and per-bucket
+guard rollback so one poisoned request never replays another tenant's
+work.
+
+Layers: :mod:`.journal` (durability), :mod:`.scheduler` (admission +
+the chunk loop), :mod:`.server` (HTTP front end), :mod:`.client`
+(drill/bench client).
+"""
+
+from gol_tpu.serve.journal import Journal
+from gol_tpu.serve.scheduler import (
+    Rejected,
+    Request,
+    ServeScheduler,
+    ValidationError,
+    decode_board,
+    encode_board,
+)
+
+__all__ = [
+    "Journal",
+    "Rejected",
+    "Request",
+    "ServeScheduler",
+    "ValidationError",
+    "decode_board",
+    "encode_board",
+]

@@ -1,0 +1,163 @@
+"""``python -m gol_tpu.serve`` — run the simulation server.
+
+The process layout is deliberate: HTTP handler threads only touch the
+scheduler's locked admission surface; the device loop runs HERE, on the
+main thread, so guard escalations and injected ``crash.exit`` faults
+kill the process where the supervisor
+(``python -m gol_tpu.resilience supervise -- ...``) can restart it, and
+the journal replay re-admits everything in flight.
+
+Shutdown is graceful by construction: SIGTERM/SIGINT (or
+``POST /shutdown``) stop admissions and the loop finishes every
+committed request before exiting 0 — the supervisor reads that as a
+clean finish, not a crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m gol_tpu.serve",
+        description="long-lived Game of Life simulation server "
+        "(continuous batching; docs/SERVING.md)",
+    )
+    p.add_argument(
+        "--state-dir", required=True,
+        help="journal + results directory (the durability root; give "
+        "the SAME directory to every supervised restart)",
+    )
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="HTTP port on 127.0.0.1 (0 = ephemeral; printed at start)",
+    )
+    p.add_argument(
+        "--telemetry", default=None,
+        help="event-stream directory (default: <state-dir>/telemetry; "
+        "'none' disables)",
+    )
+    p.add_argument("--run-id", default=None)
+    p.add_argument(
+        "--slots", type=int, default=4,
+        help="batch slots per bucket group (default 4)",
+    )
+    p.add_argument(
+        "--queue-depth", type=int, default=8,
+        help="bounded admission queue per bucket; beyond this the "
+        "server answers 429 + Retry-After (default 8)",
+    )
+    p.add_argument(
+        "--chunk", type=int, default=4,
+        help="generations per compiled device chunk — the deadline / "
+        "refill / cancel granularity (default 4)",
+    )
+    p.add_argument(
+        "--bucket-quantum", type=int, default=64,
+        help="bucket size rounding quantum (default 64)",
+    )
+    p.add_argument(
+        "--engine", default="auto",
+        choices=["auto", "dense", "bitpack", "pallas_bitpack"],
+        help="default engine for requests that do not pick one",
+    )
+    p.add_argument(
+        "--no-guard", action="store_true",
+        help="disable per-chunk integrity audits (guard is on by "
+        "default: serve is multi-tenant, corruption must not cross "
+        "requests)",
+    )
+    p.add_argument("--guard-max-restores", type=int, default=3)
+    p.add_argument(
+        "--keep-journal-segments", type=int, default=2,
+        help="rotated journal segments kept by compaction GC",
+    )
+    p.add_argument(
+        "--compact-every", type=int, default=16,
+        help="journal compaction period, in completed requests",
+    )
+    p.add_argument(
+        "--fault-plan", default=None,
+        help="fault-injection plan (path or inline JSON; default: "
+        "the GOL_FAULT_PLAN environment variable)",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    ns = build_parser().parse_args(argv)
+
+    from gol_tpu.resilience import faults as faults_mod
+
+    try:
+        if ns.fault_plan:
+            faults_mod.install(faults_mod.FaultPlan.load(ns.fault_plan))
+        else:
+            faults_mod.install_from_env()
+    except faults_mod.FaultPlanError as e:
+        print(e)
+        return 255
+
+    from gol_tpu.serve.scheduler import ServeScheduler
+    from gol_tpu.serve.server import ServeServer
+    from gol_tpu.telemetry.metrics import MetricsRegistry
+
+    telemetry_dir = ns.telemetry
+    if telemetry_dir is None:
+        telemetry_dir = os.path.join(ns.state_dir, "telemetry")
+    elif telemetry_dir == "none":
+        telemetry_dir = None
+
+    registry = MetricsRegistry()
+    scheduler = ServeScheduler(
+        ns.state_dir,
+        quantum=ns.bucket_quantum,
+        slots=ns.slots,
+        queue_depth=ns.queue_depth,
+        chunk=ns.chunk,
+        guard=not ns.no_guard,
+        guard_max_restores=ns.guard_max_restores,
+        default_engine=ns.engine,
+        telemetry_dir=telemetry_dir,
+        run_id=ns.run_id,
+        registry=registry,
+        keep_journal_segments=ns.keep_journal_segments,
+        compact_every=ns.compact_every,
+    )
+    server = ServeServer(scheduler, ns.port, registry=registry)
+    stop = server.stop_event
+
+    def _graceful(signum, frame):
+        scheduler.drain()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+
+    print(
+        f"serve: listening on http://127.0.0.1:{server.port} "
+        f"(state {ns.state_dir})",
+        flush=True,
+    )
+    try:
+        while True:
+            if stop.is_set():
+                scheduler.drain()
+                if scheduler.outstanding() == 0:
+                    break
+            if not scheduler.run_once():
+                time.sleep(0.005)
+    finally:
+        server.close()
+        scheduler.close()
+    print("serve: drained; exiting", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""A tiny stdlib client for the serving tier (docs/SERVING.md).
+
+``urllib`` only — the client exists for the smoke drill, the chaos
+cells, and servebench, not as a product surface.  The one behavior that
+matters is **idempotent resubmission**: callers pass their own request
+``id``, and :meth:`SimClient.submit` retries connection errors (the
+server may be mid-supervised-restart) by resubmitting the same id —
+admission is exactly-once on the id, so a retry can never double-run a
+request.  429/503 rejections surface as :class:`Backpressure` with the
+server's ``retry_after`` hint.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class Backpressure(RuntimeError):
+    """The server explicitly rejected (429/503) — retry later."""
+
+    def __init__(
+        self, status: int, message: str, retry_after: Optional[float]
+    ) -> None:
+        super().__init__(message)
+        self.status = status
+        self.retry_after = retry_after
+
+
+class SimClient:
+    def __init__(self, base_url: str, timeout: float = 30.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None):
+        data = (
+            json.dumps(body).encode() if body is not None else None
+        )
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except json.JSONDecodeError:
+                payload = {"error": str(e)}
+            return e.code, payload
+
+    def submit(
+        self,
+        request: dict,
+        connect_retries: int = 0,
+        retry_delay_s: float = 0.5,
+    ) -> dict:
+        """POST /simulate.  ``connect_retries`` resubmits the same id
+        across connection drops (supervised restarts) — safe because
+        admission is idempotent on the id."""
+        attempt = 0
+        while True:
+            try:
+                status, payload = self._call(
+                    "POST", "/simulate", request
+                )
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if attempt >= connect_retries:
+                    raise
+                attempt += 1
+                time.sleep(retry_delay_s)
+                continue
+            if status in (200, 202):
+                return payload
+            if status in (429, 503):
+                raise Backpressure(
+                    status, payload.get("error", "rejected"),
+                    payload.get("retry_after"),
+                )
+            raise RuntimeError(
+                f"submit failed ({status}): {payload.get('error')}"
+            )
+
+    def result(self, request_id: str):
+        """GET /result/<id> -> (status_code, payload)."""
+        return self._call("GET", f"/result/{request_id}")
+
+    def wait_for(
+        self,
+        request_id: str,
+        timeout_s: float = 60.0,
+        poll_s: float = 0.05,
+        connect_retries: int = 0,
+    ) -> dict:
+        """Poll until the request reaches a terminal payload.  Connection
+        drops are tolerated up to ``connect_retries`` times total (the
+        supervised server may be restarting under an armed fault plan)."""
+        deadline = time.time() + timeout_s
+        drops = 0
+        while time.time() < deadline:
+            try:
+                status, payload = self.result(request_id)
+            except (urllib.error.URLError, ConnectionError, OSError):
+                drops += 1
+                if drops > connect_retries:
+                    raise
+                time.sleep(max(poll_s, 0.2))
+                continue
+            if status == 200:
+                return payload
+            if status == 404:
+                raise KeyError(f"server does not know {request_id!r}")
+            time.sleep(poll_s)
+        raise TimeoutError(
+            f"request {request_id!r} not terminal after {timeout_s}s"
+        )
+
+    def healthz(self) -> dict:
+        status, payload = self._call("GET", "/healthz")
+        if status != 200:
+            raise RuntimeError(f"healthz returned {status}")
+        return payload
+
+    def shutdown(self) -> None:
+        """Ask for a graceful drain (POST /shutdown)."""
+        self._call("POST", "/shutdown")

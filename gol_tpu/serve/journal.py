@@ -1,0 +1,236 @@
+"""The crash-safe request journal (docs/SERVING.md "The journal").
+
+A serving process that dies mid-batch must not lose a request it said
+yes to.  The journal is the serve tier's durability artifact — what the
+snapshot files are to a long simulation run: an append-only JSONL intent
+log in the server's state directory, one fsync'd record per lifecycle
+transition:
+
+- ``admit``    — the full request, the commitment.  Written BEFORE the
+  client hears 200/202: if the admit cannot be made durable, the request
+  is rejected, never half-accepted.
+- ``start``    — the request entered a batch slot (advisory: replay
+  re-runs *started* work from the initial pattern, which is exact —
+  Life is deterministic).
+- ``complete`` — the result file landed (its fingerprint rides along).
+- ``cancel``   — a deadline expired at a chunk boundary.
+
+Recovery is a pure fold over the records (:func:`replay`): admitted ids
+without a terminal record are re-admitted, completed ids are never run
+again (exactly-once), duplicate ``admit`` lines are idempotent (first
+wins — the id is the identity).  A torn tail — the artifact of a crash
+mid-append — is tolerated: an unparseable line was never acknowledged to
+anyone, so it simply does not count; :meth:`Journal.append` self-heals
+an unterminated tail before the next record so one torn write can never
+corrupt its successor.
+
+Fault plane: appends fire the ``checkpoint.*`` injection sites
+(:mod:`gol_tpu.resilience.faults`) with the record index as the
+generation axis — the same precedent as the telemetry site's
+records-written counter — so one declarative plan exercises torn journal
+appends, transient EIO, and disk-full shedding through the exact code
+path production takes.  Callers wrap :meth:`append` in
+:func:`gol_tpu.resilience.degrade.write_with_retry`.
+
+GC rides the retention discipline of the snapshot store
+(:mod:`gol_tpu.resilience.retention`): :meth:`Journal.compact` rewrites
+the live file to only-open intents with the checkpoint tmp+``os.replace``
+rename discipline, rotates the previous contents to ``journal.jsonl.<n>``,
+and keeps only the newest K rotated segments — never the live file.
+"""
+
+from __future__ import annotations
+
+import errno as errno_mod
+import glob
+import json
+import os
+import re
+import time
+from typing import Dict, Tuple
+
+from gol_tpu.resilience import faults as faults_mod
+
+RECORD_KINDS = ("admit", "start", "complete", "cancel")
+_SEGMENT_RE = re.compile(r"\.(\d+)$")
+
+
+class Journal:
+    """Append-only fsync'd request journal (one per server process)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "ab")
+        # Count existing records so the fault sites' generation axis
+        # keeps advancing across restarts, and heal a torn tail left by
+        # a crash mid-append (no trailing newline).
+        self._count = 0
+        self._torn_tail = False
+        if os.path.getsize(path):
+            with open(path, "rb") as f:
+                data = f.read()
+            self._count = data.count(b"\n")
+            self._torn_tail = not data.endswith(b"\n")
+
+    def append(self, rec: dict) -> None:
+        """Durably append one record; raises ``OSError`` on failure.
+
+        Callers wrap this in ``degrade.write_with_retry`` — a transient
+        EIO is retried under the same bounded budget as a checkpoint
+        write, persistent ENOSPC sheds (the scheduler stops admitting).
+        """
+        if rec.get("rec") not in RECORD_KINDS:
+            raise ValueError(f"unknown journal record kind: {rec!r}")
+        line = json.dumps(rec, sort_keys=True)
+        if self._torn_tail:
+            # Terminate the torn tail so it reads as one unparseable
+            # (= unacknowledged) line instead of corrupting this record.
+            self._f.write(b"\n")
+            self._torn_tail = False
+        spec = faults_mod.fire(
+            "checkpoint.torn_tmp", self._count, path=self.path
+        )
+        if spec is not None:
+            # A torn append: half the record, no newline, then the error
+            # a dying disk would raise.  The retry lands a clean record
+            # after the healed tail; replay skips the torn line.
+            self._f.write(line[: max(1, len(line) // 2)].encode())
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._torn_tail = True
+            raise OSError(
+                errno_mod.EIO, f"injected torn journal append: {self.path}"
+            )
+        spec = faults_mod.fire(
+            "checkpoint.io_error", self._count, path=self.path
+        )
+        if spec is not None:
+            raise OSError(
+                errno_mod.EIO,
+                f"injected transient journal IO error: {self.path}",
+            )
+        spec = faults_mod.fire(
+            "checkpoint.disk_full", self._count, path=self.path
+        )
+        if spec is not None:
+            raise OSError(
+                errno_mod.ENOSPC,
+                f"injected disk-full journal append: {self.path}",
+            )
+        self._f.write(line.encode() + b"\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._count += 1
+
+    # -- compaction / GC -----------------------------------------------------
+    def compact(self, keep_segments: int = 2) -> None:
+        """Rewrite the live journal to only-open intents; rotate + GC.
+
+        The rewrite uses the checkpoint discipline (tmp + fsync +
+        ``os.replace`` — a crash mid-compact leaves either the old or
+        the new journal, never a hybrid); the old contents rotate to
+        ``<path>.<n>`` and :func:`gc_segments` keeps the newest
+        ``keep_segments`` of those (the snapshot store's keep-newest-K
+        retention, applied to journal history — the live file is never
+        a GC candidate).
+        """
+        entries, _ = replay(self.path)
+        open_lines = [
+            json.dumps(e["admit"], sort_keys=True)
+            for e in entries.values()
+            if e["status"] in ("admitted", "started")
+        ]
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for line in open_lines:
+                f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        # Highest existing segment + 1 — never the first free gap: GC
+        # deletes low numbers, and reusing one would stamp the NEWEST
+        # history with the OLDEST-looking name (and GC it next round).
+        taken = [
+            int(m.group(1))
+            for p in glob.glob(self.path + ".*")
+            if (m := _SEGMENT_RE.search(p))
+        ]
+        n = max(taken, default=0) + 1
+        os.replace(self.path, f"{self.path}.{n}")
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._count = len(open_lines)
+        self._torn_tail = False
+        gc_segments(self.path, keep_segments)
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+def replay(path: str) -> Tuple[Dict[str, dict], int]:
+    """Fold a journal into per-request state: ``(entries, torn_lines)``.
+
+    ``entries`` maps request id -> ``{"admit": <admit record>,
+    "status": admitted|started|completed|cancelled, "terminal": <record>}``
+    in admission order.  Unparseable lines (torn appends — final OR
+    healed mid-file) were never acknowledged, so they are counted and
+    ignored; duplicate admits are idempotent; records for unknown ids
+    (their admit was torn) are dropped.
+    """
+    entries: Dict[str, dict] = {}
+    torn = 0
+    if not os.path.exists(path):
+        return entries, torn
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                torn += 1
+                continue
+            rid = rec.get("id")
+            kind = rec.get("rec")
+            if kind == "admit":
+                entries.setdefault(
+                    rid, {"admit": rec, "status": "admitted",
+                          "terminal": None}
+                )
+            elif rid in entries:
+                e = entries[rid]
+                if kind == "start" and e["status"] == "admitted":
+                    e["status"] = "started"
+                elif kind == "complete":
+                    e["status"] = "completed"
+                    e["terminal"] = rec
+                elif kind == "cancel":
+                    e["status"] = "cancelled"
+                    e["terminal"] = rec
+    return entries, torn
+
+
+def gc_segments(path: str, keep: int) -> None:
+    """Delete rotated ``<path>.<n>`` segments beyond the newest ``keep``
+    (highest n = newest; the live ``path`` itself is never touched)."""
+    segs = []
+    for p in glob.glob(path + ".*"):
+        m = _SEGMENT_RE.search(p)
+        if m:
+            segs.append((int(m.group(1)), p))
+    segs.sort(reverse=True)
+    for _, p in segs[max(keep, 0):]:
+        try:
+            os.remove(p)
+        except OSError:  # pragma: no cover - racing GC is best-effort
+            pass
+
+
+def record(kind: str, request_id: str, **fields) -> dict:
+    """Build one journal record (the single stamping site for ``t``)."""
+    return {"rec": kind, "id": request_id, "t": time.time(), **fields}

@@ -1,0 +1,865 @@
+"""The continuous-batching scheduler (docs/SERVING.md).
+
+One scheduler owns every in-flight simulation request of a serving
+process.  Requests land in *bucket groups* — the PR 5 size buckets
+(:func:`gol_tpu.batch.runtime.bucket_shape`) crossed with the resolved
+engine — and each group holds a fixed number of batch *slots*: one
+compiled masked program per (bucket, chunk size) steps all S slots
+together, empty slots carrying dead zero boards (B3/S23 keeps dead
+worlds dead, so padding slots is exact, not approximate).  When a
+world's generations run out, its slot is freed and **refilled from the
+bucket's queue at the same chunk boundary** — continuous batching, not
+drain-and-refill: a long request never holds the batch hostage for a
+short one.
+
+The robustness plane (the reason this tier exists):
+
+- **Admission control** — a bounded queue per bucket.  A full queue is
+  an explicit :class:`Rejected` (HTTP 429 + ``retry_after``), and the
+  shed order is the PR 10 fixed order: stats streaming is sacrificed at
+  the first backpressure signal, admissions are shed when the journal's
+  disk fills (persistent ENOSPC through
+  :func:`gol_tpu.resilience.degrade.write_with_retry`), and committed
+  in-flight work is **never** shed.
+- **Deadlines** — ``deadline_s`` is checked at chunk boundaries (queued
+  and running); an expired request is cancelled, journaled, and stamped
+  as a v10 ``deadline`` event.  Transient journal/result IO failures
+  retry under the same bounded ``write_with_retry`` budget as
+  checkpoint writes.
+- **Crash safety** — every transition rides the fsync'd journal
+  (:mod:`gol_tpu.serve.journal`); construction replays it and re-admits
+  every admitted-but-unfinished request (v10 ``requeue`` events), so a
+  supervised restart completes every accepted request exactly once.
+- **Guard isolation** — with ``guard=True`` every chunk of every group
+  is audited (:func:`gol_tpu.utils.guard.audit_worlds`); a failing
+  world rolls back and replays **only its own bucket group** from the
+  fingerprint-verified last-good stack (per-group ``replays`` counters
+  pin the isolation in tests).  ``board.bitflip`` specs target requests
+  by admission ordinal (``world`` = the Nth admitted request).
+
+Threading: one lock serializes :meth:`submit`/:meth:`get_result` (HTTP
+handler threads) against :meth:`run_once` (the drive loop).  The
+scheduler itself is synchronous — chaos cells and tests drive
+:meth:`run_until_drained` deterministically in-process; the HTTP server
+runs the same loop on its main thread (:mod:`gol_tpu.serve.server`).
+v1 runs groups unsharded (``mesh=None``) — cross-chip serving is a
+placement follow-up, not a semantics one.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gol_tpu.serve import journal as journal_mod
+
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+_ENGINES = ("auto", "dense", "bitpack", "pallas_bitpack")
+_RULE = "B3/S23"
+
+
+class ValidationError(ValueError):
+    """A request body is malformed (HTTP 400)."""
+
+
+class Rejected(RuntimeError):
+    """A valid request was not admitted (HTTP 429/503).
+
+    ``retry_after`` (seconds) is the backpressure hint the server
+    surfaces as the ``Retry-After`` header.
+    """
+
+    def __init__(
+        self, status: int, message: str,
+        retry_after: Optional[float] = None,
+    ) -> None:
+        super().__init__(message)
+        self.status = status
+        self.retry_after = retry_after
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One validated simulation request."""
+
+    id: str
+    pattern: int
+    size: int
+    generations: int
+    engine: str = "auto"
+    deadline_s: Optional[float] = None
+    stream_stats: bool = False
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class RequestState:
+    """Mutable lifecycle of one admitted request."""
+
+    def __init__(
+        self, request: Request, ordinal: int, board: np.ndarray
+    ) -> None:
+        self.request = request
+        self.ordinal = ordinal  # admission sequence — fault specs'
+        # ``world`` field targets this, stable across restarts (it rides
+        # the journal's admit record).
+        self.board = board  # current host board (initial pattern, then
+        # refreshed at membership changes / completion)
+        self.status = "queued"  # queued | running | done | expired
+        self.generation = 0
+        self.remaining = request.generations
+        self.submitted_t = time.time()
+        self.started_t: Optional[float] = None
+        self.result: Optional[dict] = None
+        self.stats: List[dict] = []
+        self.done = threading.Event()
+
+
+class _BucketGroup:
+    """One (padded shape × engine) compilation unit with S batch slots."""
+
+    def __init__(self, shape: Tuple[int, int], engine: str, slots: int):
+        self.shape = shape
+        self.engine = engine
+        self.label = f"{shape[0]}x{shape[1]}/{engine}"
+        self.slots: List[Optional[RequestState]] = [None] * slots
+        self.queue: collections.deque = collections.deque()
+        self.stack = None  # device [S, H, W] (None = rebuild from boards)
+        self.hs = None
+        self.ws = None
+        self.gens = 0  # cumulative generations this group stepped —
+        # the generation axis board.bitflip specs match against
+        self.last_good = None  # (device stack copy, [fingerprints])
+        self.replays = 0  # rollback-replays — the isolation counter
+
+
+class ServeScheduler:
+    """See module docstring.  ``state_dir`` holds journal + results."""
+
+    def __init__(
+        self,
+        state_dir: str,
+        quantum: int = 64,
+        slots: int = 4,
+        queue_depth: int = 8,
+        chunk: int = 4,
+        tile_hint: int = 512,
+        guard: bool = True,
+        guard_max_restores: int = 3,
+        default_engine: str = "auto",
+        telemetry_dir: Optional[str] = None,
+        run_id: Optional[str] = None,
+        registry=None,
+        keep_journal_segments: int = 2,
+        compact_every: int = 16,
+    ) -> None:
+        from gol_tpu.resilience import faults as faults_mod
+
+        if slots < 1 or queue_depth < 1 or chunk < 1 or quantum < 1:
+            raise ValueError(
+                "slots, queue_depth, chunk, and quantum must all be >= 1"
+            )
+        self.state_dir = state_dir
+        self.results_dir = os.path.join(state_dir, "results")
+        os.makedirs(self.results_dir, exist_ok=True)
+        self.quantum = quantum
+        self.slots = slots
+        self.queue_depth = queue_depth
+        self.chunk = chunk
+        self.tile_hint = tile_hint
+        self.guard = guard
+        self.guard_max_restores = guard_max_restores
+        self.default_engine = default_engine
+        self.keep_journal_segments = keep_journal_segments
+        self.compact_every = compact_every
+
+        self._lock = threading.RLock()
+        self._groups: Dict[tuple, _BucketGroup] = {}
+        self._requests: Dict[str, RequestState] = {}
+        self._next_ordinal = 0
+        self._seq = 0
+        self._chunk_index = 0
+        self._total_gens = 0
+        self._plan_on = faults_mod.active() is not None
+        self._draining = False
+        self._admissions_shed = False
+        self._journal_shed = False
+        self._stats_shed = False
+        self._completions_since_compact = 0
+        self.guard_failures = 0
+        self.admitted_total = 0
+        self.rejected_total = 0
+        self.completed_total = 0
+        self.cancelled_total = 0
+
+        self._registry = registry
+        self._events = None
+        if telemetry_dir:
+            from gol_tpu import telemetry as telemetry_mod
+
+            self._events = telemetry_mod.EventLog(
+                telemetry_dir, run_id=run_id, process_index=0
+            )
+            if registry is not None:
+                self._events.observer = registry.observe
+            self._events.run_header(
+                {
+                    "driver": "serve",
+                    "engine": default_engine,
+                    "bucket_quantum": quantum,
+                    "slots": slots,
+                    "queue_depth": queue_depth,
+                    "chunk": chunk,
+                    "guard": guard,
+                }
+            )
+            attempt = _restart_attempt()
+            if attempt > 0:
+                self._events.restart_event(attempt)
+
+        self._journal = journal_mod.Journal(
+            os.path.join(state_dir, "journal.jsonl")
+        )
+        self._replay_journal()
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, obj: dict) -> RequestState:
+        """Validate + admit one request dict; raises
+        :class:`ValidationError` (400) / :class:`Rejected` (429/503).
+        Re-submitting a known id is idempotent (the existing state is
+        returned — exactly-once rides the request id)."""
+        req = self._validate(obj)
+        with self._lock:
+            existing = self._requests.get(req.id)
+            if existing is not None:
+                return existing
+            if self._draining:
+                raise Rejected(503, "server is draining; not admitting")
+            if self._admissions_shed:
+                raise Rejected(
+                    503,
+                    "admissions shed: journal storage full "
+                    "(committed work still completes)",
+                    retry_after=30.0,
+                )
+            grp = self._group_for(req)
+            if len(grp.queue) >= self.queue_depth:
+                # PR 10 shed order: the first backpressure signal sheds
+                # stats streaming before anything else.
+                self._shed_stats(f"bucket {grp.label} queue full")
+                self.rejected_total += 1
+                self._emit(
+                    "reject", req.id, bucket=grp.label,
+                    reason="queue_full", **self._depths(),
+                )
+                raise Rejected(
+                    429,
+                    f"bucket {grp.label} queue full "
+                    f"({self.queue_depth} waiting)",
+                    retry_after=self._retry_after(grp),
+                )
+            ordinal = self._next_ordinal
+            rec = journal_mod.record(
+                "admit", req.id, request=req.to_dict(), ordinal=ordinal
+            )
+            if not self._journal_write(rec):
+                # The admit could not be made durable: this request was
+                # never committed, and no future one can be — shed
+                # admissions (in-flight committed work is untouched).
+                self._admissions_shed = True
+                self.rejected_total += 1
+                self._emit(
+                    "reject", req.id, reason="admissions_shed",
+                    **self._depths(),
+                )
+                raise Rejected(
+                    503,
+                    "admissions shed: journal storage full",
+                    retry_after=30.0,
+                )
+            self._next_ordinal = ordinal + 1
+            state = RequestState(req, ordinal, self._initial_board(req))
+            self._requests[req.id] = state
+            grp.queue.append(state)
+            self.admitted_total += 1
+            self._emit("admit", req.id, bucket=grp.label, **self._depths())
+            return state
+
+    def get_result(self, request_id: str) -> Optional[RequestState]:
+        with self._lock:
+            return self._requests.get(request_id)
+
+    def result_board(self, request_id: str) -> np.ndarray:
+        """Decode a completed request's board (tests/chaos cells)."""
+        state = self.get_result(request_id)
+        if state is None or state.result is None:
+            raise KeyError(f"no result for request {request_id!r}")
+        return decode_board(state.result["board"])
+
+    def drain(self) -> None:
+        """Stop admitting; the loop finishes everything committed."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def outstanding(self) -> int:
+        """Committed requests not yet in a terminal state."""
+        with self._lock:
+            return sum(
+                1
+                for s in self._requests.values()
+                if s.status in ("queued", "running")
+            )
+
+    # -- the drive loop ------------------------------------------------------
+    def run_once(self) -> bool:
+        """One scheduling round: expire deadlines, refill slots, step
+        every occupied group one chunk.  Returns whether device work ran
+        (False = idle; callers sleep)."""
+        with self._lock:
+            self._expire_deadlines()
+            self._refill()
+            did = False
+            for grp in list(self._groups.values()):
+                if any(s is not None for s in grp.slots):
+                    self._step_group(grp)
+                    did = True
+            self._drain_plane()
+            return did
+
+    def run_until_drained(self) -> None:
+        """Drive synchronously until nothing is queued or running."""
+        while self.outstanding():
+            if not self.run_once():
+                time.sleep(0.001)
+
+    def close(self) -> None:
+        with self._lock:
+            self._drain_plane()
+            self._journal.close()
+            if self._events is not None:
+                self._events.close()
+                self._events = None
+
+    # -- internals: admission ------------------------------------------------
+    def _validate(self, obj) -> Request:
+        from gol_tpu.models import patterns
+
+        if not isinstance(obj, dict):
+            raise ValidationError("request body must be a JSON object")
+        known = {
+            "id", "pattern", "size", "generations", "engine", "rule",
+            "deadline_s", "stream_stats", "wait",
+        }
+        unknown = set(obj) - known
+        if unknown:
+            raise ValidationError(
+                f"unknown request fields {sorted(unknown)} "
+                f"(known: {sorted(known)})"
+            )
+
+        def _int(name, minimum):
+            v = obj.get(name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < minimum:
+                raise ValidationError(
+                    f"{name!r} must be an integer >= {minimum}, got {v!r}"
+                )
+            return v
+
+        pattern = _int("pattern", 0)
+        size = _int("size", 1)
+        generations = _int("generations", 1)
+        try:
+            patterns.validate_pattern_size(pattern, size)
+        except ValueError as e:
+            raise ValidationError(str(e))
+        rule = obj.get("rule", _RULE)
+        if rule not in (None, _RULE):
+            raise ValidationError(
+                f"rule {rule!r} is not served; every engine implements "
+                f"{_RULE} (Conway) only"
+            )
+        engine = obj.get("engine", self.default_engine)
+        if engine not in _ENGINES:
+            raise ValidationError(
+                f"unknown engine {engine!r}; expected one of {_ENGINES}"
+            )
+        deadline_s = obj.get("deadline_s")
+        if deadline_s is not None and (
+            not isinstance(deadline_s, (int, float))
+            or isinstance(deadline_s, bool)
+            or deadline_s < 0
+        ):
+            raise ValidationError(
+                f"deadline_s must be a number >= 0, got {deadline_s!r}"
+            )
+        rid = obj.get("id")
+        if rid is None:
+            with self._lock:
+                self._seq += 1
+                rid = f"req-{os.getpid()}-{self._seq:06d}"
+        elif not isinstance(rid, str) or not _ID_RE.match(rid):
+            raise ValidationError(
+                f"id {rid!r} must match {_ID_RE.pattern} (it names the "
+                "journal/result entries)"
+            )
+        return Request(
+            id=rid, pattern=pattern, size=size, generations=generations,
+            engine=engine,
+            deadline_s=float(deadline_s) if deadline_s is not None else None,
+            stream_stats=bool(obj.get("stream_stats", False)),
+        )
+
+    def _initial_board(self, req: Request) -> np.ndarray:
+        from gol_tpu.models import patterns
+
+        return patterns.init_global(req.pattern, req.size, 1)
+
+    def _group_for(self, req: Request) -> _BucketGroup:
+        from gol_tpu.batch.runtime import (
+            Bucket, bucket_shape, resolve_bucket_engine,
+        )
+
+        shape = bucket_shape(req.size, req.size, self.quantum)
+        synthetic = Bucket(
+            shape=shape, indices=(0,),
+            masked=(req.size, req.size) != shape,
+        )
+        try:
+            name = resolve_bucket_engine(
+                req.engine, synthetic, [(req.size, req.size)]
+            )
+        except ValueError as e:
+            raise ValidationError(str(e))
+        if name == "pallas_bitpack":
+            # Serve groups always run the masked programs (slots hold
+            # mixed sizes and dead padding); the fused kernel has no
+            # masked form — same documented fallback as batch buckets.
+            name = "bitpack"
+        key = (shape[0], shape[1], name)
+        grp = self._groups.get(key)
+        if grp is None:
+            grp = _BucketGroup(shape, name, self.slots)
+            self._groups[key] = grp
+        return grp
+
+    def _retry_after(self, grp: _BucketGroup) -> float:
+        inflight = sum(1 for s in grp.slots if s is not None)
+        return round(0.1 * (len(grp.queue) + inflight) + 0.1, 3)
+
+    def _depths(self) -> dict:
+        return {
+            "queue_depth": sum(
+                len(g.queue) for g in self._groups.values()
+            ),
+            "inflight": sum(
+                1
+                for g in self._groups.values()
+                for s in g.slots
+                if s is not None
+            ),
+        }
+
+    # -- internals: durability ----------------------------------------------
+    def _journal_write(self, rec: dict) -> bool:
+        from gol_tpu.resilience import degrade as degrade_mod
+
+        if self._journal_shed:
+            return False
+        ok = degrade_mod.write_with_retry(
+            lambda: self._journal.append(rec),
+            what="journal",
+            shed_telemetry=self._shed_telemetry,
+        )
+        if not ok:
+            # Persistent ENOSPC: the journal goes read-only.  Committed
+            # requests keep running to completion (their results are
+            # still written best-effort) — the shed order never touches
+            # committed work.
+            self._journal_shed = True
+            self._admissions_shed = True
+        return ok
+
+    def _shed_telemetry(self, reason: str) -> None:
+        if self._events is not None:
+            self._events.request_shed("telemetry", reason)
+
+    def _shed_stats(self, reason: str) -> None:
+        if not self._stats_shed:
+            self._stats_shed = True
+            if self._events is not None:
+                self._events.degraded_event(
+                    "stats", "shed", detail=reason
+                )
+
+    def _write_result(self, payload: dict) -> None:
+        from gol_tpu.resilience import degrade as degrade_mod
+
+        path = os.path.join(
+            self.results_dir, f"{payload['id']}.json"
+        )
+
+        def _write():
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(payload, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+        # Same atomic-rename + bounded-retry discipline as snapshots; a
+        # shed (full disk) keeps the result in memory — it is still
+        # served, just not durable.
+        degrade_mod.write_with_retry(
+            _write, what="result", shed_telemetry=self._shed_telemetry
+        )
+
+    def _replay_journal(self) -> None:
+        """Re-admit every admitted-but-unfinished journal entry, load
+        completed results back, and never re-run a completed id."""
+        entries, torn = journal_mod.replay(self._journal.path)
+        for rid, entry in entries.items():
+            admit = entry["admit"]
+            try:
+                req = Request(**admit["request"])
+            except TypeError:
+                continue  # a foreign/unreadable admit record
+            ordinal = int(admit.get("ordinal", self._next_ordinal))
+            self._next_ordinal = max(self._next_ordinal, ordinal + 1)
+            if entry["status"] in ("completed", "cancelled"):
+                state = RequestState(req, ordinal, np.zeros((1, 1), np.uint8))
+                state.status = (
+                    "done" if entry["status"] == "completed" else "expired"
+                )
+                state.result = self._load_result(rid)
+                state.done.set()
+                self._requests[rid] = state
+                continue
+            state = RequestState(req, ordinal, self._initial_board(req))
+            self._requests[rid] = state
+            grp = self._group_for(req)
+            grp.queue.append(state)
+            self._emit("requeue", rid, bucket=grp.label, **self._depths())
+
+    def _load_result(self, rid: str) -> Optional[dict]:
+        path = os.path.join(self.results_dir, f"{rid}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    # -- internals: the chunk loop -------------------------------------------
+    def _expire_deadlines(self) -> None:
+        now = time.time()
+        for grp in self._groups.values():
+            kept = collections.deque()
+            while grp.queue:
+                state = grp.queue.popleft()
+                if self._expired(state, now):
+                    self._cancel(state, grp)
+                else:
+                    kept.append(state)
+            grp.queue = kept
+            for k, state in enumerate(grp.slots):
+                if state is not None and self._expired(state, now):
+                    grp.slots[k] = None
+                    grp.stack = None
+                    grp.last_good = None
+                    self._cancel(state, grp)
+
+    @staticmethod
+    def _expired(state: RequestState, now: float) -> bool:
+        d = state.request.deadline_s
+        return d is not None and (now - state.submitted_t) > d
+
+    def _cancel(self, state: RequestState, grp: _BucketGroup) -> None:
+        state.status = "expired"
+        payload = {
+            "id": state.request.id,
+            "status": "expired",
+            "reason": "deadline",
+            "deadline_s": state.request.deadline_s,
+            "generation": state.generation,
+            "generations": state.request.generations,
+        }
+        state.result = payload
+        self._write_result(payload)
+        self._journal_write(
+            journal_mod.record(
+                "cancel", state.request.id, reason="deadline",
+                generation=state.generation,
+            )
+        )
+        self.cancelled_total += 1
+        self._emit(
+            "deadline", state.request.id, bucket=grp.label,
+            generation=state.generation, **self._depths(),
+        )
+        state.done.set()
+
+    def _refill(self) -> None:
+        for grp in self._groups.values():
+            for k, slot in enumerate(grp.slots):
+                if slot is not None or not grp.queue:
+                    continue
+                state = grp.queue.popleft()
+                state.status = "running"
+                state.started_t = time.time()
+                grp.slots[k] = state
+                grp.stack = None  # membership changed: rebuild
+                grp.last_good = None
+                self._journal_write(
+                    journal_mod.record(
+                        "start", state.request.id, ordinal=state.ordinal
+                    )
+                )
+                self._emit(
+                    "start", state.request.id, bucket=grp.label,
+                    **self._depths(),
+                )
+
+    def _build_stack(self, grp: _BucketGroup) -> None:
+        import jax
+
+        from gol_tpu.batch.runtime import stack_worlds
+        from gol_tpu.utils.timing import force_ready
+
+        boards = [
+            s.board if s is not None else np.zeros(grp.shape, np.uint8)
+            for s in grp.slots
+        ]
+        stack, hs, ws = stack_worlds(boards, grp.shape)
+        grp.stack = jax.device_put(stack)
+        grp.hs = jax.device_put(hs)
+        grp.ws = jax.device_put(ws)
+        force_ready(grp.stack)
+        if self.guard:
+            from gol_tpu.utils import guard as guard_mod
+
+            audits = guard_mod.audit_worlds(grp.stack, grp.gens)
+            grp.last_good = (
+                guard_mod._device_copy(grp.stack),
+                [a.fingerprint for a in audits],
+            )
+
+    def _step_group(self, grp: _BucketGroup) -> None:
+        from gol_tpu.batch import engines as batch_engines
+        from gol_tpu.resilience import faults as faults_mod
+        from gol_tpu.utils import guard as guard_mod
+        from gol_tpu.utils.timing import force_ready
+
+        active = [
+            (k, s) for k, s in enumerate(grp.slots) if s is not None
+        ]
+        take = min(
+            self.chunk, min(s.remaining for _, s in active)
+        )
+        compiled = batch_engines.compiled_batch_evolver(
+            grp.engine, take, True, self.tile_hint, None
+        )
+        if grp.stack is None:
+            self._build_stack(grp)
+        world_ids = tuple(
+            s.ordinal if s is not None else -1 for s in grp.slots
+        )
+        gen_after = grp.gens + take
+        restores = 0
+        audits = None
+        while True:
+            t0 = time.perf_counter()
+            candidate = compiled(grp.stack, grp.hs, grp.ws)
+            force_ready(candidate)
+            wall = time.perf_counter() - t0
+            if self._plan_on:
+                candidate = faults_mod.apply_board_faults(
+                    candidate, gen_after, world_ids=world_ids
+                )
+            if not self.guard:
+                break
+            audits = guard_mod.audit_worlds(candidate, gen_after)
+            if self._events is not None:
+                for k, s in active:
+                    self._events.guard_event(
+                        audits[k], world=s.ordinal, bucket=grp.label,
+                        request_id=s.request.id,
+                    )
+            bad = [k for k, s in active if not audits[k].ok]
+            if not bad:
+                grp.last_good = (
+                    guard_mod._device_copy(candidate),
+                    [a.fingerprint for a in audits],
+                )
+                break
+            # Detection: roll back THIS group only, replay the chunk.
+            self.guard_failures += len(bad)
+            grp.replays += 1
+            restores += 1
+            if restores > self.guard_max_restores:
+                raise guard_mod.GuardError(
+                    f"serve bucket {grp.label}: corruption persisted "
+                    f"past {self.guard_max_restores} rollback-replays "
+                    "(persistent fault — crash-only: the supervisor "
+                    "restarts and the journal re-admits)"
+                )
+            base, fps = grp.last_good
+            restored = guard_mod._device_copy(base)
+            base_audits = guard_mod.audit_worlds(restored, grp.gens)
+            if [a.fingerprint for a in base_audits] != fps:
+                raise guard_mod.GuardError(
+                    f"serve bucket {grp.label}: rollback base failed "
+                    "fingerprint verification"
+                )
+            grp.stack = restored
+        grp.gens = gen_after
+        self._total_gens += take
+        grp.stack = candidate
+        for _, s in active:
+            s.remaining -= take
+            s.generation += take
+        if self._events is not None:
+            cells = sum(
+                s.request.size * s.request.size for _, s in active
+            )
+            self._events.chunk_event(
+                self._chunk_index, take, grp.gens, wall,
+                cells * take, None,
+                batch={
+                    "bucket": list(grp.shape),
+                    "B": len(grp.slots),
+                    "masked": True,
+                    "engine": grp.engine,
+                },
+            )
+        self._chunk_index += 1
+        if (
+            self.guard
+            and not self._stats_shed
+            and audits is not None
+        ):
+            for k, s in active:
+                if s.request.stream_stats:
+                    s.stats.append(
+                        {
+                            "generation": s.generation,
+                            "population": audits[k].population,
+                        }
+                    )
+        done = [(k, s) for k, s in active if s.remaining <= 0]
+        if done:
+            host = np.asarray(candidate)
+            for k, s in active:
+                n = s.request.size
+                s.board = host[k, :n, :n].copy()
+            for k, s in done:
+                grp.slots[k] = None
+                self._finish(s, grp)
+            grp.stack = None  # freed slots must read as dead zeros
+            grp.last_good = None
+        if self._plan_on:
+            faults_mod.crash_or_stall(self._total_gens)
+
+    def _finish(self, state: RequestState, grp: _BucketGroup) -> None:
+        from gol_tpu.utils import guard as guard_mod
+
+        fp = guard_mod.fingerprint_np(state.board)
+        latency = time.time() - state.submitted_t
+        payload = {
+            "id": state.request.id,
+            "status": "done",
+            "pattern": state.request.pattern,
+            "size": state.request.size,
+            "generations": state.request.generations,
+            "generation": state.generation,
+            "engine": grp.engine,
+            "bucket": grp.label,
+            "fingerprint": int(fp),
+            "population": int(state.board.sum()),
+            "latency_s": round(latency, 6),
+            "board": encode_board(state.board),
+        }
+        if state.request.stream_stats:
+            payload["stats"] = state.stats
+            payload["stats_shed"] = self._stats_shed
+        self._write_result(payload)
+        self._journal_write(
+            journal_mod.record(
+                "complete", state.request.id, fingerprint=int(fp),
+                generation=state.generation,
+            )
+        )
+        state.result = payload
+        state.status = "done"
+        self.completed_total += 1
+        self._emit(
+            "complete", state.request.id, bucket=grp.label,
+            latency_s=payload["latency_s"], generation=state.generation,
+            **self._depths(),
+        )
+        state.done.set()
+        self._completions_since_compact += 1
+        if (
+            self._completions_since_compact >= self.compact_every
+            and not self._journal_shed
+        ):
+            self._completions_since_compact = 0
+            try:
+                self._journal.compact(self.keep_journal_segments)
+            except OSError:  # full disk: the live journal still works
+                pass
+
+    # -- internals: telemetry ------------------------------------------------
+    def _emit(self, action: str, request_id: str, **extra) -> None:
+        if self._events is not None:
+            self._events.serve_event(action, request_id, **extra)
+        elif self._registry is not None:
+            self._registry.observe(
+                {
+                    "event": "serve", "t": time.time(),
+                    "action": action, "request_id": request_id,
+                    **extra,
+                }
+            )
+
+    def _drain_plane(self) -> None:
+        from gol_tpu.resilience import degrade as degrade_mod
+        from gol_tpu.resilience import faults as faults_mod
+
+        if self._events is None:
+            faults_mod.drain_fired()
+            degrade_mod.drain_reports()
+            return
+        for f in faults_mod.drain_fired():
+            self._events.fault_event(**f)
+        for d in degrade_mod.drain_reports():
+            self._events.degraded_event(**d)
+
+
+def encode_board(board: np.ndarray) -> List[str]:
+    """Rows of '0'/'1' characters — byte-comparable across transports."""
+    return ["".join("1" if c else "0" for c in row) for row in board]
+
+
+def decode_board(rows: List[str]) -> np.ndarray:
+    return np.array(
+        [[1 if c == "1" else 0 for c in row] for row in rows], np.uint8
+    )
+
+
+def _restart_attempt() -> int:
+    try:
+        return int(os.environ.get("GOL_RESTART_ATTEMPT", "0"))
+    except ValueError:
+        return 0

@@ -1,0 +1,196 @@
+"""The serving-tier HTTP front end (docs/SERVING.md).
+
+Stdlib only, same shape as the ``--metrics-port`` exporter
+(:class:`gol_tpu.telemetry.metrics.MetricsServer`): a
+``ThreadingHTTPServer`` bound to 127.0.0.1 runs on a daemon thread and
+its handler threads only ever call the scheduler's locked entry points
+(:meth:`submit` / :meth:`get_result`); the device loop stays on the
+process's main thread (:mod:`gol_tpu.serve.__main__`), so a guard
+escalation or an injected ``crash.exit`` dies where the supervisor can
+see it.
+
+Endpoints::
+
+    POST /simulate   {"pattern": 4, "size": 96, "generations": 50, ...}
+                     -> 200 result (``"wait": true``) or 202 ticket
+                     -> 400 malformed, 429 queue full (Retry-After),
+                        503 draining / admissions shed
+    GET  /result/ID  -> 200 terminal payload | 202 progress | 404
+    GET  /healthz    -> 200 {"ok": true, outstanding, draining}
+    GET  /metrics    -> Prometheus text (the gol_serve_* gauges)
+    POST /shutdown   -> 200, then graceful drain: stop admitting,
+                        finish every committed request, exit 0
+
+Backpressure is explicit, never silent: 429/503 carry a JSON ``error``
+plus ``retry_after`` (and the ``Retry-After`` header) — a well-behaved
+client resubmits the SAME id later and admission stays exactly-once.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from typing import Optional
+
+from gol_tpu.serve.scheduler import (
+    Rejected, ServeScheduler, ValidationError,
+)
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # Set on the per-server class copy by ServeServer:
+    scheduler: ServeScheduler
+    registry = None
+    stop_event: threading.Event
+
+    # -- plumbing ------------------------------------------------------------
+    def _json(
+        self, status: int, payload: dict,
+        retry_after: Optional[float] = None,
+    ) -> None:
+        body = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", "0"))
+        if length <= 0:
+            raise ValidationError("request body required")
+        raw = self.rfile.read(length)
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ValidationError(f"body is not valid JSON: {e}")
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+    # -- routes --------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            self._json(
+                200,
+                {
+                    "ok": True,
+                    "outstanding": self.scheduler.outstanding(),
+                    "draining": self.scheduler.draining,
+                },
+            )
+        elif path == "/metrics":
+            if self.registry is None:
+                self.send_error(404, "no metrics registry attached")
+                return
+            from gol_tpu.telemetry.metrics import CONTENT_TYPE
+
+            body = self.registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path.startswith("/result/"):
+            self._result(path[len("/result/"):])
+        else:
+            self.send_error(
+                404, "routes: /simulate /result/<id> /healthz /metrics"
+            )
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        path = self.path.rstrip("/")
+        if path == "/simulate":
+            self._simulate()
+        elif path == "/shutdown":
+            self.scheduler.drain()
+            self.stop_event.set()
+            self._json(200, {"ok": True, "draining": True})
+        else:
+            self.send_error(404, "POST routes: /simulate /shutdown")
+
+    def _simulate(self) -> None:
+        try:
+            body = self._body()
+            wait = bool(body.get("wait", False))
+            state = self.scheduler.submit(body)
+        except ValidationError as e:
+            self._json(400, {"error": str(e)})
+            return
+        except Rejected as e:
+            self._json(
+                e.status,
+                {"error": str(e), "retry_after": e.retry_after},
+                retry_after=e.retry_after,
+            )
+            return
+        if wait:
+            state.done.wait()
+        if state.result is not None:
+            self._json(200, state.result)
+        else:
+            self._json(
+                202,
+                {
+                    "id": state.request.id,
+                    "status": state.status,
+                    "generation": state.generation,
+                },
+            )
+
+    def _result(self, request_id: str) -> None:
+        state = self.scheduler.get_result(request_id)
+        if state is None:
+            self._json(404, {"error": f"unknown request {request_id!r}"})
+        elif state.result is not None:
+            self._json(200, state.result)
+        else:
+            self._json(
+                202,
+                {
+                    "id": request_id,
+                    "status": state.status,
+                    "generation": state.generation,
+                },
+            )
+
+
+class ServeServer:
+    """Threaded HTTP listener over one scheduler (127.0.0.1 only)."""
+
+    def __init__(
+        self, scheduler: ServeScheduler, port: int, registry=None
+    ) -> None:
+        self.stop_event = threading.Event()
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {
+                "scheduler": scheduler,
+                "registry": registry,
+                "stop_event": self.stop_event,
+            },
+        )
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="gol-serve-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

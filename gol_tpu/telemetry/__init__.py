@@ -55,7 +55,17 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 9 (this round) adds the fault-plane events
+# Version 10 (this round) adds the serving-tier event
+# (docs/SERVING.md): a ``serve`` record marks one request-lifecycle
+# transition of the continuous-batching scheduler
+# (:mod:`gol_tpu.serve`) — ``action`` is one of ``admit`` (journaled,
+# committed), ``start`` (placed into a batch slot), ``complete``
+# (result written; carries ``latency_s``), ``reject`` (backpressure 429
+# or admissions shed), ``deadline`` (cancelled at a chunk boundary), or
+# ``requeue`` (re-admitted from the journal after a restart) — with the
+# ``request_id`` and, where known, the ``bucket`` and live
+# ``queue_depth``/``inflight`` the metrics registry gauges ride on.
+# Version 9 added the fault-plane events
 # (docs/RESILIENCE.md): a ``fault`` record marks one fired injection of
 # the declarative fault plan (``--fault-plan`` / ``GOL_FAULT_PLAN``,
 # :mod:`gol_tpu.resilience.faults`) — the site name, the generation it
@@ -102,9 +112,9 @@ from typing import Dict, Optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
 # readable: every v1-v8 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5/v6/v7/v8 fixture tests).
-SCHEMA_VERSION = 9
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+# committed v1/v2/v3/v4/v5/v6/v7/v8/v9 fixture tests).
+SCHEMA_VERSION = 10
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -166,6 +176,11 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # ``resource`` names what degraded (checkpoint / telemetry),
     # ``action`` what was done about it (retried / shed / dropped).
     "degraded": frozenset({"resource", "action"}),
+    # v10: one request-lifecycle transition of the serving tier
+    # (gol_tpu/serve, docs/SERVING.md): ``action`` is admit / start /
+    # complete / reject / deadline / requeue; extras carry bucket,
+    # queue_depth, inflight, latency_s, generation.
+    "serve": frozenset({"action", "request_id"}),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -507,6 +522,13 @@ class EventLog:
         telemetry, ``action`` retried/shed/dropped; ``extra`` carries
         generation/errno/attempt detail."""
         self.emit("degraded", resource=resource, action=action, **extra)
+
+    def serve_event(self, action: str, request_id: str, **extra) -> None:
+        """One serving-tier request transition (v10): ``action`` is
+        admit/start/complete/reject/deadline/requeue; ``extra`` carries
+        bucket/queue_depth/inflight/latency_s/generation detail
+        (docs/SERVING.md)."""
+        self.emit("serve", action=action, request_id=request_id, **extra)
 
     def stats_event(
         self, index: int, take: int, generation: int, values: dict

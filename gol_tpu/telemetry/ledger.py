@@ -430,6 +430,55 @@ def _multichip_records(
     ]
 
 
+def _serve_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    """SERVE_r*.json (servebench): each offered-rate row lands as one
+    throughput record (achieved req/s, higher) and one latency record
+    (p99 seconds, lower) — so ``ledger check`` gates serving-tier p99
+    regressions exactly the way it gates cell rates."""
+    backend = (data.get("header") or {}).get("backend", "cpu")
+    shape = (
+        f"{data.get('size')}^2x{data.get('generations')}"
+        f":s{data.get('slots')}q{data.get('queue_depth')}"
+    )
+    out = []
+    for row in data.get("rows") or []:
+        label = f"serve:{backend}:{shape}:offered{row['offered_rps']:g}"
+        extra = {
+            "completed": row.get("completed"),
+            "rejected": row.get("rejected"),
+            "p50_s": row.get("p50_s"),
+            "max_queue_depth": row.get("max_queue_depth"),
+        }
+        out.append(
+            _record(
+                label,
+                row["achieved_rps"],
+                "req/s",
+                source,
+                "servebench",
+                backend,
+                round_=round_,
+                extra=extra,
+            )
+        )
+        if row.get("p99_s") is not None:
+            out.append(
+                _record(
+                    label + ":p99",
+                    row["p99_s"],
+                    "s",
+                    source,
+                    "servebench",
+                    backend,
+                    kind="latency",
+                    direction="lower",
+                    round_=round_,
+                    extra=extra,
+                )
+            )
+    return out
+
+
 _TOOL_ADAPTERS = {
     "bench": _bench_records,
     "batchbench": _batch_records,
@@ -437,6 +486,7 @@ _TOOL_ADAPTERS = {
     "halobench": _halo_records,
     "scalebench": _scale_records,
     "dryrun_multichip": _multichip_records,
+    "servebench": _serve_records,
 }
 
 

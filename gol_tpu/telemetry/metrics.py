@@ -32,6 +32,17 @@ Exported metrics (all ``gol_``-prefixed)::
     gol_run_finished              1 after the summary record (gauge)
     gol_updates_per_sec_final     the summary's headline (gauge)
 
+Serving-tier metrics (schema v10, emitted only once a ``serve`` event
+has been observed — docs/SERVING.md)::
+
+    gol_serve_queue_depth             queued requests, all buckets (gauge)
+    gol_serve_inflight_worlds         requests in batch slots (gauge)
+    gol_serve_admitted_total          journaled admissions (counter)
+    gol_serve_rejected_total          429/503 rejections (counter)
+    gol_serve_completed_total         results written (counter)
+    gol_serve_deadline_total          chunk-boundary cancels (counter)
+    gol_serve_request_seconds_*       admit→complete latency histogram
+
 Purity: the registry runs strictly host-side inside the emission path,
 which itself runs after the ``force_ready`` fences — the trace-identity
 pin covers metrics-on vs -off (tests/test_metrics.py).
@@ -42,6 +53,12 @@ from __future__ import annotations
 import http.server
 import threading
 from typing import Dict, Optional
+
+
+#: Upper bounds (seconds) of the serve request-latency histogram —
+#: small-world simulation requests on a warm scheduler land in the
+#: sub-second buckets; the top buckets catch queueing under load.
+SERVE_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
 
 
 class MetricsRegistry:
@@ -68,6 +85,23 @@ class MetricsRegistry:
         self.restart_attempt = 0
         self.finished = False
         self.updates_per_sec_final: Optional[float] = None
+        # Serving tier (schema v10): gauges track the scheduler's own
+        # queue_depth/inflight stamps (authoritative — rejects and
+        # requeues make pure event counting lie), counters count
+        # lifecycle transitions, and the latency histogram buckets the
+        # admit→complete seconds of every completed request.
+        self.serve_seen = False
+        self.serve_queue_depth = 0
+        self.serve_inflight = 0
+        self.serve_admitted_total = 0
+        self.serve_rejected_total = 0
+        self.serve_completed_total = 0
+        self.serve_deadline_total = 0
+        self.serve_latency_buckets: Dict[float, int] = {
+            le: 0 for le in SERVE_LATENCY_BUCKETS
+        }
+        self.serve_latency_sum = 0.0
+        self.serve_latency_count = 0
 
     # -- write side (EventLog observer) -------------------------------------
     def observe(self, rec: dict) -> None:
@@ -101,6 +135,28 @@ class MetricsRegistry:
             elif event == "summary":
                 self.finished = True
                 self.updates_per_sec_final = rec["updates_per_sec"]
+            elif event == "serve":
+                self.serve_seen = True
+                action = rec.get("action")
+                if action in ("admit", "requeue"):
+                    self.serve_admitted_total += 1
+                elif action == "reject":
+                    self.serve_rejected_total += 1
+                elif action == "complete":
+                    self.serve_completed_total += 1
+                    lat = rec.get("latency_s")
+                    if isinstance(lat, (int, float)):
+                        self.serve_latency_sum += lat
+                        self.serve_latency_count += 1
+                        for le in self.serve_latency_buckets:
+                            if lat <= le:
+                                self.serve_latency_buckets[le] += 1
+                elif action == "deadline":
+                    self.serve_deadline_total += 1
+                if "queue_depth" in rec:
+                    self.serve_queue_depth = rec["queue_depth"]
+                if "inflight" in rec:
+                    self.serve_inflight = rec["inflight"]
 
     # -- read side (HTTP) ----------------------------------------------------
     def render(self) -> str:
@@ -189,6 +245,57 @@ class MetricsRegistry:
                     "gol_updates_per_sec_final", "gauge",
                     "The run summary's headline cell-updates/s.",
                     self.updates_per_sec_final,
+                )
+            if self.serve_seen:
+                metric(
+                    "gol_serve_queue_depth", "gauge",
+                    "Queued requests across all serve buckets (v10).",
+                    self.serve_queue_depth,
+                )
+                metric(
+                    "gol_serve_inflight_worlds", "gauge",
+                    "Requests currently occupying batch slots.",
+                    self.serve_inflight,
+                )
+                metric(
+                    "gol_serve_admitted_total", "counter",
+                    "Journaled admissions (requeues included).",
+                    self.serve_admitted_total,
+                )
+                metric(
+                    "gol_serve_rejected_total", "counter",
+                    "Requests rejected by backpressure or shed.",
+                    self.serve_rejected_total,
+                )
+                metric(
+                    "gol_serve_completed_total", "counter",
+                    "Requests completed with a written result.",
+                    self.serve_completed_total,
+                )
+                metric(
+                    "gol_serve_deadline_total", "counter",
+                    "Requests cancelled at a chunk boundary by deadline.",
+                    self.serve_deadline_total,
+                )
+                lines.append(
+                    "# HELP gol_serve_request_seconds Admit-to-complete "
+                    "request latency (v10)."
+                )
+                lines.append("# TYPE gol_serve_request_seconds histogram")
+                for le, n in sorted(self.serve_latency_buckets.items()):
+                    lines.append(
+                        f'gol_serve_request_seconds_bucket{{le="{le}"}} {n}'
+                    )
+                lines.append(
+                    'gol_serve_request_seconds_bucket{le="+Inf"} '
+                    f"{self.serve_latency_count}"
+                )
+                lines.append(
+                    f"gol_serve_request_seconds_sum {self.serve_latency_sum}"
+                )
+                lines.append(
+                    f"gol_serve_request_seconds_count "
+                    f"{self.serve_latency_count}"
                 )
             return "\n".join(lines) + "\n"
 

@@ -634,6 +634,37 @@ def render_run(run: Run, out) -> None:
             file=out,
         )
 
+    serves = run.records("serve", rank=rank0)
+    if serves:
+        # Schema v10 (docs/SERVING.md): the serving tier's request
+        # lifecycle — distinct ids that were committed (admit/requeue)
+        # next to the per-transition counts, so an exactly-once miss
+        # (completes != admitted ids) is visible from the stream alone.
+        by_action: Dict[str, int] = {}
+        committed = set()
+        for r in serves:
+            by_action[r["action"]] = by_action.get(r["action"], 0) + 1
+            if r["action"] in ("admit", "requeue"):
+                committed.add(r["request_id"])
+        detail = ", ".join(
+            f"{n} {a}" for a, n in sorted(by_action.items())
+        )
+        lats = [
+            r["latency_s"]
+            for r in serves
+            if r["action"] == "complete" and r.get("latency_s") is not None
+        ]
+        lat = (
+            f"  (median latency {statistics.median(lats):.3f}s)"
+            if lats
+            else ""
+        )
+        print(
+            f"  serve: {len(committed)} request(s) committed — "
+            f"{detail}{lat}",
+            file=out,
+        )
+
     benches = run.records("bench_row")
     if benches:
         for b in benches:

@@ -35,7 +35,11 @@
 #                       bit-flip + torn-write + ENOSPC through a small
 #                       guarded batch run — detected, contained, and
 #                       recovered byte-equal; docs/RESILIENCE.md)
-#  12. tier-1 tests    (the exact ROADMAP.md command)
+#  12. serve smoke     (serving tier, docs/SERVING.md: supervised
+#                       crash mid-batch + journal re-admit — every
+#                       accepted request completes exactly once,
+#                       byte-equal — then a SIGTERM graceful drain)
+#  13. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
@@ -81,10 +85,13 @@ JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 echo "== [10/12] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/12] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+echo "== [11/13] chaos smoke (docs/RESILIENCE.md, fault plane) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
-echo "== [12/12] tier-1 tests =="
+echo "== [12/13] serve smoke (docs/SERVING.md, serving tier) =="
+JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+echo "== [13/13] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,269 @@
+"""Serve-smoke gate: the serving tier's two process-level drills.
+
+The check.sh stage for docs/SERVING.md.  Everything in-process is
+covered by tests/test_serve.py and the chaos matrix's serve cells; this
+script exercises what needs REAL process death:
+
+**Phase A — supervised crash drill.**  A server under
+``python -m gol_tpu.resilience supervise`` with an armed fault plan:
+``crash.exit`` kills the process mid-batch (attempt 0 only), a
+``board.bitflip`` poisons one request's world on the relaunch (the
+guard must catch and replay it), and a transient journal ``io_error``
+exercises the bounded retry under restart.  A client submits three
+mixed-size requests, tolerating connection drops by resubmitting the
+SAME ids (admission is idempotent).  Assertions: the supervisor exits 0
+after a graceful ``/shutdown``, every accepted request completed
+**exactly once** (one ``complete`` journal record each), every result
+is **byte-equal** to the sequential single-world oracle, and the stream
+carries the v10 ``requeue`` records plus the restart marker.
+
+**Phase B — graceful drain.**  An unsupervised server receives two
+in-flight requests and a SIGTERM: it must stop admitting, finish the
+committed work, exit 0, and leave byte-equal results + a fully-terminal
+journal on disk.
+
+Exits non-zero with a message on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from gol_tpu.models import patterns  # noqa: E402
+from gol_tpu.serve import journal as journal_mod  # noqa: E402
+from gol_tpu.serve.client import SimClient  # noqa: E402
+from gol_tpu.serve.scheduler import decode_board  # noqa: E402
+from tests import oracle  # noqa: E402
+
+GENS = 12
+REQUESTS = [  # (id, pattern, size) — two share a bucket, one does not
+    ("q0", 4, 64),
+    ("q1", 4, 64),
+    ("q2", 4, 96),
+]
+
+PLAN = {
+    "faults": [
+        # Kill the process at the first chunk boundary (first attempt
+        # only — the default attempts=1 cannot re-kill the recovery).
+        {"site": "crash.exit", "at": 4},
+        # Poison the SECOND admitted request's world on the relaunch;
+        # the guard must catch it and replay only that bucket.
+        {"site": "board.bitflip", "at": 8, "world": 1, "row": 3,
+         "col": 5, "value": 165, "attempts": 2},
+        # Two transient EIO hits on a journal append under restart —
+        # absorbed by the bounded write_with_retry budget.  (NOT
+        # disk_full: ENOSPC sheds the telemetry stream by design, which
+        # would race the guard-audit records this drill asserts on.)
+        {"site": "checkpoint.io_error", "at": 6, "count": 2,
+         "attempts": 2},
+    ]
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _fail(msg: str) -> int:
+    print(f"serve-smoke: FAIL — {msg}")
+    return 1
+
+
+def _wait_healthy(client: SimClient, timeout_s: float = 120.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            client.healthz()
+            return
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError("server never became healthy")
+
+
+def _oracle_board(pattern: int, size: int, gens: int):
+    return oracle.run_torus(patterns.init_global(pattern, size, 1), gens)
+
+
+def _events(telemetry_dir: str):
+    out = []
+    d = pathlib.Path(telemetry_dir)
+    if d.is_dir():
+        for p in sorted(d.glob("*.jsonl*")):  # incl. rotated attempt-0
+            out.extend(json.loads(ln) for ln in open(p))
+    return out
+
+
+def phase_a(tmp: str, env: dict) -> int:
+    import numpy as np
+
+    state = os.path.join(tmp, "a_state")
+    tm = os.path.join(tmp, "a_tm")
+    plan_path = os.path.join(tmp, "plan.json")
+    pathlib.Path(plan_path).write_text(json.dumps(PLAN))
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu.resilience", "supervise",
+            "--max-restarts", "3", "--backoff-base", "0.1",
+            "--backoff-seed", "0", "--",
+            sys.executable, "-m", "gol_tpu.serve",
+            "--state-dir", state, "--port", str(port),
+            "--telemetry", tm, "--run-id", "smoke", "--chunk", "4",
+        ],
+        env={**env, "GOL_FAULT_PLAN": plan_path},
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    client = SimClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    try:
+        _wait_healthy(client)
+        for rid, pat, size in REQUESTS:
+            # The armed crash can land mid-submission: resubmitting the
+            # same id across connection drops is the designed recovery.
+            client.submit(
+                {"id": rid, "pattern": pat, "size": size,
+                 "generations": GENS},
+                connect_retries=40, retry_delay_s=0.5,
+            )
+        results = {
+            rid: client.wait_for(
+                rid, timeout_s=180.0, connect_retries=200
+            )
+            for rid, _, _ in REQUESTS
+        }
+        client.shutdown()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = proc.stdout.read()
+    if rc != 0:
+        return _fail(f"supervised server exited {rc}:\n{out[-2000:]}")
+    for rid, pat, size in REQUESTS:
+        want = _oracle_board(pat, size, GENS)
+        got = decode_board(results[rid]["board"])
+        if not np.array_equal(got, want):
+            return _fail(f"{rid}: result differs from sequential oracle")
+    # Exactly once, straight from the durability artifact: every id has
+    # completed status; no id completed twice (count raw records).
+    raw = [
+        json.loads(ln)
+        for ln in open(os.path.join(state, "journal.jsonl"))
+        if ln.strip()
+    ]
+    completes = [r["id"] for r in raw if r.get("rec") == "complete"]
+    if sorted(completes) != ["q0", "q1", "q2"]:
+        return _fail(f"journal completes {completes} != one per request")
+    entries, _ = journal_mod.replay(os.path.join(state, "journal.jsonl"))
+    if not all(e["status"] == "completed" for e in entries.values()):
+        return _fail("journal left a non-terminal request behind")
+    recs = _events(tm)
+    if not any(
+        r.get("event") == "serve" and r.get("action") == "requeue"
+        for r in recs
+    ):
+        return _fail("no v10 requeue record — the restart never replayed")
+    if not any(r.get("event") == "restart" for r in recs):
+        return _fail("no restart marker on the stream")
+    if not any(
+        r.get("event") == "guard_audit" and not r.get("ok")
+        for r in recs
+    ):
+        return _fail("the injected bitflip never failed an audit")
+    headers = [r for r in recs if r.get("event") == "run_header"]
+    if headers and headers[0].get("schema") != 10:
+        return _fail(f"stream schema {headers[0].get('schema')} != 10")
+    print(
+        "serve-smoke: phase A ok — crash mid-batch, supervised restart "
+        "re-admitted from the journal, every request completed exactly "
+        "once, byte-equal"
+    )
+    return 0
+
+
+def phase_b(tmp: str, env: dict) -> int:
+    import numpy as np
+
+    state = os.path.join(tmp, "b_state")
+    tm = os.path.join(tmp, "b_tm")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu.serve",
+            "--state-dir", state, "--port", str(port),
+            "--telemetry", tm, "--run-id", "drain", "--chunk", "4",
+        ],
+        env=env, cwd=str(REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    client = SimClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    try:
+        _wait_healthy(client)
+        for rid in ("d0", "d1"):
+            client.submit(
+                {"id": rid, "pattern": 4, "size": 64,
+                 "generations": 40}
+            )
+        proc.send_signal(signal.SIGTERM)  # while both are in flight
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = proc.stdout.read()
+    if rc != 0:
+        return _fail(f"SIGTERM drain exited {rc}:\n{out[-2000:]}")
+    want = _oracle_board(4, 64, 40)
+    for rid in ("d0", "d1"):
+        path = os.path.join(state, "results", f"{rid}.json")
+        if not os.path.exists(path):
+            return _fail(f"{rid}: no result on disk after drain")
+        payload = json.load(open(path))
+        if payload["status"] != "done":
+            return _fail(f"{rid}: drained result status {payload['status']}")
+        if not np.array_equal(decode_board(payload["board"]), want):
+            return _fail(f"{rid}: drained result differs from oracle")
+    entries, _ = journal_mod.replay(os.path.join(state, "journal.jsonl"))
+    if sorted(entries) != ["d0", "d1"] or not all(
+        e["status"] == "completed" for e in entries.values()
+    ):
+        return _fail("journal not fully terminal after graceful drain")
+    print(
+        "serve-smoke: phase B ok — SIGTERM drained both in-flight "
+        "requests to byte-equal results and exited 0"
+    )
+    return 0
+
+
+def main() -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    env.pop("XLA_FLAGS", None)
+    env.pop("GOL_FAULT_PLAN", None)
+    env.pop("GOL_RESTART_ATTEMPT", None)
+    with tempfile.TemporaryDirectory() as tmp:
+        rc = phase_a(tmp, env)
+        if rc:
+            return rc
+        rc = phase_b(tmp, env)
+        if rc:
+            return rc
+    print("serve-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

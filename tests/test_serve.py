@@ -1,0 +1,442 @@
+"""The serving tier (docs/SERVING.md): journal durability, continuous
+batching, admission control, deadlines, and guard isolation.
+
+Everything here is in-process and fast (tier 1).  The drills that need
+real process death — SIGKILL mid-batch under a supervisor, graceful
+SIGTERM drain — live in scripts/serve_smoke.py; the crash-replay test
+here simulates the same journal path by abandoning one scheduler and
+constructing a second over the same state directory.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from gol_tpu.models import patterns
+from gol_tpu.serve import journal as journal_mod
+from gol_tpu.serve.scheduler import (
+    Rejected, ServeScheduler, ValidationError,
+)
+from tests import oracle
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _oracle(pattern: int, size: int, gens: int) -> np.ndarray:
+    return oracle.run_torus(
+        patterns.init_global(pattern, size, 1), gens
+    )
+
+
+def _events(path: pathlib.Path):
+    out = []
+    for p in sorted(path.glob("*.jsonl")):
+        out.extend(json.loads(ln) for ln in open(p))
+    return out
+
+
+# -- journal -------------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_fold(tmp_path):
+    j = journal_mod.Journal(str(tmp_path / "j.jsonl"))
+    j.append(journal_mod.record("admit", "a", request={}, ordinal=0))
+    j.append(journal_mod.record("start", "a", ordinal=0))
+    j.append(journal_mod.record("admit", "b", request={}, ordinal=1))
+    j.append(journal_mod.record("complete", "a", fingerprint=7))
+    j.close()
+    entries, torn = journal_mod.replay(j.path)
+    assert torn == 0
+    assert entries["a"]["status"] == "completed"
+    assert entries["a"]["terminal"]["fingerprint"] == 7
+    assert entries["b"]["status"] == "admitted"
+    assert list(entries) == ["a", "b"]  # admission order
+
+
+def test_journal_torn_final_record_is_tolerated(tmp_path):
+    """A crash mid-append leaves a half-written last line; the replay
+    fold ignores it (it was never acknowledged) and the next append
+    self-heals the tail instead of corrupting its own record."""
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.Journal(path)
+    j.append(journal_mod.record("admit", "a", request={}, ordinal=0))
+    j.append(journal_mod.record("admit", "b", request={}, ordinal=1))
+    j.close()
+    whole = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(whole[:-20])  # tear the final record mid-line
+    entries, torn = journal_mod.replay(path)
+    assert torn == 1
+    assert list(entries) == ["a"]  # the torn admit never happened
+    j2 = journal_mod.Journal(path)  # reopen over the torn tail
+    j2.append(journal_mod.record("admit", "c", request={}, ordinal=2))
+    j2.close()
+    entries, torn = journal_mod.replay(path)
+    assert torn == 1
+    assert list(entries) == ["a", "c"]
+
+
+def test_journal_duplicate_admit_is_idempotent(tmp_path):
+    j = journal_mod.Journal(str(tmp_path / "j.jsonl"))
+    j.append(
+        journal_mod.record("admit", "a", request={"n": 1}, ordinal=0)
+    )
+    j.append(
+        journal_mod.record("admit", "a", request={"n": 2}, ordinal=9)
+    )
+    j.close()
+    entries, _ = journal_mod.replay(j.path)
+    assert len(entries) == 1
+    assert entries["a"]["admit"]["request"] == {"n": 1}  # first wins
+
+
+def test_journal_compaction_gc_keeps_newest_segments(tmp_path):
+    """Compaction rewrites the live file to only-open intents, rotates
+    history to ``.n`` segments, and keeps only the newest K — the PR 4
+    keep-newest retention discipline applied to journal history."""
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.Journal(path)
+    for round_ in range(4):
+        rid = f"r{round_}"
+        j.append(
+            journal_mod.record("admit", rid, request={}, ordinal=round_)
+        )
+        j.append(journal_mod.record("complete", rid, fingerprint=0))
+        j.append(
+            journal_mod.record(
+                "admit", f"open{round_}", request={}, ordinal=100 + round_
+            )
+        )
+        j.compact(keep_segments=2)
+    j.close()
+    segs = sorted(tmp_path.glob("j.jsonl.*"))
+    assert [s.name for s in segs] == ["j.jsonl.3", "j.jsonl.4"]
+    entries, _ = journal_mod.replay(path)
+    # Completed intents were compacted away; every open one survives.
+    assert sorted(entries) == [f"open{r}" for r in range(4)]
+    assert all(e["status"] == "admitted" for e in entries.values())
+
+
+# -- scheduler: continuous batching -------------------------------------------
+
+
+def test_continuous_refill_bit_equal_to_sequential(tmp_path):
+    """Five same-bucket requests through two slots: slots refill as
+    worlds finish (continuous batching), and every result is bit-equal
+    to the sequential single-world oracle."""
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=2, queue_depth=8,
+        chunk=3,
+    )
+    specs = [(4, 32, 5 + 2 * i) for i in range(5)]  # staggered lengths
+    try:
+        for i, (pat, size, gens) in enumerate(specs):
+            sched.submit(
+                {"id": f"w{i}", "pattern": pat, "size": size,
+                 "generations": gens}
+            )
+        assert sched.outstanding() == 5
+        sched.run_until_drained()
+        for i, (pat, size, gens) in enumerate(specs):
+            got = sched.result_board(f"w{i}")
+            assert np.array_equal(got, _oracle(pat, size, gens)), f"w{i}"
+        assert sched.completed_total == 5
+    finally:
+        sched.close()
+
+
+def test_mixed_buckets_and_engines_complete(tmp_path):
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=2, chunk=4,
+    )
+    reqs = [
+        {"id": "dense32", "pattern": 4, "size": 32, "generations": 6,
+         "engine": "dense"},
+        {"id": "bp32", "pattern": 4, "size": 32, "generations": 6,
+         "engine": "bitpack"},
+        {"id": "auto48", "pattern": 4, "size": 48, "generations": 9},
+    ]
+    try:
+        for r in reqs:
+            sched.submit(r)
+        sched.run_until_drained()
+        for r in reqs:
+            got = sched.result_board(r["id"])
+            want = _oracle(r["pattern"], r["size"], r["generations"])
+            assert np.array_equal(got, want), r["id"]
+    finally:
+        sched.close()
+
+
+def test_duplicate_submit_returns_existing_state(tmp_path):
+    sched = ServeScheduler(str(tmp_path / "state"), quantum=32)
+    try:
+        a = sched.submit(
+            {"id": "dup", "pattern": 4, "size": 32, "generations": 3}
+        )
+        b = sched.submit(
+            {"id": "dup", "pattern": 4, "size": 32, "generations": 99}
+        )
+        assert a is b  # idempotent on the id: no double admission
+        sched.run_until_drained()
+        assert sched.completed_total == 1
+        assert sched.get_result("dup").result["generation"] == 3
+    finally:
+        sched.close()
+
+
+def test_validation_rejects_bad_requests(tmp_path):
+    sched = ServeScheduler(str(tmp_path / "state"), quantum=32)
+    try:
+        for bad in (
+            {"pattern": 4, "size": 32},  # no generations
+            {"pattern": 999, "size": 32, "generations": 1},
+            {"pattern": 4, "size": 32, "generations": 1, "rule": "B36/S23"},
+            {"pattern": 4, "size": 32, "generations": 1, "engine": "warp"},
+            {"pattern": 4, "size": 32, "generations": 1, "id": "../etc"},
+            {"pattern": 4, "size": 32, "generations": 1, "bogus": True},
+        ):
+            with pytest.raises(ValidationError):
+                sched.submit(bad)
+    finally:
+        sched.close()
+
+
+# -- admission control ---------------------------------------------------------
+
+
+def test_backpressure_429_with_retry_after_and_stats_shed(tmp_path):
+    """Beyond the bounded queue the scheduler answers an explicit 429
+    with a retry hint, and the FIRST backpressure signal sheds stats
+    streaming (the PR 10 order: stats before admissions, admissions
+    before committed work)."""
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=1, queue_depth=1,
+        telemetry_dir=str(tmp_path / "tm"), run_id="bp",
+    )
+    try:
+        sched.submit(
+            {"id": "ok", "pattern": 4, "size": 32, "generations": 2}
+        )
+        with pytest.raises(Rejected) as exc:
+            sched.submit(
+                {"id": "no", "pattern": 4, "size": 32, "generations": 2}
+            )
+        assert exc.value.status == 429
+        assert exc.value.retry_after is not None
+        assert sched.rejected_total == 1
+        assert sched.get_result("no") is None  # never half-admitted
+        sched.run_until_drained()  # the committed request still lands
+        assert sched.get_result("ok").status == "done"
+    finally:
+        sched.close()
+    recs = _events(tmp_path / "tm")
+    reject = next(
+        r for r in recs
+        if r["event"] == "serve" and r["action"] == "reject"
+    )
+    assert reject["request_id"] == "no"
+    assert any(
+        r["event"] == "degraded"
+        and r["resource"] == "stats"
+        and r["action"] == "shed"
+        for r in recs
+    )
+
+
+def test_draining_rejects_with_503(tmp_path):
+    sched = ServeScheduler(str(tmp_path / "state"), quantum=32)
+    try:
+        sched.drain()
+        with pytest.raises(Rejected) as exc:
+            sched.submit(
+                {"pattern": 4, "size": 32, "generations": 1}
+            )
+        assert exc.value.status == 503
+    finally:
+        sched.close()
+
+
+# -- deadlines -----------------------------------------------------------------
+
+
+def test_deadline_cancels_one_request_other_completes_bit_equal(tmp_path):
+    """Two requests, one with an already-lapsed deadline: the scheduler
+    cancels it at the next chunk boundary (journaled + v10 ``deadline``
+    event) and the survivor completes bit-equal to the oracle."""
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=2, chunk=2,
+        telemetry_dir=str(tmp_path / "tm"), run_id="dl",
+    )
+    try:
+        sched.submit(
+            {"id": "doomed", "pattern": 4, "size": 32,
+             "generations": 500, "deadline_s": 0.0}
+        )
+        sched.submit(
+            {"id": "fine", "pattern": 4, "size": 32, "generations": 6}
+        )
+        sched.run_until_drained()
+        doomed = sched.get_result("doomed")
+        assert doomed.status == "expired"
+        assert doomed.result["status"] == "expired"
+        fine = sched.result_board("fine")
+        assert np.array_equal(fine, _oracle(4, 32, 6))
+    finally:
+        sched.close()
+    entries, _ = journal_mod.replay(
+        str(tmp_path / "state" / "journal.jsonl")
+    )
+    assert entries["doomed"]["status"] == "cancelled"
+    assert entries["fine"]["status"] == "completed"
+    recs = _events(tmp_path / "tm")
+    assert any(
+        r["event"] == "serve"
+        and r["action"] == "deadline"
+        and r["request_id"] == "doomed"
+        for r in recs
+    )
+
+
+# -- guard isolation -----------------------------------------------------------
+
+
+def test_guard_bitflip_replays_only_the_poisoned_bucket(tmp_path):
+    """A bitflip injected into one request's world rolls back and
+    replays ONLY that request's bucket group; the other bucket's replay
+    counter stays zero and both results are bit-equal to the oracle."""
+    from gol_tpu.resilience import faults
+
+    faults.install(
+        faults.FaultPlan.from_obj(
+            [{"site": "board.bitflip", "at": 4, "world": 0,
+              "row": 3, "col": 5, "value": 165}]
+        )
+    )
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=2, chunk=2,
+        telemetry_dir=str(tmp_path / "tm"), run_id="iso",
+    )
+    try:
+        sched.submit(  # ordinal 0 — the fault plan's target
+            {"id": "hit", "pattern": 4, "size": 32, "generations": 6}
+        )
+        sched.submit(  # ordinal 1, different bucket (64x64)
+            {"id": "bystander", "pattern": 4, "size": 48,
+             "generations": 6}
+        )
+        sched.run_until_drained()
+        assert sched.guard_failures >= 1
+        groups = {g.label: g for g in sched._groups.values()}
+        hit_grp = groups["32x32/bitpack"]
+        other = [g for lbl, g in groups.items() if g is not hit_grp]
+        assert hit_grp.replays >= 1
+        assert all(g.replays == 0 for g in other), (
+            "a fault in one request's world replayed another bucket"
+        )
+        assert np.array_equal(
+            sched.result_board("hit"), _oracle(4, 32, 6)
+        )
+        assert np.array_equal(
+            sched.result_board("bystander"), _oracle(4, 48, 6)
+        )
+    finally:
+        faults.clear()
+        sched.close()
+    recs = _events(tmp_path / "tm")
+    bad = [
+        r for r in recs
+        if r["event"] == "guard_audit" and not r["ok"]
+    ]
+    assert bad and all(r["request_id"] == "hit" for r in bad)
+    assert any(r["event"] == "fault" for r in recs)
+
+
+# -- crash-safe replay ---------------------------------------------------------
+
+
+def test_restart_replays_journal_and_completes_exactly_once(tmp_path):
+    """Scheduler A admits three requests, steps partway, and is
+    abandoned mid-batch (the in-process stand-in for SIGKILL — the real
+    supervised drill is scripts/serve_smoke.py).  Scheduler B over the
+    same state directory re-admits every unfinished request from the
+    journal and completes each exactly once, bit-equal to the oracle."""
+    state = str(tmp_path / "state")
+    a = ServeScheduler(
+        state, quantum=32, slots=2, chunk=2,
+        telemetry_dir=str(tmp_path / "tma"), run_id="a",
+    )
+    for i in range(3):
+        a.submit(
+            {"id": f"w{i}", "pattern": 4, "size": 32,
+             "generations": 8}
+        )
+    a.run_once()  # partway through the batch, then "die" (no close)
+    assert a.outstanding() == 3
+
+    b = ServeScheduler(
+        state, quantum=32, slots=2, chunk=2,
+        telemetry_dir=str(tmp_path / "tmb"), run_id="b",
+    )
+    try:
+        assert b.outstanding() == 3  # journal replay re-admitted all
+        b.run_until_drained()
+        want = _oracle(4, 32, 8)
+        for i in range(3):
+            assert np.array_equal(b.result_board(f"w{i}"), want)
+        assert b.completed_total == 3
+    finally:
+        b.close()
+    recs = _events(tmp_path / "tmb")
+    requeues = [
+        r["request_id"]
+        for r in recs
+        if r["event"] == "serve" and r["action"] == "requeue"
+    ]
+    assert sorted(requeues) == ["w0", "w1", "w2"]
+    # Exactly once: one complete record per id across the whole journal.
+    entries, _ = journal_mod.replay(state + "/journal.jsonl")
+    assert all(e["status"] == "completed" for e in entries.values())
+
+    # A third scheduler sees only terminal state: nothing to re-run.
+    c = ServeScheduler(state, quantum=32, slots=2, chunk=2)
+    try:
+        assert c.outstanding() == 0
+        assert c.get_result("w0").status == "done"
+        assert np.array_equal(c.result_board("w1"), want)
+    finally:
+        c.close()
+
+
+# -- the batch-runtime satellite ----------------------------------------------
+
+
+def test_batch_runtime_on_world_complete_hook(tmp_path):
+    """The batch runtime's completion callback — the hook the serve
+    scheduler's slot-refill design generalizes — fires once per world
+    with the final board."""
+    from gol_tpu.batch import GolBatchRuntime
+
+    worlds = [
+        patterns.init_global(4, 32, 1),
+        patterns.init_global(4, 48, 1),
+    ]
+    seen = {}
+    brt = GolBatchRuntime(
+        worlds=worlds,
+        engine="auto",
+        on_world_complete=lambda i, board, gen: seen.setdefault(
+            i, (board.copy(), gen)
+        ),
+    )
+    _, boards = brt.run(4)
+    assert sorted(seen) == [0, 1]
+    for i, want in enumerate(boards):
+        got, gen = seen[i]
+        assert gen == 4
+        assert np.array_equal(got, np.asarray(want))

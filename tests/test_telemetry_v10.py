@@ -1,0 +1,180 @@
+"""Schema v10 (serving-tier events) + v1–v9 back-compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..9}.py.
+Here:
+
+- the v10 addition round-trips: ``serve`` records one request lifecycle
+  transition (admit/start/complete/reject/deadline/requeue) with its
+  request id and queue-depth detail (docs/SERVING.md);
+- a REAL scheduler run emits the full admit→start→complete sequence and
+  the summarize pass renders the serve line;
+- **back-compat**: ALL NINE committed fixtures — PR 2 (v1) through
+  PR 12 (v9, a real faulted guarded batch run) — still load, and a
+  directory holding v1–v9 + a fresh v10 stream merges and renders in
+  one ``summarize`` pass (exit 0) with the serve line, while a bogus
+  schema still exits 2.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+    9: DATA / "telemetry_v9" / "pr12run.rank0.jsonl",
+}
+
+
+def _v10_stream(directory, run_id="v10"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "serve", "engine": "auto", "slots": 4,
+             "queue_depth": 8, "chunk": 4}
+        )
+        ev.serve_event("admit", "req-1", bucket="64x64/bitpack",
+                       queue_depth=1, inflight=0)
+        ev.serve_event("start", "req-1", bucket="64x64/bitpack",
+                       queue_depth=0, inflight=1)
+        ev.serve_event(
+            "complete", "req-1", bucket="64x64/bitpack",
+            queue_depth=0, inflight=0, latency_s=0.125, generation=50,
+        )
+        ev.serve_event("reject", "req-2", reason="queue_full",
+                       queue_depth=8, inflight=4)
+        return ev.path
+
+
+def test_v10_serve_roundtrip(tmp_path):
+    path = _v10_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 10
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 11))
+    serves = [r for r in recs if r["event"] == "serve"]
+    assert [r["action"] for r in serves] == [
+        "admit", "start", "complete", "reject",
+    ]
+    done = serves[2]
+    assert done["request_id"] == "req-1"
+    assert done["latency_s"] == 0.125
+
+
+def test_real_scheduler_run_stamps_v10_records(tmp_path):
+    """End to end: the serve scheduler's admit→start→complete sequence
+    lands in the stream and summarize renders the serve line."""
+    from gol_tpu.serve.scheduler import ServeScheduler
+
+    sched = ServeScheduler(
+        str(tmp_path / "state"),
+        quantum=32,
+        slots=2,
+        chunk=3,
+        telemetry_dir=str(tmp_path / "tm"),
+        run_id="served",
+    )
+    try:
+        sched.submit(
+            {"id": "a", "pattern": 4, "size": 32, "generations": 5}
+        )
+        sched.submit(
+            {"id": "b", "pattern": 4, "size": 32, "generations": 5}
+        )
+        sched.run_until_drained()
+    finally:
+        sched.close()
+    recs = [
+        json.loads(ln)
+        for ln in open(tmp_path / "tm" / "served.rank0.jsonl")
+    ]
+    actions = [
+        (r["action"], r["request_id"])
+        for r in recs
+        if r["event"] == "serve"
+    ]
+    for rid in ("a", "b"):
+        for action in ("admit", "start", "complete"):
+            assert (action, rid) in actions
+    assert summ_mod.main(["summarize", str(tmp_path / "tm")]) == 0
+
+
+def test_committed_fixture_schemas_are_v1_to_v9():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v9_fixture_is_a_real_faulted_guarded_run():
+    recs = [json.loads(ln) for ln in FIXTURES[9].open()]
+    assert recs[0]["config"]["driver"] == "batch"
+    faults = [r for r in recs if r["event"] == "fault"]
+    assert {f["site"] for f in faults} >= {
+        "checkpoint.io_error", "board.bitflip",
+    }
+    assert any(
+        r["event"] == "guard_audit" and not r["ok"] for r in recs
+    )
+    assert any(
+        r["event"] == "degraded" and r["action"] == "retried"
+        for r in recs
+    )
+
+
+def test_v1_to_v10_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v10_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "pr12run", "v10",
+    ):
+        assert run_id in out
+    assert "serve: 1 request(s) committed" in out
+    assert "1 admit" in out and "1 reject" in out
+
+
+def test_serve_metrics_render(tmp_path):
+    """The gol_serve_* gauges appear once serve records are observed."""
+    from gol_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    base = reg.render()
+    assert "gol_serve_" not in base  # absent until the tier is used
+    for ln in open(_v10_stream(tmp_path)):
+        reg.observe(json.loads(ln))
+    text = reg.render()
+    assert "gol_serve_admitted_total 1" in text
+    assert "gol_serve_rejected_total 1" in text
+    assert "gol_serve_completed_total 1" in text
+    assert 'gol_serve_request_seconds_bucket{le="0.5"} 1' in text
+    assert "gol_serve_request_seconds_count 1" in text
+
+
+def test_bogus_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": 99, "run_id": "bad",
+             "process_index": 0, "process_count": 1, "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
