@@ -61,7 +61,16 @@ class SimClient:
     ) -> dict:
         """POST /simulate.  ``connect_retries`` resubmits the same id
         across connection drops (supervised restarts) — safe because
-        admission is idempotent on the id."""
+        admission is idempotent on the id.  That safety is exactly why
+        retries REQUIRE a caller-supplied ``id``: without one the server
+        mints a fresh id per submission, so a resubmitted retry would be
+        admitted (and run) twice."""
+        if connect_retries > 0 and "id" not in request:
+            raise ValueError(
+                "connect_retries requires a caller-supplied 'id': "
+                "server-generated ids make every resubmission a NEW "
+                "request, so a retry would double-run it"
+            )
         attempt = 0
         while True:
             try:

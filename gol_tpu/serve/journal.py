@@ -35,8 +35,10 @@ path production takes.  Callers wrap :meth:`append` in
 GC rides the retention discipline of the snapshot store
 (:mod:`gol_tpu.resilience.retention`): :meth:`Journal.compact` rewrites
 the live file to only-open intents with the checkpoint tmp+``os.replace``
-rename discipline, rotates the previous contents to ``journal.jsonl.<n>``,
-and keeps only the newest K rotated segments — never the live file.
+rename discipline, rotates the previous contents to ``journal.jsonl.<n>``
+by hard link (the live path holds a complete journal at every crash
+point), and keeps only the newest K rotated segments — never the live
+file.
 """
 
 from __future__ import annotations
@@ -130,12 +132,15 @@ class Journal:
         """Rewrite the live journal to only-open intents; rotate + GC.
 
         The rewrite uses the checkpoint discipline (tmp + fsync +
-        ``os.replace`` — a crash mid-compact leaves either the old or
-        the new journal, never a hybrid); the old contents rotate to
-        ``<path>.<n>`` and :func:`gc_segments` keeps the newest
-        ``keep_segments`` of those (the snapshot store's keep-newest-K
-        retention, applied to journal history — the live file is never
-        a GC candidate).
+        ``os.replace``), and the rotation to ``<path>.<n>`` is a **hard
+        link**, never a rename of the live file: at every instruction
+        boundary the live path holds a complete journal — the old one
+        until ``os.replace`` commits the new one — so a SIGKILL anywhere
+        mid-compact can never strand a restart without a journal (old or
+        new, never a hybrid, never missing).  :func:`gc_segments` keeps
+        the newest ``keep_segments`` rotated segments (the snapshot
+        store's keep-newest-K retention, applied to journal history —
+        the live file is never a GC candidate).
         """
         entries, _ = replay(self.path)
         open_lines = [
@@ -149,7 +154,6 @@ class Journal:
                 f.write(line + "\n")
             f.flush()
             os.fsync(f.fileno())
-        self._f.close()
         # Highest existing segment + 1 — never the first free gap: GC
         # deletes low numbers, and reusing one would stamp the NEWEST
         # history with the OLDEST-looking name (and GC it next round).
@@ -159,11 +163,20 @@ class Journal:
             if (m := _SEGMENT_RE.search(p))
         ]
         n = max(taken, default=0) + 1
-        os.replace(self.path, f"{self.path}.{n}")
-        os.replace(tmp, self.path)
-        self._f = open(self.path, "ab")
-        self._count = len(open_lines)
-        self._torn_tail = False
+        self._f.close()
+        try:
+            # The link and the live file share an inode until the
+            # replace lands, which freezes the segment as history.  A
+            # crash between the two calls leaves BOTH names pointing at
+            # the full old journal — a valid state replay handles.
+            os.link(self.path, f"{self.path}.{n}")
+            os.replace(tmp, self.path)
+            self._count = len(open_lines)
+            self._torn_tail = False
+        finally:
+            # Reopen even on failure (full disk, interrupted rotation):
+            # the live path always holds a journal we can append to.
+            self._f = open(self.path, "ab")
         gc_segments(self.path, keep_segments)
 
     def close(self) -> None:

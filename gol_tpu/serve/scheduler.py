@@ -236,7 +236,10 @@ class ServeScheduler:
         """Validate + admit one request dict; raises
         :class:`ValidationError` (400) / :class:`Rejected` (429/503).
         Re-submitting a known id is idempotent (the existing state is
-        returned — exactly-once rides the request id)."""
+        returned — exactly-once rides the request id).  A request
+        WITHOUT an id gets a fresh server-minted one per call, so only
+        caller-supplied ids make resubmission idempotent — the server's
+        202 ticket and :meth:`SimClient.submit` both enforce/flag this."""
         req = self._validate(obj)
         with self._lock:
             existing = self._requests.get(req.id)
@@ -548,6 +551,12 @@ class ServeScheduler:
                 self._requests[rid] = state
                 continue
             state = RequestState(req, ordinal, self._initial_board(req))
+            t = admit.get("t")
+            if isinstance(t, (int, float)) and not isinstance(t, bool):
+                # Deadlines and latency are measured from the ORIGINAL
+                # admission, not from this restart — a deadlined request
+                # must not get a fresh budget every supervised restart.
+                state.submitted_t = float(t)
             self._requests[rid] = state
             grp = self._group_for(req)
             grp.queue.append(state)
@@ -573,12 +582,30 @@ class ServeScheduler:
                 else:
                     kept.append(state)
             grp.queue = kept
-            for k, state in enumerate(grp.slots):
-                if state is not None and self._expired(state, now):
-                    grp.slots[k] = None
-                    grp.stack = None
-                    grp.last_good = None
-                    self._cancel(state, grp)
+            expired = [
+                k for k, s in enumerate(grp.slots)
+                if s is not None and self._expired(s, now)
+            ]
+            if not expired:
+                continue
+            # Cancelling a RUNNING slot drops the device stack, and the
+            # survivors' next stack is rebuilt from their host boards —
+            # which are only refreshed on completion.  Host-sync every
+            # occupied slot first so co-resident requests keep the
+            # generations they actually ran (and the cancelled request
+            # reports the board/generation it really reached).
+            if grp.stack is not None:
+                host = np.asarray(grp.stack)
+                for k, s in enumerate(grp.slots):
+                    if s is not None:
+                        n = s.request.size
+                        s.board = host[k, :n, :n].copy()
+            for k in expired:
+                state = grp.slots[k]
+                grp.slots[k] = None
+                self._cancel(state, grp)
+            grp.stack = None
+            grp.last_good = None
 
     @staticmethod
     def _expired(state: RequestState, now: float) -> bool:

@@ -24,6 +24,9 @@ Endpoints::
 Backpressure is explicit, never silent: 429/503 carry a JSON ``error``
 plus ``retry_after`` (and the ``Retry-After`` header) — a well-behaved
 client resubmits the SAME id later and admission stays exactly-once.
+Exactly-once holds **only for caller-supplied ids**: omit ``id`` and the
+server mints one per submission, so a blind retry is a new request — the
+202 ticket flags this (``id_generated``) and names the id to reuse.
 """
 
 from __future__ import annotations
@@ -133,14 +136,22 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if state.result is not None:
             self._json(200, state.result)
         else:
-            self._json(
-                202,
-                {
-                    "id": state.request.id,
-                    "status": state.status,
-                    "generation": state.generation,
-                },
-            )
+            ticket = {
+                "id": state.request.id,
+                "status": state.status,
+                "generation": state.generation,
+            }
+            if "id" not in body:
+                # Exactly-once admission keys on the id.  This one was
+                # minted server-side, so a connection-retry that omits
+                # it is a NEW request (double-run) — say so in the
+                # ticket, where the one client who can fix it reads it.
+                ticket["id_generated"] = True
+                ticket["note"] = (
+                    "id was server-generated: retries must resubmit "
+                    "with this id to stay exactly-once"
+                )
+            self._json(202, ticket)
 
     def _result(self, request_id: str) -> None:
         state = self.scheduler.get_result(request_id)

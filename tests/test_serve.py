@@ -95,6 +95,44 @@ def test_journal_duplicate_admit_is_idempotent(tmp_path):
     assert entries["a"]["admit"]["request"] == {"n": 1}  # first wins
 
 
+def test_journal_compact_crash_midway_keeps_live_journal(tmp_path,
+                                                        monkeypatch):
+    """A SIGKILL between compaction's filesystem steps must leave a
+    valid live journal.  The rotation is a hard link, not a rename of
+    the live file, so the worst crash point (segment linked, new file
+    not yet committed) leaves the FULL old journal at the live path —
+    a restart replays every open intent instead of forgetting them."""
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.Journal(path)
+    j.append(journal_mod.record("admit", "a", request={}, ordinal=0))
+    j.append(journal_mod.record("complete", "a", fingerprint=0))
+    j.append(journal_mod.record("admit", "open", request={}, ordinal=1))
+
+    def die(src, dst):
+        raise OSError("simulated crash before the new journal landed")
+
+    monkeypatch.setattr(journal_mod.os, "replace", die)
+    with pytest.raises(OSError):
+        j.compact(keep_segments=2)
+    monkeypatch.undo()
+    entries, torn = journal_mod.replay(path)
+    assert torn == 0
+    assert entries["a"]["status"] == "completed"
+    assert entries["open"]["status"] == "admitted"  # nothing forgotten
+    # The journal stays appendable after the failed compact...
+    j.append(journal_mod.record("start", "open", ordinal=1))
+    j.close()
+    # ...and a restart-over-the-same-path (what the supervisor does)
+    # sees the full fold, then compacts cleanly.
+    j2 = journal_mod.Journal(path)
+    entries, _ = journal_mod.replay(path)
+    assert entries["open"]["status"] == "started"
+    j2.compact(keep_segments=2)
+    j2.close()
+    entries, _ = journal_mod.replay(path)
+    assert sorted(entries) == ["open"]
+
+
 def test_journal_compaction_gc_keeps_newest_segments(tmp_path):
     """Compaction rewrites the live file to only-open intents, rotates
     history to ``.n`` segments, and keeps only the newest K — the PR 4
@@ -207,6 +245,20 @@ def test_validation_rejects_bad_requests(tmp_path):
         sched.close()
 
 
+def test_client_refuses_connect_retries_without_id():
+    """Idempotent resubmission keys on a caller-supplied id; without
+    one, every retry is a fresh (double-run) request — the client
+    refuses that combination up front, before any network call."""
+    from gol_tpu.serve.client import SimClient
+
+    c = SimClient("http://127.0.0.1:1")  # never contacted
+    with pytest.raises(ValueError, match="caller-supplied 'id'"):
+        c.submit(
+            {"pattern": 4, "size": 32, "generations": 1},
+            connect_retries=2,
+        )
+
+
 # -- admission control ---------------------------------------------------------
 
 
@@ -301,6 +353,73 @@ def test_deadline_cancels_one_request_other_completes_bit_equal(tmp_path):
         and r["request_id"] == "doomed"
         for r in recs
     )
+
+
+def test_deadline_cancels_running_slot_survivor_stays_bit_equal(tmp_path):
+    """A deadline that expires while its request is RUNNING in a batch
+    slot: cancellation drops the group's device stack, so the
+    co-resident survivor's host board must be synced from the stack
+    first — otherwise it is rebuilt from a stale board while its
+    generation counter keeps the advanced value, and it completes with
+    fewer generations than reported (breaking bit-equality)."""
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=2, chunk=2,
+    )
+    try:
+        sched.submit(
+            {"id": "doomed", "pattern": 4, "size": 32,
+             "generations": 500, "deadline_s": 3600.0}
+        )
+        # The survivor is an r-pentomino (methuselah): every generation
+        # differs for hundreds of steps, so a survivor silently rebuilt
+        # from a stale board CANNOT sneak past the oracle comparison.
+        sched.submit(
+            {"id": "fine", "pattern": 6, "size": 32, "generations": 10}
+        )
+        sched.run_once()  # both enter slots and step one chunk
+        doomed = sched.get_result("doomed")
+        assert doomed.status == "running"
+        assert doomed.generation > 0
+        doomed.submitted_t -= 7200.0  # lapse the deadline mid-flight
+        sched.run_until_drained()
+        assert doomed.status == "expired"
+        # The cancelled request reports the generation it truly reached.
+        assert doomed.result["generation"] == doomed.generation > 0
+        assert np.array_equal(
+            sched.result_board("fine"), _oracle(6, 32, 10)
+        )
+        assert sched.get_result("fine").result["generation"] == 10
+    finally:
+        sched.close()
+
+
+def test_replay_restores_original_admit_time_for_deadlines(tmp_path):
+    """Journal replay restores ``submitted_t`` from the admit record's
+    ``t`` — a deadlined request must not get a fresh deadline budget on
+    every supervised restart (nor undercount ``latency_s``)."""
+    import os as os_mod
+    import time as time_mod
+
+    state_dir = str(tmp_path / "state")
+    os_mod.makedirs(state_dir, exist_ok=True)
+    j = journal_mod.Journal(os_mod.path.join(state_dir, "journal.jsonl"))
+    req = {
+        "id": "stale", "pattern": 4, "size": 32, "generations": 500,
+        "engine": "auto", "deadline_s": 60.0, "stream_stats": False,
+    }
+    rec = journal_mod.record("admit", "stale", request=req, ordinal=0)
+    rec["t"] = time_mod.time() - 120.0  # admitted two minutes ago
+    j.append(rec)
+    j.close()
+    sched = ServeScheduler(state_dir, quantum=32, slots=2, chunk=2)
+    try:
+        state = sched.get_result("stale")
+        assert state is not None
+        assert state.submitted_t == rec["t"]  # not restart time
+        sched.run_until_drained()  # 60s deadline lapsed 60s ago
+        assert state.status == "expired"
+    finally:
+        sched.close()
 
 
 # -- guard isolation -----------------------------------------------------------
