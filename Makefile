@@ -1,13 +1,13 @@
 # Entry points for builders and reviewers.  `make check` is the one
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
-# smoke + halo smoke + chaos smoke + serve smoke + tier-1 tests
-# (see scripts/check.sh).
+# smoke + halo smoke + chaos smoke + serve smoke + elastic smoke +
+# tier-1 tests (see scripts/check.sh).
 
 .PHONY: lint verify test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
-	chaos-smoke chaos-matrix serve-smoke servebench
+	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -112,6 +112,14 @@ chaos-matrix:
 # journal, byte-equal to the sequential oracle; then a SIGTERM drain.
 serve-smoke:
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
+# Live-elasticity smoke (docs/RESILIENCE.md "Live elasticity"): a
+# --mesh-devices server loses a device mid-serve, live-reshards at the
+# chunk boundary, regrows on restore, and hedges a straggler — every
+# request byte-equal, no restart, v11 verdicts on the stream.  The
+# script forces its own 8-device virtual CPU ring.
+elastic-smoke:
+	python scripts/elastic_smoke.py
 
 # Open-loop serving load curve -> SERVE_r{N}.json (CPU: admission /
 # queue dynamics; the TPU headline command is pinned in the note).

@@ -90,6 +90,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from gol_tpu.analysis.batchcheck import default_batch_matrix
             from gol_tpu.analysis.guardcheck import default_guard_matrix
             from gol_tpu.analysis.halocheck import default_halo_matrix
+            from gol_tpu.analysis.redistcheck import default_redist_matrix
             from gol_tpu.analysis.reshardcheck import default_reshard_matrix
             from gol_tpu.analysis.sparsecheck import default_sparse_matrix
 
@@ -99,6 +100,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(scfg.name)
             for rcfg in default_reshard_matrix():
                 print(rcfg.name)
+            for dcfg in default_redist_matrix():
+                print(dcfg.name)
+            print("redist-worlds-stack")
             for hcfg in default_halo_matrix():
                 print(hcfg.name)
             for gcfg in default_guard_matrix():
@@ -114,12 +118,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from gol_tpu.analysis.batchcheck import run_batch_checks
         from gol_tpu.analysis.guardcheck import run_guard_checks
         from gol_tpu.analysis.halocheck import run_halo_checks
+        from gol_tpu.analysis.redistcheck import run_redist_checks
         from gol_tpu.analysis.reshardcheck import run_reshard_checks
         from gol_tpu.analysis.sparsecheck import run_sparse_checks
 
         report.engines.extend(run_batch_checks())
         report.engines.extend(run_sparse_checks())
         report.engines.extend(run_reshard_checks())
+        report.engines.extend(run_redist_checks())
         report.engines.extend(run_halo_checks())
         report.engines.extend(run_guard_checks())
 

@@ -1,0 +1,291 @@
+"""Checks over the device-side resharding collective (docs/RESILIENCE.md).
+
+``reshardcheck`` proves the *planner* (host geometry, exactly-once move
+tables); this matrix proves the *executor* —
+:mod:`gol_tpu.parallel.redistribute` — which compiles those tables into
+ppermute phases and per-device ``lax.switch`` branch programs.  Three
+checks per (src mesh → dst mesh) pair, run on the verifier's virtual
+CPU device ring:
+
+- **schedule soundness** — the coverage canvas painted from the
+  *compiled branch tables* (:func:`redistribute.schedule_coverage`, not
+  the plan) is all-ones: every destination cell is written by exactly
+  one (phase, move) of the static exchange program.  A bug in the phase
+  assignment or union-position bookkeeping fails here even though
+  ``validate_plan`` already blessed the geometry.
+- **executed equivalence** — :func:`redistribute.device_reshard` moves
+  a random board (seams cutting words mid-bit included) and the landed
+  cells are bit-equal to the host-side truth, under the destination
+  mesh's canonical sharding; the worlds variant
+  (:func:`redistribute.device_reshard_worlds`) is held to the same bar
+  over a ``[B, H, W]`` stack.
+- **teeth** — deliberately broken plans (an overlapping move, a gapped
+  move) handed to ``device_reshard`` explicitly MUST be rejected before
+  any device program is built.  A broken fixture that executes means
+  the exactly-once property reaches the collective unwitnessed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+# Same seam discipline as reshardcheck: 96 columns = 3 words, so the
+# 2-way column split lands mid-word while 1-D splits stay row-only.
+SHAPE = (48, 96)
+WORLD_HW = (16, 64)  # per-world board of the [B, H, W] stack check
+BATCH = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class RedistConfig:
+    """One src→dst cell of the device-reshard matrix."""
+
+    name: str
+    src: Optional[str]  # mesh spec: None / "1d2" / "1d4" / "2d2x2"
+    dst: Optional[str]
+
+
+def default_redist_matrix() -> List[RedistConfig]:
+    """Grow and shrink pairs within the verifier's 4-device ring."""
+    pairs: List[Tuple[Optional[str], Optional[str]]] = [
+        (None, "1d4"),
+        ("1d4", None),       # shrink to one device
+        ("1d2", "1d4"),      # grow the ring
+        ("1d4", "1d2"),      # shrink the ring
+        (None, "2d2x2"),     # blocks, mid-word column seam at 48
+        ("2d2x2", None),
+        ("1d2", "2d2x2"),    # ring -> blocks
+        ("2d2x2", "1d4"),    # blocks -> ring
+    ]
+    return [
+        RedistConfig(
+            name=f"redist-{s or 'none'}-to-{d or 'none'}", src=s, dst=d
+        )
+        for s, d in pairs
+    ]
+
+
+def _mesh(spec: Optional[str]):
+    import jax
+
+    from gol_tpu.parallel import mesh as mesh_mod
+
+    if spec is None:
+        return None
+    if spec.startswith("1d"):
+        return mesh_mod.make_mesh_1d(int(spec[2:]))
+    rows, cols = int(spec[2]), int(spec[4])
+    return mesh_mod.make_mesh_2d(
+        (rows, cols), devices=jax.devices()[: rows * cols]
+    )
+
+
+def _check_schedule(cfg: RedistConfig) -> CheckResult:
+    """The compiled branch tables cover every cell exactly once."""
+    from gol_tpu.parallel import redistribute as rd
+    from gol_tpu.resilience import reshard as rs
+
+    findings: List[Finding] = []
+    src_mesh, dst_mesh = _mesh(cfg.src), _mesh(cfg.dst)
+    src = rs.MeshLayout.from_mesh(src_mesh)
+    dst = rs.MeshLayout.from_mesh(dst_mesh)
+    plan = rs.plan_reshard(SHAPE, src.boxes(SHAPE), src, dst)
+    try:
+        sched = rd.board_schedule(plan, src_mesh, dst_mesh)
+        canvas = rd.schedule_coverage(sched)
+    except rs.ReshardError as e:
+        findings.append(
+            Finding(ERROR, "redist-schedule", f"schedule build failed: {e}")
+        )
+        return CheckResult.from_findings("redist-schedule", findings)
+    if not (canvas == 1).all():
+        over = int((canvas > 1).sum())
+        under = int((canvas == 0).sum())
+        findings.append(
+            Finding(
+                ERROR,
+                "redist-schedule",
+                f"branch tables are not exactly-once: {over} cells "
+                f"written more than once, {under} never",
+            )
+        )
+    findings.append(
+        Finding(
+            INFO,
+            "redist-schedule",
+            f"{len(sched.shifts)} ppermute phases over a "
+            f"{sched.n}-device union",
+        )
+    )
+    return CheckResult.from_findings("redist-schedule", findings)
+
+
+def _check_executed(cfg: RedistConfig) -> CheckResult:
+    """device_reshard lands the same bits the host path would."""
+    import jax
+
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import redistribute as rd
+
+    findings: List[Finding] = []
+    src_mesh, dst_mesh = _mesh(cfg.src), _mesh(cfg.dst)
+    rng = np.random.default_rng(hash(cfg.name) % (2**32))
+    board = (rng.random(SHAPE) < 0.5).astype(np.uint8)
+    placed = (
+        mesh_mod.shard_board(jax.numpy.asarray(board), src_mesh)
+        if src_mesh is not None
+        else jax.device_put(jax.numpy.asarray(board))
+    )
+    out = rd.device_reshard(placed, src_mesh, dst_mesh)
+    if not np.array_equal(np.asarray(out), board):
+        findings.append(
+            Finding(
+                ERROR,
+                "redist-exec",
+                "device reshard changed the board — the collective is "
+                "not bit-exact against the host truth",
+            )
+        )
+    if dst_mesh is not None:
+        want = mesh_mod.board_sharding(dst_mesh)
+        if not out.sharding.is_equivalent_to(want, out.ndim):
+            findings.append(
+                Finding(
+                    ERROR,
+                    "redist-exec",
+                    "landed board is not under the destination mesh's "
+                    "canonical sharding",
+                )
+            )
+    if not findings:
+        findings.append(
+            Finding(INFO, "redist-exec", "bit-equal under dst sharding")
+        )
+    return CheckResult.from_findings("redist-exec", findings)
+
+
+def _check_teeth(cfg: RedistConfig) -> CheckResult:
+    """Broken plans must be rejected before any program is built."""
+    import jax
+
+    from gol_tpu.parallel import mesh as mesh_mod
+    from gol_tpu.parallel import redistribute as rd
+    from gol_tpu.resilience import reshard as rs
+
+    findings: List[Finding] = []
+    src_mesh, dst_mesh = _mesh(cfg.src), _mesh(cfg.dst)
+    src = rs.MeshLayout.from_mesh(src_mesh)
+    dst = rs.MeshLayout.from_mesh(dst_mesh)
+    plan = rs.plan_reshard(SHAPE, src.boxes(SHAPE), src, dst)
+    if not plan.moves or not plan.moves[-1][1]:
+        return CheckResult.skipped("redist-teeth", "plan has no moves")
+    dbox, srcs = plan.moves[-1]
+    broken = [
+        (
+            "overlapping move",
+            dataclasses.replace(
+                plan, moves=plan.moves[:-1] + ((dbox, srcs + (srcs[0],)),)
+            ),
+        ),
+        (
+            "gapped move",
+            dataclasses.replace(
+                plan, moves=plan.moves[:-1] + ((dbox, srcs[:-1]),)
+            ),
+        ),
+    ]
+    board = np.zeros(SHAPE, np.uint8)
+    placed = (
+        mesh_mod.shard_board(jax.numpy.asarray(board), src_mesh)
+        if src_mesh is not None
+        else jax.device_put(jax.numpy.asarray(board))
+    )
+    for label, bad in broken:
+        try:
+            rd.device_reshard(placed, src_mesh, dst_mesh, plan=bad)
+        except (rs.ReshardError, rs.ReshardPlanError) as e:
+            findings.append(
+                Finding(INFO, "redist-teeth", f"{label} rejected: {e}")
+            )
+        else:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "redist-teeth",
+                    f"broken fixture ({label}) EXECUTED — the device "
+                    "collective accepts unvalidated move tables",
+                )
+            )
+    return CheckResult.from_findings("redist-teeth", findings)
+
+
+def _check_worlds() -> CheckResult:
+    """The [B, H, W] stack variant is bit-exact across mesh sizes."""
+    import jax
+
+    from gol_tpu.batch import engines as batch_engines
+    from gol_tpu.parallel import redistribute as rd
+
+    findings: List[Finding] = []
+    rng = np.random.default_rng(7)
+    h, w = WORLD_HW
+    stack = (rng.random((BATCH, h, w)) < 0.5).astype(np.uint8)
+    meshes = {
+        1: None,
+        2: batch_engines.make_batch_mesh(2),
+        4: batch_engines.make_batch_mesh(4),
+    }
+    for n_src, n_dst in [(1, 4), (4, 1), (2, 4), (4, 2)]:
+        src_mesh, dst_mesh = meshes[n_src], meshes[n_dst]
+        placed = (
+            jax.device_put(
+                jax.numpy.asarray(stack),
+                batch_engines.batch_sharding(src_mesh),
+            )
+            if src_mesh is not None
+            else jax.device_put(jax.numpy.asarray(stack))
+        )
+        out = rd.device_reshard_worlds(placed, src_mesh, dst_mesh)
+        if not np.array_equal(np.asarray(out), stack):
+            findings.append(
+                Finding(
+                    ERROR,
+                    "redist-worlds",
+                    f"worlds reshard {n_src}->{n_dst} devices is not "
+                    "bit-exact",
+                )
+            )
+    if not findings:
+        findings.append(
+            Finding(
+                INFO, "redist-worlds",
+                "stack bit-equal across 1/2/4-device worlds meshes",
+            )
+        )
+    return CheckResult.from_findings("redist-worlds", findings)
+
+
+def run_redist_checks() -> List[EngineReport]:
+    """One :class:`EngineReport` per src→dst pair, plus the worlds cell."""
+    reports = []
+    for cfg in default_redist_matrix():
+        rep = EngineReport(config_name=cfg.name)
+        rep.checks.append(_check_schedule(cfg))
+        rep.checks.append(_check_executed(cfg))
+        rep.checks.append(_check_teeth(cfg))
+        reports.append(rep)
+    worlds = EngineReport(config_name="redist-worlds-stack")
+    worlds.checks.append(_check_worlds())
+    reports.append(worlds)
+    return reports

@@ -1,0 +1,645 @@
+"""Device-side resharding: the live-elasticity collective (ROADMAP 4b).
+
+``resilience/reshard.py`` makes snapshots portable across topologies,
+but its transport is the host: pieces are read, repacked, and re-placed
+through ``make_array_from_callback``.  That is the right shape for a
+*restart* event; a *live* mesh change (device loss, capacity return —
+docs/RESILIENCE.md, "Live elasticity") cannot afford the device→host
+round trip.  This module executes the SAME validated
+:class:`~gol_tpu.resilience.reshard.ReshardPlan` move table as a
+``shard_map`` program of ``lax.ppermute`` phases over bit-packed words,
+so a board (or a batch-tier world stack) moves from mesh A to mesh B
+without the cells ever leaving device memory:
+
+- **pack** — a ``shard_map`` over the source mesh packs each shard
+  in-graph (:mod:`gol_tpu.ops.bitlife` layout, 32 cells per uint32
+  word), stacking the pieces along a leading axis.
+- **exchange** — a flat 1-D transfer mesh over the union of source and
+  destination devices runs one ``ppermute`` ring-shift phase per
+  distinct (src device → dst device) offset in the move table — the
+  portable all-to-all of the redistribution paper (PAPERS.md), as a
+  persistent schedule rebuilt only when the plan changes (the
+  persistent-collective framing of the partitioned-MPI paper).  Each
+  device then assembles its destination shard with a
+  ``lax.switch`` over statically unrolled per-device move lists; column
+  seams that cut a source word mid-bit are realigned with the same
+  logical-shift pair the host path uses (``w >> s | w' << 32-s``), in
+  the graph.
+- **land** — a ``shard_map`` over the destination mesh unpacks the
+  assembled words into the canonical board sharding.
+
+The executor is pinned bit-equal to the host-side ``load_resharded``
+path on every none/1d/2d grow+shrink pair (tests/test_redistribute.py)
+and its static program is re-verified by
+``gol_tpu/analysis/redistcheck.py`` (exactly-once coverage derived from
+the branch tables themselves, plus broken-plan TEETH).  Transport is
+destination-major: the union device list starts with the destination
+mesh so landing is a prefix slice and the exchange output already sits
+on the devices that keep it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gol_tpu.compat import shard_map
+from gol_tpu.ops import bitlife
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.resilience.reshard import (
+    Box,
+    MeshLayout,
+    ReshardError,
+    ReshardPlan,
+    WORD_BITS,
+    plan_reshard,
+    validate_plan,
+)
+
+XFER = "xfer"  # the flat union-mesh axis the exchange phases ride
+
+
+# -- packed helpers (arbitrary widths; bitlife.pack wants 32-multiples) -------
+
+
+def _packed_words(width: int) -> int:
+    return -(-width // WORD_BITS)
+
+
+def _pack_cells(cells: jax.Array) -> jax.Array:
+    """uint8[h, w] -> uint32[h, ceil(w/32)], padding the tail word."""
+    h, w = cells.shape
+    pad = (-w) % WORD_BITS
+    if pad:
+        cells = jnp.pad(cells, ((0, 0), (0, pad)))
+    return bitlife.pack(cells)
+
+
+def _unpack_cells(words: jax.Array, width: int) -> jax.Array:
+    return bitlife.unpack(words)[:, :width]
+
+
+def _extract_cols(words: jax.Array, c0: int, c1: int) -> jax.Array:
+    """Packed cells ``[c0, c1)`` realigned so bit 0 is column ``c0``.
+
+    The in-graph twin of ``reshard.slice_packed_cols``: a shift pair
+    (``w[k] >> s | w[k+1] << 32-s``) when the seam cuts mid-word, a
+    plain word slice when it does not.  The tail word is masked so the
+    result ORs cleanly into a destination canvas.
+    """
+    nb = c1 - c0
+    q, s = divmod(c0, WORD_BITS)
+    now = _packed_words(nb)
+    need = now + (1 if s else 0)
+    w = words[:, q : q + need]
+    if w.shape[1] < need:
+        w = jnp.pad(w, ((0, 0), (0, need - w.shape[1])))
+    if s:
+        out = (w[:, :now] >> np.uint32(s)) | (
+            w[:, 1 : now + 1] << np.uint32(WORD_BITS - s)
+        )
+    else:
+        out = w
+    tail = nb % WORD_BITS
+    if tail:
+        out = out.at[:, now - 1].set(
+            out[:, now - 1] & np.uint32((1 << tail) - 1)
+        )
+    return out
+
+
+def _deposit_cols(
+    canvas: jax.Array,
+    r0: int,
+    r1: int,
+    bits: jax.Array,
+    c0: int,
+    nb: int,
+) -> jax.Array:
+    """OR ``bits`` (bit 0 = dst column ``c0``) into the canvas rows."""
+    q, s = divmod(c0, WORD_BITS)
+    if s:
+        lo = bits << np.uint32(s)
+        hi = bits >> np.uint32(WORD_BITS - s)
+        shifted = jnp.concatenate(
+            [lo, jnp.zeros_like(bits[:, :1])], axis=1
+        )
+        shifted = shifted.at[:, 1:].set(shifted[:, 1:] | hi)
+    else:
+        shifted = bits
+    # The carry word can poke past the canvas only when its content is
+    # already zero (the tail was masked at extraction) — clip it.
+    span = min(shifted.shape[1], canvas.shape[1] - q)
+    region = canvas[r0:r1, q : q + span]
+    return canvas.at[r0:r1, q : q + span].set(region | shifted[:, :span])
+
+
+# -- the static exchange schedule --------------------------------------------
+
+
+class _Schedule:
+    """Everything the SPMD program needs, derived once per plan.
+
+    ``branch_moves[p]`` lists, for the device at union position ``p``,
+    the statically-resolved moves that build its destination piece:
+    ``(phase, src_box, dst_box, inter)`` — which received buffer to
+    read and which rectangle to cut and paste.  ``redistcheck`` paints
+    its coverage canvas from THESE tables (not from the plan), so a bug
+    in the phase assignment — not just in the geometry — fails the
+    verify gate.
+    """
+
+    def __init__(
+        self,
+        plan: ReshardPlan,
+        src_devices: Sequence,
+        dst_devices: Sequence,
+    ) -> None:
+        validate_plan(plan)
+        self.plan = plan
+        self.src_boxes: List[Box] = plan.src.boxes(plan.shape)
+        self.dst_boxes: List[Box] = [d for d, _ in plan.moves]
+        if len(self.src_boxes) != len(src_devices):
+            raise ReshardError(
+                f"plan has {len(self.src_boxes)} source pieces but the "
+                f"source mesh holds {len(src_devices)} devices"
+            )
+        if len(self.dst_boxes) != len(dst_devices):
+            raise ReshardError(
+                f"plan has {len(self.dst_boxes)} destination shards but "
+                f"the destination mesh holds {len(dst_devices)} devices"
+            )
+        # Destination-major union: landing is a prefix slice.
+        self.union = list(dict.fromkeys(list(dst_devices) + list(src_devices)))
+        self.n = len(self.union)
+        upos = {d: p for p, d in enumerate(self.union)}
+        self.pos_src = [upos[d] for d in src_devices]
+        self.pos_dst = [upos[d] for d in dst_devices]
+        src_index = {b: i for i, b in enumerate(self.src_boxes)}
+        shifts = sorted(
+            {
+                (self.pos_dst[j] - self.pos_src[src_index[sbox]]) % self.n
+                for j, (_, srcs) in enumerate(plan.moves)
+                for sbox, _ in srcs
+            }
+        )
+        self.shifts: List[int] = shifts
+        phase_of = {s: k for k, s in enumerate(shifts)}
+        self.branch_moves: List[List[Tuple[int, Box, Box, Box]]] = [
+            [] for _ in range(self.n)
+        ]
+        for j, (dbox, srcs) in enumerate(plan.moves):
+            p = self.pos_dst[j]
+            for sbox, inter in srcs:
+                q = self.pos_src[src_index[sbox]]
+                self.branch_moves[p].append(
+                    (phase_of[(p - q) % self.n], sbox, dbox, inter)
+                )
+
+
+def schedule_coverage(sched: "_Schedule") -> np.ndarray:
+    """Per-cell write counts implied by the *compiled* branch tables.
+
+    Exactly-once on-device means this canvas is all-ones.  It is
+    deliberately derived from :attr:`_Schedule.branch_moves` — the
+    structures the traced program actually unrolls — rather than from
+    the plan, so the verifier re-proves the phase assignment, not just
+    the geometry ``validate_plan`` already covered.
+    """
+    h, w = sched.plan.shape
+    canvas = np.zeros((h, w), np.int64)
+    for p, moves in enumerate(sched.branch_moves):
+        for _, _, dbox, inter in moves:
+            if _boxpos(sched, dbox) != p:
+                raise ReshardError(
+                    f"branch {p} writes into foreign dst box {dbox}"
+                )
+            canvas[inter[0] : inter[1], inter[2] : inter[3]] += 1
+    return canvas
+
+
+def _boxpos(sched: "_Schedule", dbox: Box) -> int:
+    return sched.pos_dst[sched.dst_boxes.index(dbox)]
+
+
+# -- program construction -----------------------------------------------------
+
+
+def _xfer_mesh(sched: _Schedule) -> Mesh:
+    return Mesh(np.asarray(sched.union), (XFER,))
+
+
+def _exchange_fn(sched: _Schedule, piece_shape, canvas_shape):
+    """The per-device exchange+assemble program over the union mesh.
+
+    ``piece_shape``/``canvas_shape`` are the (rows, words) blocks of one
+    packed source piece / destination piece.  Rectangles and shifts are
+    baked in; the traced graph is identical for identical plans, and no
+    host state (fault plane, health plane) is consulted — the
+    trace-identity pin in tests/test_redistribute.py holds the program
+    to that.
+    """
+    n = sched.n
+    shifts = sched.shifts
+
+    def _branch(p: int):
+        moves = sched.branch_moves[p]
+
+        def build(recv):
+            canvas = jnp.zeros(canvas_shape, jnp.uint32)
+            for phase, sbox, dbox, inter in moves:
+                r0, r1, c0, c1 = inter
+                piece = recv[phase]
+                rows = piece[r0 - sbox[0] : r1 - sbox[0]]
+                bits = _extract_cols(rows, c0 - sbox[2], c1 - sbox[2])
+                canvas = _deposit_cols(
+                    canvas,
+                    r0 - dbox[0],
+                    r1 - dbox[0],
+                    bits,
+                    c0 - dbox[2],
+                    c1 - c0,
+                )
+            return canvas
+
+        return build
+
+    branches = [_branch(p) for p in range(n)]
+
+    def exchange(stacked):
+        piece = stacked[0]
+        recvs = []
+        for s in shifts:
+            if s == 0:
+                recvs.append(piece)
+            else:
+                perm = [(q, (q + s) % n) for q in range(n)]
+                recvs.append(lax.ppermute(piece, XFER, perm))
+        recv = jnp.stack(recvs)
+        idx = lax.axis_index(XFER)
+        return lax.switch(idx, branches, recv)[None]
+
+    return exchange
+
+
+@functools.lru_cache(maxsize=32)
+def _board_program(
+    plan: ReshardPlan,
+    src_mesh: Optional[Mesh],
+    dst_mesh: Optional[Mesh],
+):
+    """(pack, exchange, land) jitted callables for one board reshard."""
+    h, w = plan.shape
+    src_devs = (
+        list(src_mesh.devices.flat) if src_mesh is not None
+        else [jax.devices()[0]]
+    )
+    dst_devs = (
+        list(dst_mesh.devices.flat) if dst_mesh is not None
+        else [jax.devices()[0]]
+    )
+    sched = _Schedule(plan, src_devs, dst_devs)
+    sb0 = sched.src_boxes[0]
+    db0 = sched.dst_boxes[0]
+    piece_shape = (sb0[1] - sb0[0], _packed_words(sb0[3] - sb0[2]))
+    canvas_shape = (db0[1] - db0[0], _packed_words(db0[3] - db0[2]))
+    xmesh = _xfer_mesh(sched)
+    xspec = NamedSharding(xmesh, P(XFER, None, None))
+
+    if src_mesh is None:
+        pack = jax.jit(lambda b: _pack_cells(b)[None])
+    else:
+        axes = (
+            (mesh_mod.ROWS, mesh_mod.COLS)
+            if mesh_mod.COLS in src_mesh.axis_names
+            else mesh_mod.ROWS
+        )
+        pack = jax.jit(
+            shard_map(
+                lambda b: _pack_cells(b)[None],
+                mesh=src_mesh,
+                in_specs=mesh_mod.board_sharding(src_mesh).spec,
+                out_specs=P(axes, None, None),
+                check_vma=False,
+            )
+        )
+
+    exchange = jax.jit(
+        shard_map(
+            _exchange_fn(sched, piece_shape, canvas_shape),
+            mesh=xmesh,
+            in_specs=P(XFER, None, None),
+            out_specs=P(XFER, None, None),
+            check_vma=False,
+        )
+    )
+
+    dw = db0[3] - db0[2]
+    if dst_mesh is None:
+        land = jax.jit(lambda st: _unpack_cells(st[0], dw))
+    else:
+        daxes = (
+            (mesh_mod.ROWS, mesh_mod.COLS)
+            if mesh_mod.COLS in dst_mesh.axis_names
+            else mesh_mod.ROWS
+        )
+        land = jax.jit(
+            shard_map(
+                lambda st: _unpack_cells(st[0], dw),
+                mesh=dst_mesh,
+                in_specs=P(daxes, None, None),
+                out_specs=mesh_mod.board_sharding(dst_mesh).spec,
+                check_vma=False,
+            )
+        )
+    return sched, pack, exchange, land, xspec
+
+
+def device_reshard(
+    board: jax.Array,
+    src_mesh: Optional[Mesh],
+    dst_mesh: Optional[Mesh],
+    plan: Optional[ReshardPlan] = None,
+) -> jax.Array:
+    """Move ``board`` from ``src_mesh``'s sharding to ``dst_mesh``'s.
+
+    The plan defaults to :func:`plan_reshard` for the two layouts; an
+    explicit plan is re-validated first (the broken-fixture TEETH in
+    ``redistcheck`` rides this), so an overlapping or gapped move table
+    can never reach the device program.  Returns the board under the
+    destination mesh's canonical sharding, bit-equal to the host-side
+    ``load_resharded`` placement of the same cells.
+    """
+    h, w = int(board.shape[0]), int(board.shape[1])
+    src_layout = MeshLayout.from_mesh(src_mesh)
+    dst_layout = MeshLayout.from_mesh(dst_mesh)
+    if plan is None:
+        plan = plan_reshard(
+            (h, w), src_layout.boxes((h, w)), src_layout, dst_layout
+        )
+    else:
+        validate_plan(plan)
+    if plan.shape != (h, w):
+        raise ReshardError(
+            f"plan is for a {plan.shape} board, got {h}x{w}"
+        )
+    if (plan.src, plan.dst) != (src_layout, dst_layout):
+        raise ReshardError(
+            f"plan maps {plan.src.describe()} -> {plan.dst.describe()}, "
+            f"but the live meshes are {src_layout.describe()} -> "
+            f"{dst_layout.describe()}"
+        )
+    sched, pack, exchange, land, xspec = _board_program(
+        plan, src_mesh, dst_mesh
+    )
+    dtype = board.dtype
+    stacked = pack(board.astype(jnp.uint8))
+    stacked = _to_union(stacked, sched, xspec)
+    out = exchange(stacked)
+    landed = _from_union(out, sched, dst_mesh)
+    return land(landed).astype(dtype)
+
+
+def _to_union(stacked, sched: _Schedule, xspec) -> jax.Array:
+    """Route the packed src-piece stack onto its union-mesh positions.
+
+    Union ordering is destination-major, so source piece ``i`` belongs
+    at position ``pos_src[i]`` — a permutation (plus zero slots for
+    devices that only receive).  The heavy all-to-all is the exchange
+    program; this step only relabels buffers (and is a same-device
+    no-op when the meshes overlap).
+    """
+    n_src = len(sched.pos_src)
+    take = np.full((sched.n,), n_src, np.int32)
+    for i, p in enumerate(sched.pos_src):
+        take[p] = i
+    padded = jnp.concatenate(
+        [stacked, jnp.zeros_like(stacked[:1])], axis=0
+    )
+    return jax.device_put(jnp.take(padded, take, axis=0), xspec)
+
+
+def _from_union(out, sched: _Schedule, dst_mesh) -> jax.Array:
+    """Prefix-slice the exchange output back to the destination stack."""
+    n_dst = len(sched.pos_dst)
+    sliced = out[:n_dst]
+    if dst_mesh is None:
+        return jax.device_put(sliced, sched.union[0])
+    daxes = (
+        (mesh_mod.ROWS, mesh_mod.COLS)
+        if mesh_mod.COLS in dst_mesh.axis_names
+        else mesh_mod.ROWS
+    )
+    return jax.device_put(
+        sliced, NamedSharding(dst_mesh, P(daxes, None, None))
+    )
+
+
+# -- batch-tier world stacks --------------------------------------------------
+
+
+def plan_worlds(batch: int, n_src: int, n_dst: int) -> ReshardPlan:
+    """A move table over the worlds axis of a ``[B, H, W]`` stack.
+
+    Worlds reshard as whole rows of a ``(B, 32)`` pseudo-board — the
+    column range is always one full word, so the exchange ships whole
+    packed worlds and never touches the seam repack.  ``B`` must divide
+    both device counts (the serve tier enforces slots % devices == 0).
+    """
+    src = MeshLayout("none") if n_src == 1 else MeshLayout("1d", rows=n_src)
+    dst = MeshLayout("none") if n_dst == 1 else MeshLayout("1d", rows=n_dst)
+    shape = (batch, WORD_BITS)
+    return plan_reshard(shape, src.boxes(shape), src, dst)
+
+
+@functools.lru_cache(maxsize=32)
+def _worlds_program(
+    plan: ReshardPlan,
+    hw: Tuple[int, int],
+    src_mesh: Optional[Mesh],
+    dst_mesh: Optional[Mesh],
+):
+    from gol_tpu.batch import engines as batch_engines
+
+    h, w = hw
+    src_devs = (
+        list(src_mesh.devices.flat) if src_mesh is not None
+        else [jax.devices()[0]]
+    )
+    dst_devs = (
+        list(dst_mesh.devices.flat) if dst_mesh is not None
+        else [jax.devices()[0]]
+    )
+    sched = _Schedule(plan, src_devs, dst_devs)
+    b_src = sched.src_boxes[0][1] - sched.src_boxes[0][0]
+    b_dst = sched.dst_boxes[0][1] - sched.dst_boxes[0][0]
+    ww = _packed_words(w)
+    xmesh = _xfer_mesh(sched)
+    xspec = NamedSharding(xmesh, P(XFER, None, None, None))
+    W = batch_engines.WORLDS
+
+    def _pack_block(block):  # [b, h, w] -> [b, h, ww]
+        return jax.vmap(_pack_cells)(block)
+
+    def _unpack_block(block):  # [b, h, ww] -> [b, h, w]
+        return jax.vmap(lambda ws: _unpack_cells(ws, w))(block)
+
+    if src_mesh is None:
+        pack = jax.jit(lambda st: _pack_block(st)[None])
+    else:
+        pack = jax.jit(
+            shard_map(
+                lambda st: _pack_block(st)[None],
+                mesh=src_mesh,
+                in_specs=P(W, None, None),
+                out_specs=P(W, None, None, None),
+                check_vma=False,
+            )
+        )
+
+    def _branch(p: int):
+        moves = sched.branch_moves[p]
+
+        def build(recv):
+            canvas = jnp.zeros((b_dst, h, ww), jnp.uint32)
+            for phase, sbox, dbox, inter in moves:
+                a0, a1 = inter[0] - sbox[0], inter[1] - sbox[0]
+                d0, d1 = inter[0] - dbox[0], inter[1] - dbox[0]
+                canvas = canvas.at[d0:d1].set(recv[phase][a0:a1])
+            return canvas
+
+        return build
+
+    branches = [_branch(p) for p in range(sched.n)]
+    shifts = sched.shifts
+    n = sched.n
+
+    def exchange_body(stacked):
+        piece = stacked[0]
+        recvs = []
+        for s in shifts:
+            if s == 0:
+                recvs.append(piece)
+            else:
+                perm = [(q, (q + s) % n) for q in range(n)]
+                recvs.append(lax.ppermute(piece, XFER, perm))
+        recv = jnp.stack(recvs)
+        return lax.switch(lax.axis_index(XFER), branches, recv)[None]
+
+    exchange = jax.jit(
+        shard_map(
+            exchange_body,
+            mesh=xmesh,
+            in_specs=P(XFER, None, None, None),
+            out_specs=P(XFER, None, None, None),
+            check_vma=False,
+        )
+    )
+
+    if dst_mesh is None:
+        land = jax.jit(lambda st: _unpack_block(st[0]))
+    else:
+        land = jax.jit(
+            shard_map(
+                lambda st: _unpack_block(st[0]),
+                mesh=dst_mesh,
+                in_specs=P(W, None, None, None),
+                out_specs=P(W, None, None),
+                check_vma=False,
+            )
+        )
+    return sched, pack, exchange, land, xspec, b_src
+
+
+def device_reshard_worlds(
+    stack: jax.Array,
+    src_mesh: Optional[Mesh],
+    dst_mesh: Optional[Mesh],
+    plan: Optional[ReshardPlan] = None,
+) -> jax.Array:
+    """Move a ``[B, H, W]`` world stack between worlds meshes, on device.
+
+    The serve tier's live-elasticity hook: bucket-group stacks ride this
+    at a chunk boundary when the health plane shrinks or regrows the
+    mesh (docs/SERVING.md).  Same contract as :func:`device_reshard`:
+    plan re-validated, result bit-equal to a host round trip.
+    """
+    b, h, w = (int(x) for x in stack.shape)
+    n_src = 1 if src_mesh is None else src_mesh.devices.size
+    n_dst = 1 if dst_mesh is None else dst_mesh.devices.size
+    if plan is None:
+        plan = plan_worlds(b, n_src, n_dst)
+    else:
+        validate_plan(plan)
+    if plan.shape[0] != b:
+        raise ReshardError(
+            f"worlds plan is for {plan.shape[0]} worlds, stack holds {b}"
+        )
+    sched, pack, exchange, land, xspec, _ = _worlds_program(
+        plan, (h, w), src_mesh, dst_mesh
+    )
+    dtype = stack.dtype
+    packed = pack(stack.astype(jnp.uint8))
+    packed = _to_union(packed, sched, xspec)
+    out = exchange(packed)
+    n_dst_slots = len(sched.pos_dst)
+    sliced = out[:n_dst_slots]
+    if dst_mesh is None:
+        sliced = jax.device_put(sliced, sched.union[0])
+    else:
+        from gol_tpu.batch import engines as batch_engines
+
+        sliced = jax.device_put(
+            sliced,
+            NamedSharding(
+                dst_mesh, P(batch_engines.WORLDS, None, None, None)
+            ),
+        )
+    return land(sliced).astype(dtype)
+
+
+# -- verifier surface ---------------------------------------------------------
+
+
+def board_schedule(
+    plan: ReshardPlan,
+    src_mesh: Optional[Mesh],
+    dst_mesh: Optional[Mesh],
+) -> _Schedule:
+    """The static exchange schedule ``redistcheck`` audits (no tracing)."""
+    src_devs = (
+        list(src_mesh.devices.flat) if src_mesh is not None
+        else [jax.devices()[0]]
+    )
+    dst_devs = (
+        list(dst_mesh.devices.flat) if dst_mesh is not None
+        else [jax.devices()[0]]
+    )
+    return _Schedule(plan, src_devs, dst_devs)
+
+
+def lowered_exchange_text(
+    plan: ReshardPlan,
+    src_mesh: Optional[Mesh],
+    dst_mesh: Optional[Mesh],
+) -> str:
+    """Lowered text of the exchange program (the trace-identity pin).
+
+    The health plane and fault plane are host-side by construction;
+    arming either must leave this string byte-identical.
+    """
+    sched, _, exchange, _, xspec = _board_program(plan, src_mesh, dst_mesh)
+    sb0 = sched.src_boxes[0]
+    shape = (
+        sched.n,
+        sb0[1] - sb0[0],
+        _packed_words(sb0[3] - sb0[2]),
+    )
+    arg = jax.ShapeDtypeStruct(shape, jnp.uint32, sharding=xspec)
+    return str(exchange.lower(arg).as_text())

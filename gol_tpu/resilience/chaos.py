@@ -36,6 +36,12 @@ Expectation classes (the ``kind`` field of a scenario):
   shedding telemetry before checkpoints (v9 ``degraded`` stamped).
 - ``telemetry``  — failing rank-file write: the run completes, the
   stream degrades (warn once, drop, ``degraded`` stamp).
+- ``elastic``    — serve-tier live elasticity (``mesh_devices`` armed):
+  a ``device.loss`` / ``rank.slowdown`` fault must be absorbed
+  **in-process** — no supervisor restart — with every request's board
+  byte-identical to the clean run, and the stream must carry the v11
+  ``health`` verdicts plus (for device loss) the live ``reshard``
+  record (docs/RESILIENCE.md "Live elasticity").
 
 ``crash.exit`` scenarios need a supervisor and real process death; they
 live in the subprocess drills (tests/test_resilience_drill.py,
@@ -56,7 +62,7 @@ import numpy as np
 
 TIERS = ("dense", "bitpack", "pallas", "batch", "activity", "3d", "serve")
 MESHES = ("none", "1d", "2d")
-KINDS = ("guard", "resume", "contain", "shed", "telemetry")
+KINDS = ("guard", "resume", "contain", "shed", "telemetry", "elastic")
 
 #: The committed grid (the acceptance surface of the chaos matrix).
 DEFAULT_PLAN_PATH = os.path.join(
@@ -151,6 +157,7 @@ class _RunCfg:
 class _Outcome:
     final: object  # np array (2-D/3-D) or list of arrays (batch)
     guard_failures: int = 0
+    live_reshards: int = 0  # serve tier: in-process mesh transitions
 
 
 _PATTERN = 4  # deterministic soup, every engine supports it
@@ -260,14 +267,17 @@ def _run_3d(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
     return _Outcome(out)
 
 
-def _run_serve(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
+def _run_serve(mesh_kind: str, plan: ChaosPlan, cfg: _RunCfg, workdir: str):
     """One serving-tier cell: three same-bucket requests (the fault
     plans' ``world`` axis = admission ordinal), all submitted BEFORE the
     drive loop runs — the journal record sequence and the chunk schedule
     are deterministic, so one committed plan file means one behavior.
-    Crash.exit drills need real process death and live in
-    scripts/serve_smoke.py; this cell covers the in-process plane
-    (board faults, journal IO faults, disk-full shedding, stalls)."""
+    ``mesh_kind == "1d"`` shards the bucket groups over a 4-device
+    worlds mesh and arms the health plane — the surface the ``elastic``
+    scenarios drill.  Crash.exit drills need real process death and
+    live in scripts/serve_smoke.py; this cell covers the in-process
+    plane (board faults, journal IO faults, disk-full shedding, stalls,
+    device loss, stragglers)."""
     from gol_tpu.serve.scheduler import ServeScheduler
 
     state_dir = cfg.checkpoint_dir or os.path.join(
@@ -281,6 +291,7 @@ def _run_serve(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
         guard=cfg.guard,
         telemetry_dir=cfg.telemetry_dir,
         run_id=cfg.run_id,
+        mesh_devices=4 if mesh_kind == "1d" else 0,
     )
     try:
         ids = []
@@ -296,7 +307,10 @@ def _run_serve(plan: ChaosPlan, cfg: _RunCfg, workdir: str):
             ids.append(st.request.id)
         sched.run_until_drained()
         boards = [sched.result_board(rid) for rid in ids]
-        return _Outcome(boards, sched.guard_failures)
+        return _Outcome(
+            boards, sched.guard_failures,
+            live_reshards=sched.live_reshards,
+        )
     finally:
         sched.close()
 
@@ -308,7 +322,7 @@ def _run_cell(tier: str, mesh: str, plan: ChaosPlan, cfg: _RunCfg,
     if tier == "3d":
         return _run_3d(plan, cfg, workdir)
     if tier == "serve":
-        return _run_serve(plan, cfg, workdir)
+        return _run_serve(mesh, plan, cfg, workdir)
     engine = {"dense": "dense", "bitpack": "bitpack", "pallas": "pallas",
               "activity": "activity"}[tier]
     return _run_2d(engine, mesh, plan, cfg)
@@ -323,8 +337,9 @@ def _legal(tier: str, mesh: str) -> Optional[str]:
     if tier == "3d" and mesh != "none":
         return "the 3-D driver's mesh is its own (P,R,C) grid; the " \
                "chaos matrix drives it unsharded"
-    if tier == "serve" and mesh != "none":
-        return "the serve scheduler runs bucket groups unsharded (v1)"
+    if tier == "serve" and mesh == "2d":
+        return "the serve worlds axis is 1-D (a 2-D mesh has no " \
+               "meaning for bucket-group sharding)"
     return None
 
 
@@ -431,6 +446,60 @@ def _run_scenario(
                 "resume past the corrupt snapshot did not recover the "
                 "clean grid"
             )
+        elif scenario.kind == "elastic":
+            # The drill needs enough chunk boundaries for loss →
+            # shrink → restore → grow to all land, so it runs its own
+            # longer clean reference instead of the cached one.
+            gens = plan.iterations * 4
+            faults_mod.clear()
+            ref = _run_cell(
+                tier, mesh, plan, _RunCfg(iterations=gens), cell
+            )
+            install()
+            out = _run_cell(
+                tier, mesh, plan,
+                _RunCfg(
+                    iterations=gens, guard=True, telemetry_dir=tm,
+                    run_id="chaos",
+                ),
+                cell,
+            )
+            assert _equal(out.final, ref.final), (
+                "live elasticity changed the computed boards — the "
+                "reshard/hedge path is not byte-exact"
+            )
+            recs = _events(tm)
+            assert not any(r.get("event") == "restart" for r in recs), (
+                "a restart record is on the stream — elasticity must "
+                "be in-process, not supervisor-driven"
+            )
+            sites = {f["site"] for f in scenario.faults}
+            if "device.loss" in sites:
+                assert any(
+                    r.get("event") == "health"
+                    and r.get("verdict") == "device_loss"
+                    for r in recs
+                ), "no v11 device_loss verdict on the stream"
+                assert any(
+                    r.get("event") == "reshard" and r.get("live")
+                    for r in recs
+                ), "no live reshard record — the mesh never moved"
+                assert out.live_reshards >= 1, "scheduler counted no reshard"
+            if any(f.get("restore_after") for f in scenario.faults):
+                assert any(
+                    r.get("event") == "health"
+                    and r.get("verdict") == "device_restore"
+                    for r in recs
+                ), "no device_restore verdict — capacity never grew back"
+                assert out.live_reshards >= 2, (
+                    "restore landed but the mesh never grew back"
+                )
+            if "rank.slowdown" in sites:
+                assert any(
+                    r.get("event") == "health"
+                    and r.get("verdict") in ("straggler", "hedge")
+                    for r in recs
+                ), "no straggler/hedge verdict — the watchdog missed it"
         elif scenario.kind in ("contain", "shed", "telemetry"):
             install()
             out = _run_cell(

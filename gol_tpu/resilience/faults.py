@@ -41,6 +41,16 @@ site                    what fires
                         completes
 ``rank.stall``          sleep ``delay_s`` at a chunk boundary on the chosen
                         rank — the slow-rank hang
+``device.loss``         mark ``device`` lost at a chunk boundary — the health
+                        plane (:mod:`gol_tpu.resilience.health`) turns it
+                        into a live-reshard verdict; ``restore_after`` > 0
+                        brings the device back that many generations later
+                        (the shrink→grow→shrink drill)
+``rank.slowdown``       inflate the measured chunk wall by ``delay_s`` on the
+                        chosen rank — a degraded-but-alive device; the
+                        straggler watchdog must flag it (the wall is
+                        inflated, not slept, so drills stay fast — on real
+                        hardware the measurement needs no injection)
 ======================  =====================================================
 
 Plans load from JSON — ``--fault-plan PATH`` on both CLIs, or the
@@ -73,6 +83,8 @@ SITES = (
     "telemetry.write_error",
     "crash.exit",
     "rank.stall",
+    "device.loss",
+    "rank.slowdown",
 )
 
 #: The documented back-compat alias for a
@@ -99,7 +111,11 @@ class FaultSpec:
     - ``world``: the batch world a ``board.bitflip`` targets (0 for
       single-world runs); ``plane``/``row``/``col`` the cell; ``value``
       the byte to write (-1 = in-range 0↔1 flip).
-    - ``delay_s``: seconds for ``rank.stall`` / ``checkpoint.rename_delay``.
+    - ``delay_s``: seconds for ``rank.stall`` / ``checkpoint.rename_delay``,
+      or the wall inflation a ``rank.slowdown`` reports.
+    - ``device``: the mesh device a ``device.loss`` takes out;
+      ``restore_after`` > 0 schedules its return that many generations
+      after the loss (0 = the device stays gone).
     """
 
     site: str
@@ -113,6 +129,8 @@ class FaultSpec:
     col: int = 0
     value: int = -1
     delay_s: float = 0.0
+    device: int = 0
+    restore_after: int = 0
 
     def __post_init__(self) -> None:
         if self.site not in SITES:
@@ -127,6 +145,11 @@ class FaultSpec:
         if self.delay_s < 0:
             raise FaultPlanError(
                 f"{self.site}: delay_s must be >= 0, got {self.delay_s}"
+            )
+        if self.restore_after < 0:
+            raise FaultPlanError(
+                f"{self.site}: restore_after must be >= 0 "
+                f"(0 = permanent loss), got {self.restore_after}"
             )
 
     @classmethod
@@ -473,6 +496,49 @@ def board_fault_hook():
     if not has_board_faults():
         return None
     return apply_board_faults
+
+
+# -- site: degraded hardware (the health plane's injection points) -----------
+
+
+def device_losses(generation: int) -> List[FaultSpec]:
+    """Consume every armed ``device.loss`` spec due at ``generation``.
+
+    Polled once per chunk boundary by
+    :meth:`gol_tpu.resilience.health.HealthMonitor.poll` — the verdicts
+    (and the live reshard they trigger) belong to the health plane; this
+    plane only decides *that* a device dies, and records it in the fired
+    ledger like every other site.
+    """
+    out = []
+    with _lock:
+        for i in _matching("device.loss", generation):
+            spec = _plan.faults[i]
+            _consume(
+                i,
+                generation,
+                device=spec.device,
+                restore_after=spec.restore_after,
+            )
+            out.append(spec)
+    return out
+
+
+def rank_slowdown(generation: int) -> float:
+    """Seconds of injected chunk-wall inflation due at ``generation``.
+
+    The straggler drill: the watchdog compares the *reported* wall to
+    its fitted baseline, so inflating the measurement (instead of
+    sleeping) exercises the same verdict path without slowing the
+    drill down.
+    """
+    with _lock:
+        hits = _matching("rank.slowdown", generation)
+        if not hits:
+            return 0.0
+        spec = _plan.faults[hits[0]]
+        _consume(hits[0], generation, delay_s=spec.delay_s)
+        return spec.delay_s
 
 
 # -- site: the process -------------------------------------------------------

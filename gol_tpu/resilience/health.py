@@ -1,0 +1,194 @@
+"""The health plane: heartbeats, straggler watchdog, loss/restore verdicts.
+
+The fault plane (:mod:`gol_tpu.resilience.faults`) decides *that*
+hardware degrades; this module decides *what the run does about it* —
+and hands the serving tier the verdicts it live-reshards on
+(docs/RESILIENCE.md, "Live elasticity").  Three signals, all sampled at
+chunk boundaries so the compiled programs never see the plane:
+
+- **heartbeats** — every chunk boundary reports its wall time.  The
+  watchdog fits a baseline (the median of a sliding window of healthy
+  walls) and flags a chunk that exceeds ``straggler_factor`` × baseline
+  as a ``straggler`` verdict.  Straggler walls do not join the window,
+  so one slow rank cannot drag the baseline up and mask itself.
+- **device loss** — armed ``device.loss`` specs fire here; the verdict
+  names the device, and a spec with ``restore_after`` schedules the
+  matching ``device_restore`` verdict (the shrink→grow→shrink drill).
+- **alive set** — the monitor owns which devices are usable; the serve
+  scheduler maps that onto the largest worlds mesh the slot count
+  divides and reshards live at the next boundary.
+
+Every verdict lands as a schema-v11 ``health`` telemetry event and in
+the ``gol_health_*`` metrics (docs/OBSERVABILITY.md).  The plane is
+host-side by construction: with no monitor installed nothing runs, and
+with one installed the compiled chunk programs are byte-identical (the
+trace-identity pin in tests/test_health.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import statistics
+from collections import deque
+from typing import Deque, List, Optional
+
+from gol_tpu.resilience import faults as faults_mod
+
+#: Verdict kinds, in the order a boundary can produce them.
+KINDS = ("device_loss", "device_restore", "straggler")
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """One health-plane decision, ready to stamp into telemetry."""
+
+    kind: str
+    generation: int
+    device: int = -1
+    rank: int = -1
+    wall_s: float = 0.0
+    baseline_s: float = 0.0
+    alive: int = 0
+
+    def to_event(self) -> dict:
+        out = {"verdict": self.kind, "alive": self.alive}
+        if self.device >= 0:
+            out["device"] = self.device
+        if self.kind == "straggler":
+            out["rank"] = self.rank
+            out["wall_s"] = round(self.wall_s, 6)
+            out["baseline_s"] = round(self.baseline_s, 6)
+        return out
+
+
+class HealthMonitor:
+    """Chunk-boundary health sampling over ``num_devices`` devices.
+
+    ``events``/``registry`` mirror the serve scheduler's emission pair:
+    verdicts go to the v11 stream when a log is attached, else straight
+    to the metrics registry — and both stay optional so the monitor
+    works bare in unit tests.
+    """
+
+    def __init__(
+        self,
+        num_devices: int,
+        window: int = 16,
+        straggler_factor: float = 4.0,
+        min_samples: int = 3,
+        min_wall_s: float = 0.010,
+        events=None,
+        registry=None,
+    ) -> None:
+        if num_devices < 1:
+            raise ValueError(f"num_devices must be >= 1, got {num_devices}")
+        if straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must exceed 1, got {straggler_factor}"
+            )
+        self.num_devices = num_devices
+        self.straggler_factor = straggler_factor
+        self.min_samples = min_samples
+        # Sub-10ms chunks jitter by whole multiples of themselves on a
+        # shared host; the watchdog only trusts walls above this floor.
+        self.min_wall_s = min_wall_s
+        self._walls: Deque[float] = deque(maxlen=window)
+        self._alive = set(range(num_devices))
+        self._restores: List[tuple] = []  # (due_generation, device)
+        self._events = events
+        self._registry = registry
+
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def alive(self) -> List[int]:
+        return sorted(self._alive)
+
+    def baseline(self) -> Optional[float]:
+        """The fitted healthy-wall baseline (None until enough samples)."""
+        if len(self._walls) < self.min_samples:
+            return None
+        return statistics.median(self._walls)
+
+    # -- sampling -------------------------------------------------------------
+
+    def poll(self, generation: int) -> List[Verdict]:
+        """Device loss/restore verdicts due at this chunk boundary."""
+        verdicts: List[Verdict] = []
+        for spec in faults_mod.device_losses(generation):
+            if spec.device not in self._alive:
+                continue
+            if len(self._alive) == 1:
+                # The last device cannot be shed — the run would have
+                # nothing to reshard onto; the loss surfaces as a crash
+                # site's problem, not a live-elasticity one.
+                continue
+            self._alive.discard(spec.device)
+            if spec.restore_after > 0:
+                self._restores.append(
+                    (generation + spec.restore_after, spec.device)
+                )
+            verdicts.append(
+                Verdict(
+                    "device_loss",
+                    generation,
+                    device=spec.device,
+                    alive=len(self._alive),
+                )
+            )
+        due = [r for r in self._restores if r[0] <= generation]
+        for r in due:
+            self._restores.remove(r)
+            self._alive.add(r[1])
+            verdicts.append(
+                Verdict(
+                    "device_restore",
+                    generation,
+                    device=r[1],
+                    alive=len(self._alive),
+                )
+            )
+        self._emit(verdicts)
+        return verdicts
+
+    def heartbeat(
+        self, generation: int, wall_s: float, rank: int = 0
+    ) -> List[Verdict]:
+        """Report one chunk wall; returns any straggler verdict.
+
+        An armed ``rank.slowdown`` inflates the reported wall here —
+        the injection point for the watchdog drill.
+        """
+        wall = wall_s + faults_mod.rank_slowdown(generation)
+        base = self.baseline()
+        verdicts: List[Verdict] = []
+        if (
+            base is not None
+            and wall > self.min_wall_s
+            and wall > self.straggler_factor * max(base, 1e-9)
+        ):
+            verdicts.append(
+                Verdict(
+                    "straggler",
+                    generation,
+                    rank=rank,
+                    wall_s=wall,
+                    baseline_s=base,
+                    alive=len(self._alive),
+                )
+            )
+        else:
+            self._walls.append(wall)
+        self._emit(verdicts)
+        return verdicts
+
+    # -- emission -------------------------------------------------------------
+
+    def _emit(self, verdicts: List[Verdict]) -> None:
+        for v in verdicts:
+            payload = v.to_event()
+            if self._events is not None:
+                self._events.health_event(generation=v.generation, **payload)
+            elif self._registry is not None:
+                rec = dict(event="health", generation=v.generation, **payload)
+                self._registry.observe(rec)

@@ -82,6 +82,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="journal compaction period, in completed requests",
     )
     p.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="shard bucket groups over a worlds mesh of N devices and "
+        "arm the health plane: device loss / stragglers live-reshard "
+        "at chunk boundaries instead of crashing (0 = unsharded; "
+        "N must divide --slots)",
+    )
+    p.add_argument(
         "--fault-plan", default=None,
         help="fault-injection plan (path or inline JSON; default: "
         "the GOL_FAULT_PLAN environment variable)",
@@ -128,6 +135,7 @@ def main(argv=None) -> int:
         registry=registry,
         keep_journal_segments=ns.keep_journal_segments,
         compact_every=ns.compact_every,
+        mesh_devices=ns.mesh_devices,
     )
     server = ServeServer(scheduler, ns.port, registry=registry)
     stop = server.stop_event

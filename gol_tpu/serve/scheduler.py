@@ -42,8 +42,22 @@ handler threads) against :meth:`run_once` (the drive loop).  The
 scheduler itself is synchronous — chaos cells and tests drive
 :meth:`run_until_drained` deterministically in-process; the HTTP server
 runs the same loop on its main thread (:mod:`gol_tpu.serve.server`).
-v1 runs groups unsharded (``mesh=None``) — cross-chip serving is a
-placement follow-up, not a semantics one.
+
+**Live elasticity** (``mesh_devices > 0``, docs/RESILIENCE.md "Live
+elasticity"): bucket groups run sharded over a ``worlds`` mesh, and a
+:class:`gol_tpu.resilience.health.HealthMonitor` samples the fault
+plane at every chunk boundary.  A ``device_loss`` verdict shrinks the
+mesh to the largest slot-divisible survivor set at the **next** chunk
+boundary — every live group stack (and its guard last-good copy) moves
+through :func:`gol_tpu.parallel.redistribute.device_reshard_worlds`
+without leaving device memory, the journal is untouched (committed
+requests keep their exactly-once guarantee), and admissions are
+throttled proportional to the lost capacity.  ``device_restore`` grows
+the mesh back the same way.  A ``straggler`` verdict triggers a hedged
+replay of that bucket's chunk from the fingerprint-verified last-good
+stack, with the guard's fingerprint picking the winner.  With
+``mesh_devices=0`` (the default) groups run unsharded and none of this
+machinery exists — the compiled chunk programs are byte-identical.
 """
 
 from __future__ import annotations
@@ -64,6 +78,16 @@ from gol_tpu.serve import journal as journal_mod
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 _ENGINES = ("auto", "dense", "bitpack", "pallas_bitpack")
 _RULE = "B3/S23"
+
+#: The 429 ``retry_after`` hint is queue-position / observed drain rate,
+#: clamped to [_RETRY_AFTER_MIN, _RETRY_AFTER_MAX].  During the
+#: zero-completions startup window no drain rate exists yet — the hint
+#: falls back to _RETRY_AFTER_DEFAULT seconds per request ahead (the
+#: documented default a well-behaved client sleeps on), never a
+#: divide-by-zero guess (docs/SERVING.md "Backpressure").
+_RETRY_AFTER_DEFAULT = 0.5
+_RETRY_AFTER_MIN = 0.1
+_RETRY_AFTER_MAX = 30.0
 
 
 class ValidationError(ValueError):
@@ -161,12 +185,21 @@ class ServeScheduler:
         registry=None,
         keep_journal_segments: int = 2,
         compact_every: int = 16,
+        mesh_devices: int = 0,
+        health=None,
     ) -> None:
         from gol_tpu.resilience import faults as faults_mod
 
         if slots < 1 or queue_depth < 1 or chunk < 1 or quantum < 1:
             raise ValueError(
                 "slots, queue_depth, chunk, and quantum must all be >= 1"
+            )
+        if mesh_devices < 0:
+            raise ValueError(f"mesh_devices must be >= 0, got {mesh_devices}")
+        if mesh_devices and slots % mesh_devices:
+            raise ValueError(
+                f"slots ({slots}) must be divisible by mesh_devices "
+                f"({mesh_devices}) — the worlds axis shards evenly"
             )
         self.state_dir = state_dir
         self.results_dir = os.path.join(state_dir, "results")
@@ -200,6 +233,16 @@ class ServeScheduler:
         self.rejected_total = 0
         self.completed_total = 0
         self.cancelled_total = 0
+        self.mesh_devices = mesh_devices
+        self.live_reshards = 0
+        self.hedges = 0
+        self._cur_mesh = None  # active worlds mesh (None = unsharded)
+        self._cur_n = 0
+        self._devices: list = []  # the full pool, index = monitor device id
+        self._resharding = False  # readiness drops from verdict → reshard
+        self._pending_resize = False
+        self._health = health
+        self._complete_times: collections.deque = collections.deque(maxlen=32)
 
         self._registry = registry
         self._events = None
@@ -211,20 +254,34 @@ class ServeScheduler:
             )
             if registry is not None:
                 self._events.observer = registry.observe
-            self._events.run_header(
-                {
-                    "driver": "serve",
-                    "engine": default_engine,
-                    "bucket_quantum": quantum,
-                    "slots": slots,
-                    "queue_depth": queue_depth,
-                    "chunk": chunk,
-                    "guard": guard,
-                }
-            )
+            header = {
+                "driver": "serve",
+                "engine": default_engine,
+                "bucket_quantum": quantum,
+                "slots": slots,
+                "queue_depth": queue_depth,
+                "chunk": chunk,
+                "guard": guard,
+            }
+            if mesh_devices > 0:
+                header["mesh_devices"] = mesh_devices
+            self._events.run_header(header)
             attempt = _restart_attempt()
             if attempt > 0:
                 self._events.restart_event(attempt)
+
+        if mesh_devices > 0:
+            from gol_tpu.batch import engines as batch_engines
+
+            self._cur_mesh = batch_engines.make_batch_mesh(mesh_devices)
+            self._cur_n = mesh_devices
+            self._devices = list(self._cur_mesh.devices.flat)
+            if self._health is None:
+                from gol_tpu.resilience.health import HealthMonitor
+
+                self._health = HealthMonitor(
+                    mesh_devices, events=self._events, registry=registry
+                )
 
         self._journal = journal_mod.Journal(
             os.path.join(state_dir, "journal.jsonl")
@@ -255,7 +312,8 @@ class ServeScheduler:
                     retry_after=30.0,
                 )
             grp = self._group_for(req)
-            if len(grp.queue) >= self.queue_depth:
+            depth = self._effective_queue_depth()
+            if len(grp.queue) >= depth:
                 # PR 10 shed order: the first backpressure signal sheds
                 # stats streaming before anything else.
                 self._shed_stats(f"bucket {grp.label} queue full")
@@ -266,8 +324,7 @@ class ServeScheduler:
                 )
                 raise Rejected(
                     429,
-                    f"bucket {grp.label} queue full "
-                    f"({self.queue_depth} waiting)",
+                    f"bucket {grp.label} queue full ({depth} waiting)",
                     retry_after=self._retry_after(grp),
                 )
             ordinal = self._next_ordinal
@@ -317,6 +374,18 @@ class ServeScheduler:
     def draining(self) -> bool:
         return self._draining
 
+    @property
+    def ready(self) -> bool:
+        """Readiness (the /readyz contract): liveness is the process
+        being up; readiness additionally means the scheduler is
+        admitting and not mid-transition — false while draining, while
+        admissions are shed, and through a live-reshard window (from
+        the health verdict until the mesh transition lands)."""
+        with self._lock:
+            return not (
+                self._draining or self._admissions_shed or self._resharding
+            )
+
     def outstanding(self) -> int:
         """Committed requests not yet in a terminal state."""
         with self._lock:
@@ -332,6 +401,14 @@ class ServeScheduler:
         every occupied group one chunk.  Returns whether device work ran
         (False = idle; callers sleep)."""
         with self._lock:
+            if self._health is not None:
+                if self._pending_resize:
+                    # The verdict landed at the PREVIOUS boundary; this
+                    # is "the next chunk boundary" the contract promises.
+                    self._pending_resize = False
+                    self._resize_mesh()
+                    self._resharding = False
+                self._poll_health()
             self._expire_deadlines()
             self._refill()
             did = False
@@ -458,9 +535,36 @@ class ServeScheduler:
             self._groups[key] = grp
         return grp
 
+    def _drain_rate(self) -> float:
+        """Completions/second over the recent completion window.  0.0
+        while fewer than two completions have landed — the startup
+        window in which no rate can be estimated."""
+        ts = self._complete_times
+        if len(ts) >= 2 and ts[-1] > ts[0]:
+            return (len(ts) - 1) / (ts[-1] - ts[0])
+        return 0.0
+
     def _retry_after(self, grp: _BucketGroup) -> float:
         inflight = sum(1 for s in grp.slots if s is not None)
-        return round(0.1 * (len(grp.queue) + inflight) + 0.1, 3)
+        ahead = len(grp.queue) + inflight
+        rate = self._drain_rate()
+        if rate <= 0.0:
+            # Zero-completions startup window: clamp to the documented
+            # per-request default rather than guessing from a rate that
+            # does not exist yet.
+            hint = _RETRY_AFTER_DEFAULT * max(ahead, 1)
+        else:
+            hint = ahead / rate
+        return round(min(max(hint, _RETRY_AFTER_MIN), _RETRY_AFTER_MAX), 3)
+
+    def _effective_queue_depth(self) -> int:
+        """Admission depth, throttled proportional to lost capacity:
+        with half the devices dead, each bucket accepts half its queue
+        (never below one slot — the tier keeps serving)."""
+        if self._health is None or self.mesh_devices <= 0:
+            return self.queue_depth
+        frac = len(self._health.alive) / float(self.mesh_devices)
+        return max(1, int(self.queue_depth * frac))
 
     def _depths(self) -> dict:
         return {
@@ -639,6 +743,20 @@ class ServeScheduler:
 
     def _refill(self) -> None:
         for grp in self._groups.values():
+            if not grp.queue or all(s is not None for s in grp.slots):
+                continue
+            # A join drops the device stack (membership changed), and
+            # the next stack is rebuilt from host boards — which are
+            # only refreshed on completion.  Host-sync the residents
+            # first or a mid-flight join silently rewinds them to their
+            # last synced board (generations run since are lost, while
+            # the generation counter keeps counting).
+            if grp.stack is not None:
+                host = np.asarray(grp.stack)
+                for k, s in enumerate(grp.slots):
+                    if s is not None:
+                        n = s.request.size
+                        s.board = host[k, :n, :n].copy()
             for k, slot in enumerate(grp.slots):
                 if slot is not None or not grp.queue:
                     continue
@@ -669,9 +787,19 @@ class ServeScheduler:
             for s in grp.slots
         ]
         stack, hs, ws = stack_worlds(boards, grp.shape)
-        grp.stack = jax.device_put(stack)
-        grp.hs = jax.device_put(hs)
-        grp.ws = jax.device_put(ws)
+        if self._cur_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from gol_tpu.batch.engines import WORLDS, batch_sharding
+
+            vec = NamedSharding(self._cur_mesh, PartitionSpec(WORLDS))
+            grp.stack = jax.device_put(stack, batch_sharding(self._cur_mesh))
+            grp.hs = jax.device_put(hs, vec)
+            grp.ws = jax.device_put(ws, vec)
+        else:
+            grp.stack = jax.device_put(stack)
+            grp.hs = jax.device_put(hs)
+            grp.ws = jax.device_put(ws)
         force_ready(grp.stack)
         if self.guard:
             from gol_tpu.utils import guard as guard_mod
@@ -695,7 +823,7 @@ class ServeScheduler:
             self.chunk, min(s.remaining for _, s in active)
         )
         compiled = batch_engines.compiled_batch_evolver(
-            grp.engine, take, True, self.tile_hint, None
+            grp.engine, take, True, self.tile_hint, self._cur_mesh
         )
         if grp.stack is None:
             self._build_stack(grp)
@@ -705,11 +833,17 @@ class ServeScheduler:
         gen_after = grp.gens + take
         restores = 0
         audits = None
+        straggler = False
+        pre_good = grp.last_good if self.guard else None
         while True:
             t0 = time.perf_counter()
             candidate = compiled(grp.stack, grp.hs, grp.ws)
             force_ready(candidate)
             wall = time.perf_counter() - t0
+            if self._health is not None:
+                hv = self._health.heartbeat(gen_after, wall)
+                if any(v.kind == "straggler" for v in hv):
+                    straggler = True
             if self._plan_on:
                 candidate = faults_mod.apply_board_faults(
                     candidate, gen_after, world_ids=world_ids
@@ -750,6 +884,10 @@ class ServeScheduler:
                     "fingerprint verification"
                 )
             grp.stack = restored
+        if straggler and self.guard and pre_good is not None:
+            candidate, audits = self._hedge_replay(
+                grp, compiled, pre_good, candidate, audits, gen_after
+            )
         grp.gens = gen_after
         self._total_gens += take
         grp.stack = candidate
@@ -798,6 +936,143 @@ class ServeScheduler:
         if self._plan_on:
             faults_mod.crash_or_stall(self._total_gens)
 
+    # -- internals: live elasticity ------------------------------------------
+    def _poll_health(self) -> None:
+        """Sample loss/restore verdicts; a capacity change arms a mesh
+        transition for the NEXT chunk boundary (readiness drops now, so
+        /readyz sees the window the contract documents)."""
+        verdicts = self._health.poll(self._total_gens)
+        if self._cur_mesh is not None and any(
+            v.kind in ("device_loss", "device_restore") for v in verdicts
+        ):
+            self._pending_resize = True
+            self._resharding = True
+
+    def _resize_mesh(self) -> None:
+        """Move every live group stack onto the largest slot-divisible
+        mesh the surviving devices support — on device, through the
+        all-to-all collective, journal untouched."""
+        from gol_tpu.batch import engines as batch_engines
+        from gol_tpu.parallel import redistribute
+
+        alive = self._health.alive
+        n = max(
+            d for d in range(1, min(len(alive), self.slots) + 1)
+            if self.slots % d == 0
+        )
+        devices = [self._devices[i] for i in alive[:n]]
+        if [d.id for d in devices] == [
+            d.id for d in self._cur_mesh.devices.flat
+        ]:
+            return
+        new_mesh = batch_engines.make_batch_mesh(devices=devices)
+        moved = 0
+        for grp in self._groups.values():
+            if grp.stack is None:
+                continue
+            plan = redistribute.plan_worlds(
+                len(grp.slots), self._cur_n, n
+            )
+            grp.stack = redistribute.device_reshard_worlds(
+                grp.stack, self._cur_mesh, new_mesh, plan
+            )
+            if grp.last_good is not None:
+                base, fps = grp.last_good
+                grp.last_good = (
+                    redistribute.device_reshard_worlds(
+                        base, self._cur_mesh, new_mesh, plan
+                    ),
+                    fps,
+                )
+            # The extent vectors are tiny; re-place rather than reshard.
+            self._replace_extents(grp, new_mesh)
+            moved += 1
+            self._emit_reshard(plan, bucket=grp.label)
+        if moved == 0:
+            # No stack was live at the boundary; the transition is still
+            # a fact of the stream (the serve drills assert on it).
+            self._emit_reshard(
+                redistribute.plan_worlds(self.slots, self._cur_n, n)
+            )
+        self._cur_mesh = new_mesh
+        self._cur_n = n
+        self.live_reshards += 1
+
+    @staticmethod
+    def _replace_extents(grp: _BucketGroup, mesh) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from gol_tpu.batch.engines import WORLDS
+
+        vec = NamedSharding(mesh, PartitionSpec(WORLDS))
+        grp.hs = jax.device_put(np.asarray(grp.hs), vec)
+        grp.ws = jax.device_put(np.asarray(grp.ws), vec)
+
+    def _emit_reshard(self, plan, **extra) -> None:
+        if self._events is not None:
+            self._events.reshard_event(
+                generation=self._total_gens, live=True,
+                **plan.summary(), **extra,
+            )
+        elif self._registry is not None:
+            self._registry.observe(
+                {
+                    "event": "reshard", "t": time.time(),
+                    "generation": self._total_gens, "live": True,
+                    **plan.summary(), **extra,
+                }
+            )
+
+    def _hedge_replay(
+        self, grp: _BucketGroup, compiled, pre_good, candidate, audits,
+        gen_after: int,
+    ):
+        """Straggler response: recompute the chunk from the
+        fingerprint-verified pre-chunk stack and let the guard's
+        fingerprint pick the winner.  Agreement keeps the primary (the
+        slow rank was slow, not wrong); disagreement takes the hedge
+        (the replay ran on the surviving healthy state)."""
+        from gol_tpu.utils import guard as guard_mod
+        from gol_tpu.utils.timing import force_ready
+
+        base, fps = pre_good
+        base_audits = guard_mod.audit_worlds(base, grp.gens)
+        if [a.fingerprint for a in base_audits] != fps:
+            return candidate, audits  # base unusable: the primary stands
+        hedge = compiled(
+            guard_mod._device_copy(base), grp.hs, grp.ws
+        )
+        force_ready(hedge)
+        h_audits = guard_mod.audit_worlds(hedge, gen_after)
+        p_fps = [a.fingerprint for a in audits] if audits else None
+        agree = p_fps is not None and [
+            a.fingerprint for a in h_audits
+        ] == p_fps
+        self.hedges += 1
+        payload = {
+            "verdict": "hedge",
+            "generation": gen_after,
+            "bucket": grp.label,
+            "winner": "primary" if agree else "hedge",
+            "agree": agree,
+        }
+        if self._health is not None:
+            payload["alive"] = len(self._health.alive)
+        if self._events is not None:
+            self._events.health_event(**payload)
+        elif self._registry is not None:
+            self._registry.observe(
+                {"event": "health", "t": time.time(), **payload}
+            )
+        if agree:
+            return candidate, audits
+        grp.last_good = (
+            guard_mod._device_copy(hedge),
+            [a.fingerprint for a in h_audits],
+        )
+        return hedge, h_audits
+
     def _finish(self, state: RequestState, grp: _BucketGroup) -> None:
         from gol_tpu.utils import guard as guard_mod
 
@@ -830,6 +1105,7 @@ class ServeScheduler:
         state.result = payload
         state.status = "done"
         self.completed_total += 1
+        self._complete_times.append(time.time())
         self._emit(
             "complete", state.request.id, bucket=grp.label,
             latency_s=payload["latency_s"], generation=state.generation,

@@ -16,7 +16,11 @@ Endpoints::
                      -> 400 malformed, 429 queue full (Retry-After),
                         503 draining / admissions shed
     GET  /result/ID  -> 200 terminal payload | 202 progress | 404
-    GET  /healthz    -> 200 {"ok": true, outstanding, draining}
+    GET  /healthz    -> 200 {"ok": true, ready, outstanding, draining}
+                        (liveness: the process is up — always 200)
+    GET  /readyz     -> 200 when admitting | 503 while draining, while
+                        admissions are shed, or through a live-reshard
+                        window (docs/RESILIENCE.md "Live elasticity")
     GET  /metrics    -> Prometheus text (the gol_serve_* gauges)
     POST /shutdown   -> 200, then graceful drain: stop admitting,
                         finish every committed request, exit 0
@@ -78,11 +82,25 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
         path = self.path.rstrip("/")
         if path == "/healthz":
+            # Liveness: the process is up and answering.  Readiness is
+            # the separate signal — a live server mid-reshard reports
+            # ok=true here and 503 on /readyz, so an orchestrator
+            # steers traffic away without restarting it.
             self._json(
                 200,
                 {
                     "ok": True,
+                    "ready": self.scheduler.ready,
                     "outstanding": self.scheduler.outstanding(),
+                    "draining": self.scheduler.draining,
+                },
+            )
+        elif path == "/readyz":
+            ready = self.scheduler.ready
+            self._json(
+                200 if ready else 503,
+                {
+                    "ready": ready,
                     "draining": self.scheduler.draining,
                 },
             )
@@ -102,7 +120,8 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._result(path[len("/result/"):])
         else:
             self.send_error(
-                404, "routes: /simulate /result/<id> /healthz /metrics"
+                404,
+                "routes: /simulate /result/<id> /healthz /readyz /metrics",
             )
 
     def do_POST(self):  # noqa: N802 - http.server API

@@ -55,7 +55,19 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 10 (this round) adds the serving-tier event
+# Version 11 (this round) adds the health-plane event
+# (docs/RESILIENCE.md, "Live elasticity"): a ``health`` record marks one
+# verdict of :mod:`gol_tpu.resilience.health` — ``verdict`` is one of
+# ``device_loss`` / ``device_restore`` (a device left or rejoined the
+# usable set; carries ``device`` and the surviving ``alive`` count),
+# ``straggler`` (a chunk wall exceeded the fitted baseline; carries
+# ``rank``, ``wall_s``, ``baseline_s``), or ``hedge`` (the serving
+# tier's hedged replay of a straggler's chunk; carries the ``winner``
+# and whether the fingerprints agreed).  A serving run that live-
+# reshards on a verdict stamps the existing v7 ``reshard`` record next
+# to it — the pair on one stream is the proof the in-process elasticity
+# path ran instead of a supervisor restart.
+# Version 10 added the serving-tier event
 # (docs/SERVING.md): a ``serve`` record marks one request-lifecycle
 # transition of the continuous-batching scheduler
 # (:mod:`gol_tpu.serve`) — ``action`` is one of ``admit`` (journaled,
@@ -110,11 +122,11 @@ from typing import Dict, Optional
 # resilience events — ``preempt``, ``resume``, ``restart``
 # (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
-# readable: every v1-v8 event type and field survives unchanged, so
+# readable: every v1-v10 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5/v6/v7/v8/v9 fixture tests).
-SCHEMA_VERSION = 10
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+# committed v1/v2/v3/v4/v5/v6/v7/v8/v9/v10 fixture tests).
+SCHEMA_VERSION = 11
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -181,6 +193,11 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # complete / reject / deadline / requeue; extras carry bucket,
     # queue_depth, inflight, latency_s, generation.
     "serve": frozenset({"action", "request_id"}),
+    # v11: one health-plane verdict (gol_tpu/resilience/health.py):
+    # ``verdict`` is device_loss / device_restore / straggler / hedge;
+    # extras carry device, alive, rank, wall_s, baseline_s, winner.
+    # ``generation`` is the chunk boundary that produced it.
+    "health": frozenset({"verdict", "generation"}),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -529,6 +546,17 @@ class EventLog:
         bucket/queue_depth/inflight/latency_s/generation detail
         (docs/SERVING.md)."""
         self.emit("serve", action=action, request_id=request_id, **extra)
+
+    def health_event(
+        self, verdict: str, generation: int, **extra
+    ) -> None:
+        """One health-plane verdict (v11): ``verdict`` is device_loss/
+        device_restore/straggler/hedge; ``extra`` carries device/alive/
+        rank/wall_s/baseline_s/winner detail (docs/RESILIENCE.md,
+        "Live elasticity")."""
+        self.emit(
+            "health", verdict=verdict, generation=generation, **extra
+        )
 
     def stats_event(
         self, index: int, take: int, generation: int, values: dict

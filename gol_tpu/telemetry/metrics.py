@@ -43,6 +43,16 @@ has been observed — docs/SERVING.md)::
     gol_serve_deadline_total          chunk-boundary cancels (counter)
     gol_serve_request_seconds_*       admit→complete latency histogram
 
+Health-plane metrics (schema v11, emitted only once a ``health`` event
+has been observed — docs/RESILIENCE.md, "Live elasticity")::
+
+    gol_health_alive_devices          devices currently usable (gauge)
+    gol_health_device_loss_total      device_loss verdicts (counter)
+    gol_health_device_restore_total   device_restore verdicts (counter)
+    gol_health_straggler_total        straggler verdicts (counter)
+    gol_health_hedge_total            hedged chunk replays (counter)
+    gol_health_live_reshards_total    in-process live reshards (counter)
+
 Purity: the registry runs strictly host-side inside the emission path,
 which itself runs after the ``force_ready`` fences — the trace-identity
 pin covers metrics-on vs -off (tests/test_metrics.py).
@@ -102,6 +112,13 @@ class MetricsRegistry:
         }
         self.serve_latency_sum = 0.0
         self.serve_latency_count = 0
+        self.health_seen = False
+        self.health_alive_devices: Optional[int] = None
+        self.health_device_loss_total = 0
+        self.health_device_restore_total = 0
+        self.health_straggler_total = 0
+        self.health_hedge_total = 0
+        self.health_reshards_total = 0
 
     # -- write side (EventLog observer) -------------------------------------
     def observe(self, rec: dict) -> None:
@@ -157,6 +174,26 @@ class MetricsRegistry:
                     self.serve_queue_depth = rec["queue_depth"]
                 if "inflight" in rec:
                     self.serve_inflight = rec["inflight"]
+            elif event == "health":
+                self.health_seen = True
+                verdict = rec.get("verdict")
+                if verdict == "device_loss":
+                    self.health_device_loss_total += 1
+                elif verdict == "device_restore":
+                    self.health_device_restore_total += 1
+                elif verdict == "straggler":
+                    self.health_straggler_total += 1
+                elif verdict == "hedge":
+                    self.health_hedge_total += 1
+                if "alive" in rec:
+                    self.health_alive_devices = rec["alive"]
+            elif event == "reshard":
+                if self.health_seen:
+                    # A reshard on a stream that already carries health
+                    # verdicts is a LIVE reshard (the elasticity pair —
+                    # docs/RESILIENCE.md); restart-path reshards happen
+                    # in fresh processes with fresh registries.
+                    self.health_reshards_total += 1
 
     # -- read side (HTTP) ----------------------------------------------------
     def render(self) -> str:
@@ -296,6 +333,37 @@ class MetricsRegistry:
                 lines.append(
                     f"gol_serve_request_seconds_count "
                     f"{self.serve_latency_count}"
+                )
+            if self.health_seen:
+                if self.health_alive_devices is not None:
+                    metric(
+                        "gol_health_alive_devices", "gauge",
+                        "Devices the health plane considers usable (v11).",
+                        self.health_alive_devices,
+                    )
+                metric(
+                    "gol_health_device_loss_total", "counter",
+                    "device_loss verdicts.", self.health_device_loss_total,
+                )
+                metric(
+                    "gol_health_device_restore_total", "counter",
+                    "device_restore verdicts.",
+                    self.health_device_restore_total,
+                )
+                metric(
+                    "gol_health_straggler_total", "counter",
+                    "straggler verdicts from the chunk-wall watchdog.",
+                    self.health_straggler_total,
+                )
+                metric(
+                    "gol_health_hedge_total", "counter",
+                    "hedged chunk replays triggered by stragglers.",
+                    self.health_hedge_total,
+                )
+                metric(
+                    "gol_health_live_reshards_total", "counter",
+                    "In-process mesh reshards taken on health verdicts.",
+                    self.health_reshards_total,
                 )
             return "\n".join(lines) + "\n"
 

@@ -665,6 +665,21 @@ def render_run(run: Run, out) -> None:
             file=out,
         )
 
+    healths = run.records("health", rank=rank0)
+    if healths:
+        # Schema v11 (docs/RESILIENCE.md, "Live elasticity"): verdict
+        # counts plus the final alive-device count — a health line next
+        # to a reshard line above is the live-elasticity signature.
+        by_kind: Dict[str, int] = {}
+        for r in healths:
+            by_kind[r["verdict"]] = by_kind.get(r["verdict"], 0) + 1
+        detail = ", ".join(
+            f"{n} {k}" for k, n in sorted(by_kind.items())
+        )
+        alive = [r["alive"] for r in healths if "alive" in r]
+        tail = f" (alive devices now {alive[-1]})" if alive else ""
+        print(f"  health: {detail}{tail}", file=out)
+
     benches = run.records("bench_row")
     if benches:
         for b in benches:

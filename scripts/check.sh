@@ -39,7 +39,12 @@
 #                       crash mid-batch + journal re-admit — every
 #                       accepted request completes exactly once,
 #                       byte-equal — then a SIGTERM graceful drain)
-#  13. tier-1 tests    (the exact ROADMAP.md command)
+#  13. elastic smoke   (live elasticity, docs/RESILIENCE.md: a sharded
+#                       server loses a device mid-serve, live-reshards
+#                       at the chunk boundary, regrows on restore,
+#                       hedges a straggler — every request byte-equal,
+#                       no restart, v11 verdicts on the stream)
+#  14. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
@@ -85,13 +90,16 @@ JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 echo "== [10/12] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/13] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+echo "== [11/14] chaos smoke (docs/RESILIENCE.md, fault plane) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
-echo "== [12/13] serve smoke (docs/SERVING.md, serving tier) =="
+echo "== [12/14] serve smoke (docs/SERVING.md, serving tier) =="
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
-echo "== [13/13] tier-1 tests =="
+echo "== [13/14] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
+python scripts/elastic_smoke.py
+
+echo "== [14/14] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,176 @@
+"""The health plane (gol_tpu/resilience/health.py).
+
+Watchdog behavior (baseline fit, straggler exclusion, the min-wall
+floor), device loss/restore verdicts off the fault plane (including the
+last-device guard and restore scheduling), and verdict emission into
+the v11 telemetry stream / metrics registry.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from gol_tpu.resilience import faults as faults_mod
+from gol_tpu.resilience.health import KINDS, HealthMonitor, Verdict
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plane():
+    faults_mod.clear()
+    yield
+    faults_mod.clear()
+
+
+def _arm(*specs):
+    faults_mod.install(faults_mod.FaultPlan.loads(json.dumps(list(specs))))
+
+
+# -- construction -------------------------------------------------------------
+
+
+def test_constructor_validates():
+    with pytest.raises(ValueError):
+        HealthMonitor(0)
+    with pytest.raises(ValueError):
+        HealthMonitor(4, straggler_factor=1.0)
+    assert HealthMonitor(4).alive == [0, 1, 2, 3]
+
+
+# -- the straggler watchdog ---------------------------------------------------
+
+
+def test_baseline_needs_min_samples_then_fits_median():
+    mon = HealthMonitor(4, min_samples=3)
+    mon.heartbeat(2, 0.10)
+    mon.heartbeat(4, 0.20)
+    assert mon.baseline() is None
+    mon.heartbeat(6, 0.30)
+    assert mon.baseline() == pytest.approx(0.20)
+
+
+def test_straggler_flagged_and_excluded_from_window():
+    mon = HealthMonitor(4, straggler_factor=4.0, min_samples=3)
+    for g, w in ((2, 0.10), (4, 0.10), (6, 0.10)):
+        assert mon.heartbeat(g, w) == []
+    (v,) = mon.heartbeat(8, 1.0, rank=2)
+    assert v.kind == "straggler" and v.rank == 2
+    assert v.wall_s == pytest.approx(1.0)
+    assert v.baseline_s == pytest.approx(0.10)
+    # the slow wall did NOT join the window: the baseline cannot be
+    # dragged up by the straggler it is supposed to catch
+    assert mon.baseline() == pytest.approx(0.10)
+    assert mon.heartbeat(10, 1.0) and mon.baseline() == pytest.approx(0.10)
+
+
+def test_min_wall_floor_suppresses_jitter_verdicts():
+    mon = HealthMonitor(4, min_wall_s=0.010, min_samples=3)
+    for g in (2, 4, 6):
+        mon.heartbeat(g, 0.001)
+    # 8x the baseline but under the floor: sub-10ms walls jitter by
+    # whole multiples of themselves, so no verdict
+    assert mon.heartbeat(8, 0.008) == []
+
+
+def test_rank_slowdown_inflates_the_reported_wall():
+    _arm({"site": "rank.slowdown", "at": 8, "delay_s": 30.0})
+    mon = HealthMonitor(4, min_samples=3)
+    for g in (2, 4, 6):
+        mon.heartbeat(g, 0.05)
+    (v,) = mon.heartbeat(8, 0.05)
+    assert v.kind == "straggler"
+    assert v.wall_s == pytest.approx(30.05)
+
+
+# -- device loss / restore ----------------------------------------------------
+
+
+def test_loss_then_scheduled_restore():
+    _arm({"site": "device.loss", "at": 4, "device": 1, "restore_after": 6})
+    mon = HealthMonitor(4)
+    assert mon.poll(2) == []
+    (v,) = mon.poll(4)
+    assert (v.kind, v.device, v.alive) == ("device_loss", 1, 3)
+    assert mon.alive == [0, 2, 3]
+    assert mon.poll(8) == []  # restore due at 10, not yet
+    (r,) = mon.poll(10)
+    assert (r.kind, r.device, r.alive) == ("device_restore", 1, 4)
+    assert mon.alive == [0, 1, 2, 3]
+
+
+def test_last_device_cannot_be_shed():
+    _arm(
+        {"site": "device.loss", "at": 2, "device": 0},
+        {"site": "device.loss", "at": 4, "device": 1},
+    )
+    mon = HealthMonitor(2)
+    assert [v.kind for v in mon.poll(2)] == ["device_loss"]
+    # losing device 1 would leave nothing to reshard onto: refused
+    assert mon.poll(4) == []
+    assert mon.alive == [1]
+
+
+def test_losing_an_already_dead_device_is_a_noop():
+    _arm(
+        {"site": "device.loss", "at": 2, "device": 1},
+        {"site": "device.loss", "at": 4, "device": 1},
+    )
+    mon = HealthMonitor(4)
+    assert len(mon.poll(2)) == 1
+    assert mon.poll(4) == []
+    assert mon.alive == [0, 2, 3]
+
+
+# -- emission -----------------------------------------------------------------
+
+
+class _Registry:
+    def __init__(self):
+        self.records = []
+
+    def observe(self, rec):
+        self.records.append(rec)
+
+
+def test_verdicts_reach_the_registry_when_no_event_log():
+    _arm({"site": "device.loss", "at": 4, "device": 2})
+    reg = _Registry()
+    mon = HealthMonitor(4, registry=reg, min_samples=1)
+    mon.poll(4)
+    mon.heartbeat(6, 0.05)
+    mon.heartbeat(8, 5.0)
+    kinds = [r["verdict"] for r in reg.records]
+    assert kinds == ["device_loss", "straggler"]
+    assert all(r["event"] == "health" for r in reg.records)
+    assert reg.records[0]["device"] == 2
+    assert reg.records[0]["alive"] == 3
+
+
+def test_verdicts_stamp_v11_health_events(tmp_path):
+    from gol_tpu import telemetry
+
+    _arm({"site": "device.loss", "at": 4, "device": 1, "restore_after": 2})
+    with telemetry.EventLog(
+        str(tmp_path), run_id="health", process_index=0
+    ) as ev:
+        ev.run_header({"driver": "test"})
+        mon = HealthMonitor(4, events=ev)
+        mon.poll(4)
+        mon.poll(6)
+        path = ev.path
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 11
+    health = [r for r in recs if r["event"] == "health"]
+    assert [r["verdict"] for r in health] == ["device_loss", "device_restore"]
+    assert health[0]["generation"] == 4 and health[0]["device"] == 1
+
+
+def test_verdict_event_payload_shape():
+    v = Verdict("straggler", 10, rank=3, wall_s=1.23456789, baseline_s=0.1,
+                alive=4)
+    ev = v.to_event()
+    assert ev["verdict"] == "straggler" and ev["rank"] == 3
+    assert ev["wall_s"] == pytest.approx(1.234568)
+    assert "device" not in ev  # no device for a straggler
+    assert set(KINDS) == {"device_loss", "device_restore", "straggler"}

@@ -1,0 +1,257 @@
+"""The device-side resharding collective (gol_tpu/parallel/redistribute).
+
+The acceptance surface (docs/RESILIENCE.md, "Live elasticity"):
+
+- **the pin** — :func:`device_reshard` executing the SAME validated
+  ``ReshardPlan`` as the host path is **bit-equal** to
+  ``load_resharded`` on every none/1d/2d grow+shrink pair, under the
+  destination mesh's canonical sharding, from a real mid-run snapshot;
+- **teeth** — broken move tables (overlap, gap), wrong-shape plans and
+  wrong-layout plans handed to the collective explicitly are rejected
+  before any device program is built;
+- **worlds stack** — :func:`device_reshard_worlds` moves a ``[B, H, W]``
+  bucket-group stack between worlds meshes bit-exactly (the serve
+  tier's live-elasticity hook);
+- **schedule soundness** — the compiled branch tables cover every
+  destination cell exactly once;
+- **trace identity** — arming the fault plane and the health plane
+  leaves the lowered exchange program byte-identical (both are
+  host-side by construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from gol_tpu.models.state import Geometry
+from gol_tpu.parallel import mesh as mesh_mod
+from gol_tpu.parallel import redistribute as rd
+from gol_tpu.resilience import faults as faults_mod
+from gol_tpu.resilience import reshard as rs
+from gol_tpu.runtime import GolRuntime
+from gol_tpu.utils import checkpoint as ckpt
+
+jax.config.update("jax_platforms", "cpu")
+
+# 96 columns = 3 packed words: the 2-D column seam at 48 lands mid-word,
+# so the pin exercises the in-graph seam repack, not just row splits.
+SIZE = 96
+MID = 8
+
+PAIRS = [
+    ("none", "1d"),
+    ("none", "2d"),
+    ("1d", "2d"),
+    ("2d", "1d"),
+    ("1d", "none"),
+    ("2d", "none"),
+]
+
+
+def _mesh_for(kind):
+    if kind == "none":
+        return None
+    if kind == "1d":
+        return mesh_mod.make_mesh_1d(8)
+    return mesh_mod.make_mesh_2d((4, 2))
+
+
+@pytest.fixture(scope="module")
+def snapshots(tmp_path_factory):
+    """src kind -> (generation-MID snapshot path, mid-run board)."""
+    out = {}
+    for kind in ("none", "1d", "2d"):
+        d = str(tmp_path_factory.mktemp(f"src_{kind}"))
+        rt = GolRuntime(
+            geometry=Geometry(size=SIZE, num_ranks=1),
+            engine="dense",
+            mesh=_mesh_for(kind),
+            checkpoint_every=MID,
+            checkpoint_dir=d,
+            sharded_snapshots=kind != "none",
+        )
+        _, st = rt.run(pattern=6, iterations=MID)
+        path = (
+            ckpt.checkpoint_path(d, MID)
+            if kind == "none"
+            else ckpt.sharded_checkpoint_path(d, MID)
+        )
+        out[kind] = (path, np.asarray(st.board))
+    return out
+
+
+def _place(board, src_mesh):
+    arr = jax.numpy.asarray(board)
+    if src_mesh is None:
+        return jax.device_put(arr)
+    return mesh_mod.shard_board(arr, src_mesh)
+
+
+# -- the pin: device collective == host load_resharded ------------------------
+
+
+@pytest.mark.parametrize("src,dst", PAIRS, ids=[f"{s}-to-{d}" for s, d in PAIRS])
+def test_device_reshard_bit_equal_to_host_path(snapshots, src, dst):
+    snap, board = snapshots[src]
+    src_mesh, dst_mesh = _mesh_for(src), _mesh_for(dst)
+    host, source, plan = rs.load_resharded(snap, dst_mesh)
+    assert np.array_equal(np.asarray(host), board)  # snapshot is the mid board
+    out = rd.device_reshard(_place(board, src_mesh), src_mesh, dst_mesh, plan=plan)
+    assert np.array_equal(np.asarray(out), np.asarray(host))
+    if dst_mesh is not None:
+        assert out.sharding.is_equivalent_to(
+            mesh_mod.board_sharding(dst_mesh), out.ndim
+        )
+
+
+def test_device_reshard_default_plan_matches_explicit(snapshots):
+    """Omitting the plan plans the same move table the host path builds."""
+    _, board = snapshots["1d"]
+    src_mesh, dst_mesh = _mesh_for("1d"), _mesh_for("2d")
+    placed = _place(board, src_mesh)
+    out = rd.device_reshard(placed, src_mesh, dst_mesh)
+    assert np.array_equal(np.asarray(out), board)
+
+
+# -- schedule soundness -------------------------------------------------------
+
+
+@pytest.mark.parametrize("src,dst", PAIRS, ids=[f"{s}-to-{d}" for s, d in PAIRS])
+def test_branch_tables_cover_every_cell_exactly_once(src, dst):
+    src_mesh, dst_mesh = _mesh_for(src), _mesh_for(dst)
+    src_l = rs.MeshLayout.from_mesh(src_mesh)
+    dst_l = rs.MeshLayout.from_mesh(dst_mesh)
+    shape = (SIZE, SIZE)
+    plan = rs.plan_reshard(shape, src_l.boxes(shape), src_l, dst_l)
+    sched = rd.board_schedule(plan, src_mesh, dst_mesh)
+    canvas = rd.schedule_coverage(sched)
+    assert (canvas == 1).all()
+
+
+# -- teeth --------------------------------------------------------------------
+
+
+def test_broken_move_tables_rejected_before_any_program():
+    src_mesh, dst_mesh = _mesh_for("1d"), _mesh_for("2d")
+    src_l = rs.MeshLayout.from_mesh(src_mesh)
+    dst_l = rs.MeshLayout.from_mesh(dst_mesh)
+    shape = (SIZE, SIZE)
+    plan = rs.plan_reshard(shape, src_l.boxes(shape), src_l, dst_l)
+    dbox, srcs = plan.moves[-1]
+    placed = _place(np.zeros(shape, np.uint8), src_mesh)
+    overlapping = dataclasses.replace(
+        plan, moves=plan.moves[:-1] + ((dbox, srcs + (srcs[0],)),)
+    )
+    gapped = dataclasses.replace(plan, moves=plan.moves[:-1] + ((dbox, srcs[:-1]),))
+    for bad in (overlapping, gapped):
+        with pytest.raises((rs.ReshardError, rs.ReshardPlanError)):
+            rd.device_reshard(placed, src_mesh, dst_mesh, plan=bad)
+
+
+def test_wrong_shape_and_wrong_layout_plans_rejected():
+    src_mesh, dst_mesh = _mesh_for("1d"), _mesh_for("2d")
+    src_l = rs.MeshLayout.from_mesh(src_mesh)
+    dst_l = rs.MeshLayout.from_mesh(dst_mesh)
+    good = rs.plan_reshard(
+        (SIZE, SIZE), src_l.boxes((SIZE, SIZE)), src_l, dst_l
+    )
+    placed = _place(np.zeros((SIZE, SIZE), np.uint8), src_mesh)
+    # a plan for a different board size
+    small = rs.plan_reshard(
+        (SIZE // 2, SIZE), src_l.boxes((SIZE // 2, SIZE)), src_l, dst_l
+    )
+    with pytest.raises(rs.ReshardError):
+        rd.device_reshard(placed, src_mesh, dst_mesh, plan=small)
+    # a plan whose layouts do not match the meshes it is handed
+    with pytest.raises(rs.ReshardError):
+        rd.device_reshard(placed, src_mesh, None, plan=good)
+
+
+# -- worlds stack (the serve live-elasticity hook) ----------------------------
+
+
+WORLDS_PAIRS = [(1, 4), (4, 1), (2, 8), (8, 2), (2, 4), (4, 2)]
+
+
+@pytest.mark.parametrize(
+    "n_src,n_dst", WORLDS_PAIRS, ids=[f"{a}-to-{b}" for a, b in WORLDS_PAIRS]
+)
+def test_worlds_stack_bit_equal_across_mesh_sizes(n_src, n_dst):
+    from gol_tpu.batch import engines as batch_engines
+
+    rng = np.random.default_rng(n_src * 16 + n_dst)
+    stack = (rng.random((8, 16, 64)) < 0.5).astype(np.uint8)
+
+    def mesh_of(n):
+        return None if n == 1 else batch_engines.make_batch_mesh(n)
+
+    src_mesh, dst_mesh = mesh_of(n_src), mesh_of(n_dst)
+    arr = jax.numpy.asarray(stack)
+    placed = (
+        jax.device_put(arr, batch_engines.batch_sharding(src_mesh))
+        if src_mesh is not None
+        else jax.device_put(arr)
+    )
+    out = rd.device_reshard_worlds(placed, src_mesh, dst_mesh)
+    assert np.array_equal(np.asarray(out), stack)
+    if dst_mesh is not None:
+        assert out.sharding.is_equivalent_to(
+            batch_engines.batch_sharding(dst_mesh), out.ndim
+        )
+
+
+def test_worlds_plan_batch_mismatch_rejected():
+    from gol_tpu.batch import engines as batch_engines
+
+    stack = jax.numpy.zeros((8, 16, 64), jax.numpy.uint8)
+    src_mesh = batch_engines.make_batch_mesh(2)
+    dst_mesh = batch_engines.make_batch_mesh(4)
+    placed = jax.device_put(stack, batch_engines.batch_sharding(src_mesh))
+    wrong = rd.plan_worlds(4, 2, 4)  # a 4-world table for an 8-world stack
+    with pytest.raises(rs.ReshardError):
+        rd.device_reshard_worlds(placed, src_mesh, dst_mesh, plan=wrong)
+
+
+# -- trace identity: the planes never reach the compiled exchange -------------
+
+
+def test_exchange_trace_identical_with_planes_armed():
+    src_mesh, dst_mesh = _mesh_for("1d"), _mesh_for("2d")
+    src_l = rs.MeshLayout.from_mesh(src_mesh)
+    dst_l = rs.MeshLayout.from_mesh(dst_mesh)
+    shape = (SIZE, SIZE)
+    plan = rs.plan_reshard(shape, src_l.boxes(shape), src_l, dst_l)
+
+    rd._board_program.cache_clear()
+    disarmed = rd.lowered_exchange_text(plan, src_mesh, dst_mesh)
+    try:
+        faults_mod.install(
+            faults_mod.FaultPlan.loads(
+                json.dumps(
+                    {
+                        "faults": [
+                            {"site": "device.loss", "at": 4, "device": 1},
+                            {"site": "rank.slowdown", "at": 2,
+                             "delay_s": 5.0},
+                        ]
+                    }
+                )
+            )
+        )
+        from gol_tpu.resilience.health import HealthMonitor
+
+        mon = HealthMonitor(8)
+        mon.heartbeat(2, 0.05)
+        mon.poll(4)
+        rd._board_program.cache_clear()
+        armed = rd.lowered_exchange_text(plan, src_mesh, dst_mesh)
+    finally:
+        faults_mod.clear()
+        rd._board_program.cache_clear()
+    assert armed == disarmed

@@ -559,3 +559,139 @@ def test_batch_runtime_on_world_complete_hook(tmp_path):
         got, gen = seen[i]
         assert gen == 4
         assert np.array_equal(got, np.asarray(want))
+
+
+# -- live elasticity + readiness (docs/RESILIENCE.md "Live elasticity") --------
+
+
+def test_retry_after_startup_window_clamps_to_default(tmp_path):
+    """Before any completion lands there is no drain rate to estimate:
+    the 429 hint must be the documented 0.5s/request default, not a
+    division by a junk rate — and a real rate takes over afterwards,
+    clamped to the [0.1, 30] window."""
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=1, queue_depth=1,
+    )
+    req = {"pattern": 4, "size": 32, "generations": 2}
+
+    def rejected():
+        with pytest.raises(Rejected) as exc:
+            sched.submit(dict(req, id="no"))
+        return exc.value.retry_after
+
+    try:
+        sched.submit(dict(req, id="ok"))  # fills the bounded queue
+        # zero-completions startup window: 1 request ahead x 0.5s default
+        assert rejected() == pytest.approx(0.5)
+        sched._complete_times.extend([100.0, 102.0])  # 0.5 completions/s
+        assert rejected() == pytest.approx(2.0)  # ahead=1 / rate
+        sched._complete_times.clear()
+        sched._complete_times.extend([0.0, 1000.0])  # glacial rate
+        assert rejected() == pytest.approx(30.0)  # clamped to the max
+    finally:
+        sched.close()
+
+
+def test_readyz_splits_liveness_from_readiness(tmp_path):
+    """/healthz is liveness (always 200, even mid-reshard); /readyz is
+    readiness and answers 503 through a live-reshard window or a drain
+    so an orchestrator steers traffic away without restarting us."""
+    from gol_tpu.serve.client import SimClient
+    from gol_tpu.serve.server import ServeServer
+
+    sched = ServeScheduler(str(tmp_path / "state"), quantum=32)
+    srv = ServeServer(sched, 0)
+    c = SimClient(f"http://127.0.0.1:{srv.port}")
+    try:
+        assert c.healthz()["ready"] is True
+        status, payload = c._call("GET", "/readyz")
+        assert status == 200 and payload["ready"] is True
+
+        sched._resharding = True  # the live-reshard window
+        status, payload = c._call("GET", "/readyz")
+        assert status == 503 and payload["ready"] is False
+        hz = c.healthz()  # liveness holds through the window
+        assert hz["ok"] is True and hz["ready"] is False
+        sched._resharding = False
+
+        sched.drain()
+        status, payload = c._call("GET", "/readyz")
+        assert status == 503 and payload["draining"] is True
+    finally:
+        srv.close()
+        sched.close()
+
+
+def test_wait_for_across_live_reshard_never_404(tmp_path):
+    """A client polling a request that rides THROUGH a device-loss
+    live-reshard sees an uninterrupted 200/202 stream and the bit-exact
+    final board — never a 404, never a connection drop.  (wait_for
+    raises KeyError on any 404, so its success IS the assertion.)"""
+    import threading
+
+    from gol_tpu.resilience import faults as faults_mod
+    from gol_tpu.serve.client import SimClient
+    from gol_tpu.serve.scheduler import decode_board
+    from gol_tpu.serve.server import ServeServer
+
+    faults_mod.install(faults_mod.FaultPlan.loads(
+        '[{"site": "device.loss", "at": 4, "device": 1}]'
+    ))
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=32, slots=4, chunk=2,
+        mesh_devices=4,
+        telemetry_dir=str(tmp_path / "tm"), run_id="elastic",
+    )
+    srv = ServeServer(sched, 0)
+    client = SimClient(f"http://127.0.0.1:{srv.port}")
+    try:
+        client.submit(
+            {"id": "r1", "pattern": 4, "size": 32, "generations": 12}
+        )
+        driver = threading.Thread(target=sched.run_until_drained)
+        driver.start()
+        payload = client.wait_for("r1", timeout_s=120.0, poll_s=0.01)
+        driver.join(timeout=60.0)
+        assert payload["status"] == "done"
+        assert np.array_equal(
+            decode_board(payload["board"]), _oracle(4, 32, 12)
+        )
+        assert sched.live_reshards >= 1  # the loss really did reshard
+    finally:
+        faults_mod.clear()
+        srv.close()
+        sched.close()
+    recs = _events(tmp_path / "tm")
+    assert any(
+        r["event"] == "health" and r["verdict"] == "device_loss"
+        for r in recs
+    )
+    assert any(r["event"] == "reshard" and r.get("live") for r in recs)
+
+
+def test_midflight_join_does_not_rewind_residents(tmp_path):
+    """A request joining a bucket group whose stack is mid-flight must
+    not rewind the residents: the join rebuilds the stack from host
+    boards, so the residents' boards have to be synced from the device
+    stack first.  (Pattern 4 is periodic at these sizes and masks the
+    rewind — pattern 6 actually evolves.)"""
+    sched = ServeScheduler(
+        str(tmp_path / "state"), quantum=64, slots=4, chunk=2,
+    )
+    try:
+        sched.submit(
+            {"id": "resident", "pattern": 6, "size": 32, "generations": 8}
+        )
+        assert sched.run_once()  # 2 generations alone in the bucket
+        sched.submit(  # same 64x64/bitpack bucket: joins the live group
+            {"id": "joiner", "pattern": 6, "size": 64, "generations": 8}
+        )
+        sched.run_until_drained()
+        assert np.array_equal(
+            sched.result_board("resident"), _oracle(6, 32, 8)
+        )
+        assert np.array_equal(
+            sched.result_board("joiner"), _oracle(6, 64, 8)
+        )
+    finally:
+        sched.close()

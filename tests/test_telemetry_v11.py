@@ -1,0 +1,170 @@
+"""Schema v11 (health-plane events) + v1–v10 back-compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..10}.py.
+Here:
+
+- the v11 addition round-trips: ``health`` records one health-plane
+  verdict (device_loss/device_restore/straggler/hedge) with its
+  device/alive/wall detail (docs/RESILIENCE.md, "Live elasticity");
+- the committed v11 fixture is a REAL elastic serve run — a sharded
+  scheduler that lost a device mid-run, live-reshared twice
+  (shrink then regrow), hedged a straggler chunk, and still completed
+  every request;
+- **back-compat**: all TEN committed fixtures — PR 2 (v1) through
+  PR 14 (v11) — still load, merge, and render in one ``summarize``
+  pass (exit 0) with the health line, while a bogus schema still
+  exits 2;
+- the ``gol_health_*`` metrics appear once health records are observed.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+    9: DATA / "telemetry_v9" / "pr12run.rank0.jsonl",
+    11: DATA / "telemetry_v11" / "pr14run.rank0.jsonl",
+}
+
+
+def _v11_stream(directory, run_id="v11"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header(
+            {"driver": "serve", "engine": "auto", "slots": 4,
+             "chunk": 2, "mesh_devices": 4}
+        )
+        ev.health_event("device_loss", generation=4, device=1, alive=3)
+        ev.health_event(
+            "straggler", generation=8, rank=0, wall_s=0.5,
+            baseline_s=0.01, alive=3,
+        )
+        ev.health_event(
+            "hedge", generation=8, bucket="32x32/bitpack",
+            winner="primary", agree=True, alive=3,
+        )
+        ev.health_event("device_restore", generation=10, device=1, alive=4)
+        ev.reshard_event(
+            generation=4,
+            src_mesh={"kind": "1d", "rows": 4, "cols": 1},
+            dst_mesh={"kind": "1d", "rows": 2, "cols": 1},
+            bytes_moved=16,
+            live=True,
+            bucket="32x32/bitpack",
+        )
+        return ev.path
+
+
+def test_v11_health_roundtrip(tmp_path):
+    path = _v11_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 11
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 12))
+    health = [r for r in recs if r["event"] == "health"]
+    assert [r["verdict"] for r in health] == [
+        "device_loss", "straggler", "hedge", "device_restore",
+    ]
+    assert health[0]["device"] == 1 and health[0]["alive"] == 3
+    assert health[1]["wall_s"] == 0.5
+    assert health[2]["winner"] == "primary" and health[2]["agree"] is True
+    live = next(r for r in recs if r["event"] == "reshard")
+    assert live["live"] is True and live["bucket"] == "32x32/bitpack"
+
+
+def test_committed_fixture_schemas():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v11_fixture_is_a_real_elastic_serve_run():
+    """The committed stream came from a sharded scheduler that lost a
+    device, live-reshared (shrink AND regrow), hedged a straggler, and
+    completed every request — no restart, no failure."""
+    recs = [json.loads(ln) for ln in FIXTURES[11].open()]
+    assert recs[0]["config"]["driver"] == "serve"
+    assert recs[0]["config"]["mesh_devices"] == 4
+    verdicts = [r["verdict"] for r in recs if r["event"] == "health"]
+    assert {"device_loss", "device_restore", "straggler", "hedge"} <= set(
+        verdicts
+    )
+    reshards = [
+        r for r in recs if r["event"] == "reshard" and r.get("live")
+    ]
+    assert len(reshards) >= 2  # the shrink and the regrow
+    shapes = [
+        (r["src_mesh"]["rows"], r["dst_mesh"]["rows"]) for r in reshards
+    ]
+    assert (4, 2) in shapes and (2, 4) in shapes
+    faults = {r["site"] for r in recs if r["event"] == "fault"}
+    assert faults >= {"device.loss", "rank.slowdown"}
+    assert not any(r["event"] == "restart" for r in recs)
+    completes = [
+        r for r in recs
+        if r["event"] == "serve" and r["action"] == "complete"
+    ]
+    assert len(completes) == 2
+    audits = [r for r in recs if r["event"] == "guard_audit"]
+    assert audits and all(r["ok"] for r in audits)
+
+
+def test_v1_to_v11_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v11_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "pr12run", "pr14run", "v11",
+    ):
+        assert run_id in out
+    assert "health:" in out
+    assert "device_loss" in out and "straggler" in out
+
+
+def test_health_metrics_render(tmp_path):
+    """The gol_health_* gauges appear once health records land."""
+    from gol_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    assert "gol_health_" not in reg.render()  # absent until the plane runs
+    for ln in open(_v11_stream(tmp_path)):
+        reg.observe(json.loads(ln))
+    text = reg.render()
+    assert "gol_health_alive_devices 4" in text
+    assert "gol_health_device_loss_total 1" in text
+    assert "gol_health_device_restore_total 1" in text
+    assert "gol_health_straggler_total 1" in text
+    assert "gol_health_hedge_total 1" in text
+    assert "gol_health_live_reshards_total 1" in text
+
+
+def test_bogus_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": 99, "run_id": "bad",
+             "process_index": 0, "process_count": 1, "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
