@@ -2,9 +2,9 @@
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
 # smoke + halo smoke + chaos smoke + serve smoke + elastic smoke +
-# tier-1 tests (see scripts/check.sh).
+# lockcheck + tier-1 tests (see scripts/check.sh).
 
-.PHONY: lint verify test check telemetry-smoke stats-smoke \
+.PHONY: lint verify lockcheck test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
 	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke
@@ -14,6 +14,12 @@ lint:
 
 verify:
 	JAX_PLATFORMS=cpu python -m gol_tpu.analysis
+
+# Host-plane concurrency passes only (lockcheck + spmdcheck): pure-AST,
+# never initializes a jax backend, so it is check.sh's cheapest stage
+# (docs/ANALYSIS.md "The concurrency matrix").
+lockcheck:
+	python -m gol_tpu.analysis --concurrency
 
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \

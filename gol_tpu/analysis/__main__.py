@@ -65,7 +65,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="use the ambient backend/devices as-is (default: force the "
         "CPU backend with a virtual 4-device ring)",
     )
+    parser.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="run only the host-plane concurrency passes (lockcheck + "
+        "spmdcheck); pure-AST, never touches a jax backend",
+    )
     ns = parser.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    if ns.concurrency:
+        from gol_tpu.analysis.lockcheck import (
+            default_lock_matrix, run_lock_checks,
+        )
+        from gol_tpu.analysis.report import AnalysisReport
+        from gol_tpu.analysis.spmdcheck import run_spmd_checks
+
+        if ns.list:
+            for cell in default_lock_matrix():
+                print(cell.name)
+            print("lock/teeth")
+            print("lock/waivers")
+            print("spmd/collectives")
+            print("spmd/teeth")
+            print("spmd/waivers")
+            return 0
+        report = AnalysisReport()
+        report.engines.extend(run_lock_checks())
+        report.engines.extend(run_spmd_checks())
+        if ns.json:
+            print(report.to_json())
+        else:
+            print(report.render_text(verbose=ns.verbose))
+        return report.exit_code
 
     if not ns.native_devices:
         from gol_tpu.analysis.configs import MESH_DEVICE_COUNTS
@@ -107,6 +138,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 print(hcfg.name)
             for gcfg in default_guard_matrix():
                 print(gcfg.name)
+            from gol_tpu.analysis.lockcheck import default_lock_matrix
+
+            for lcfg in default_lock_matrix():
+                print(lcfg.name)
+            print("lock/teeth")
+            print("lock/waivers")
+            print("spmd/collectives")
+            print("spmd/teeth")
+            print("spmd/waivers")
         return 0
 
     from gol_tpu.analysis.checks import run_config
@@ -128,6 +168,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report.engines.extend(run_redist_checks())
         report.engines.extend(run_halo_checks())
         report.engines.extend(run_guard_checks())
+        from gol_tpu.analysis.lockcheck import run_lock_checks
+        from gol_tpu.analysis.spmdcheck import run_spmd_checks
+
+        report.engines.extend(run_lock_checks())
+        report.engines.extend(run_spmd_checks())
 
     if ns.json:
         print(report.to_json())

@@ -1,0 +1,459 @@
+"""Shared AST infrastructure for the host-plane concurrency passes.
+
+The engine verifiers (`checks.py`, `batchcheck.py`, ...) prove facts
+about *traced jax programs*; the concurrency passes (`lockcheck.py`,
+`spmdcheck.py`) prove facts about the *host code that drives them* —
+HTTP handler threads, the scheduler drive loop, the async checkpoint
+writer, the metrics observer, and the multi-host collective schedule.
+Nothing here executes analyzed code: modules are parsed with
+:mod:`ast`, never imported, so deliberately-broken fixtures are safe
+to analyze.
+
+This module is the shared substrate both passes walk on:
+
+- :class:`Program` — a set of parsed modules with indexes over
+  functions (including nested defs, keyed ``mod:Class.method`` /
+  ``mod:outer.inner``), classes, per-module import aliases, and
+  module-level lock objects.
+- attribute/type inference — a deliberately small abstract domain
+  (class basenames plus one container level) fed by ``self.x =
+  ClassName(...)`` constructor assignments and ``x: ClassName``
+  annotations.  Precision here is a *soundness dial*: an access whose
+  receiver type cannot be inferred is simply not recorded, so the
+  guarded-field check under-reports rather than false-positives.
+- lock identity — ``with self._lock:`` in a method of ``C`` canonical-
+  izes to ``C._lock``; a module-level ``with _lock:`` to ``mod._lock``;
+  lock *kind* (reentrant or not) rides along so self-acquisition of a
+  plain ``Lock`` is distinguishable from RLock reentrancy.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Constructors that make a field a synchronization primitive: accessing
+# the *object* (to .set()/.wait()/.put()) is inherently thread-safe, so
+# such fields are exempt from the guarded-field discipline.
+SYNC_CTORS = {
+    "Event", "Queue", "SimpleQueue", "Semaphore", "BoundedSemaphore",
+    "Barrier",
+}
+# Lock constructors and their reentrancy.  threading.Condition wraps an
+# RLock by default, so nested acquisition of the same condition is
+# reentrant, not a self-deadlock.
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "rlock"}
+
+# Container heads whose single known-class type parameter is the
+# element type (``Dict[str, RequestState]`` → values are RequestState).
+_CONTAINER_HEADS = {"Dict", "dict", "List", "list", "Deque", "deque",
+                    "Set", "set", "Tuple", "tuple"}
+# Methods on an inferred container attribute that yield its element.
+_CONTAINER_ELT_METHODS = {"get", "pop", "popleft"}
+# Method calls that mutate the receiver collection in place — a call
+# site ``self._requests.clear()`` is a *write* to the field even though
+# the attribute node itself is a Load.
+MUTATING_METHODS = {
+    "append", "appendleft", "add", "clear", "extend", "insert",
+    "pop", "popitem", "popleft", "remove", "discard", "update",
+    "setdefault", "sort", "reverse",
+}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method/nested def, keyed for suffix lookup."""
+
+    key: str  # "mod:func" | "mod:Class.method" | "mod:outer.inner"
+    mod: str
+    cls: Optional[str]  # defining class basename, if a method
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    is_property: bool = False
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    mod: str
+    node: ast.ClassDef
+    bases: List[str]
+    # attr -> ("plain"|"ctr", class basename) from ctor assigns / annots
+    attr_types: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # attr -> "lock" | "rlock" | "sync"
+    attr_kinds: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    """A ``threading.Thread(target=...)`` construction site."""
+
+    func: Optional[FuncInfo]  # enclosing function (None = module level)
+    call: ast.Call
+    mod: str
+    path: str
+    lineno: int
+
+
+class Program:
+    """A parsed, indexed multi-module host program."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ast.Module] = {}
+        self.paths: Dict[str, str] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # (mod, name) -> "lock"|"rlock" for module-level lock objects
+        self.module_locks: Dict[Tuple[str, str], str] = {}
+        # mod -> alias -> target module short name
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self.thread_sites: List[ThreadSite] = []
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def load(cls, modules: Sequence[Tuple[str, str]]) -> "Program":
+        """``modules`` is a list of (short module name, file path)."""
+        prog = cls()
+        for mod, path in modules:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+            prog.modules[mod] = tree
+            prog.paths[mod] = path
+            prog._index_module(mod, tree)
+        return prog
+
+    def _index_module(self, mod: str, tree: ast.Module) -> None:
+        aliases: Dict[str, str] = {}
+        # Imports anywhere in the module — this codebase deliberately
+        # defers many imports into function bodies (backend-init
+        # ordering), and a lock edge must not vanish because the
+        # importing line lives inside the function that uses it.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name.rsplit(".", 1)[-1]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    aliases[a.asname or a.name] = a.name
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                kind = _ctor_kind(node.value)
+                if isinstance(t, ast.Name) and kind in ("lock", "rlock"):
+                    self.module_locks[(mod, t.id)] = kind
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(mod, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_func(mod, None, node, node.name)
+        self.imports[mod] = aliases
+        self._scan_thread_sites(mod, tree)
+
+    def _index_class(self, mod: str, node: ast.ClassDef) -> None:
+        bases = [_tail_name(b) for b in node.bases]
+        info = ClassInfo(node.name, mod, node, [b for b in bases if b])
+        self.classes.setdefault(node.name, info)
+        for item in node.body:
+            if isinstance(item, ast.AnnAssign) and isinstance(
+                item.target, ast.Name
+            ):
+                ann = _annotation_type(item.annotation)
+                if ann is not None:
+                    info.attr_types[item.target.id] = ann
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_func(mod, node.name, item, item.name)
+                self._scan_self_assigns(info, item)
+
+    def _index_func(
+        self, mod: str, cls: Optional[str], node, qual: str
+    ) -> None:
+        is_prop = any(
+            isinstance(d, ast.Name) and d.id == "property"
+            for d in node.decorator_list
+        )
+        key = f"{mod}:{cls}.{qual}" if cls else f"{mod}:{qual}"
+        self.functions[key] = FuncInfo(key, mod, cls, node, is_prop)
+        for child in ast.walk(node):
+            if (
+                isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and child is not node
+            ):
+                nkey = f"{key}.{child.name}"
+                self.functions[nkey] = FuncInfo(nkey, mod, cls, child)
+
+    def _scan_self_assigns(self, info: ClassInfo, method) -> None:
+        """``self.a = <ctor>`` anywhere in a method types the attr."""
+        for node in ast.walk(method):
+            tgt = val = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                tgt = node.target
+                ann = _annotation_type(node.annotation)
+                if (
+                    _is_self_attr(tgt)
+                    and ann is not None
+                    and tgt.attr not in info.attr_types
+                ):
+                    info.attr_types[tgt.attr] = ann
+                continue
+            if not _is_self_attr(tgt) or val is None:
+                continue
+            kind = _ctor_kind(val)
+            if kind is not None:
+                info.attr_kinds.setdefault(tgt.attr, kind)
+            else:
+                cname = _ctor_class(val)
+                if cname is not None:
+                    info.attr_types.setdefault(tgt.attr, ("plain", cname))
+
+    def _scan_thread_sites(self, mod: str, tree: ast.Module) -> None:
+        # Map every Call node back to its innermost enclosing function
+        # so a thread target like ``self._loop`` can be resolved with
+        # the right class context later.
+        encl: Dict[int, Optional[FuncInfo]] = {}
+        for fi in self.functions.values():
+            if fi.mod != mod:
+                continue
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    encl[id(node)] = fi
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            named_thread = (
+                isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+            ) or (isinstance(fn, ast.Name) and fn.id == "Thread")
+            if named_thread:
+                self.thread_sites.append(
+                    ThreadSite(
+                        encl.get(id(node)), node, mod,
+                        self.paths[mod], node.lineno,
+                    )
+                )
+
+    # -- lookup --------------------------------------------------------------
+    def find(self, suffix: str) -> Optional[FuncInfo]:
+        """Resolve a config suffix like ``ServeScheduler.run_once`` or
+        ``serve.server:_Handler.do_GET`` to the unique matching key."""
+        hits = [
+            fi for key, fi in self.functions.items()
+            if key == suffix
+            or key.endswith(":" + suffix)
+            or key.endswith("." + suffix)
+        ]
+        if len(hits) == 1:
+            return hits[0]
+        # Prefer an exact tail after ':' over nested-def collisions.
+        exact = [h for h in hits if h.key.split(":", 1)[-1] == suffix]
+        return exact[0] if len(exact) == 1 else None
+
+    def method(self, cls: str, name: str) -> Optional[FuncInfo]:
+        info = self.classes.get(cls)
+        if info is None:
+            return None
+        fi = self.functions.get(f"{info.mod}:{cls}.{name}")
+        if fi is not None:
+            return fi
+        for base in info.bases:  # one level of inheritance is enough
+            binfo = self.classes.get(base)
+            if binfo is not None:
+                fi = self.functions.get(f"{binfo.mod}:{base}.{name}")
+                if fi is not None:
+                    return fi
+        return None
+
+
+# -- small AST helpers -------------------------------------------------------
+def _is_self_attr(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def _tail_name(node) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _ctor_kind(value) -> Optional[str]:
+    """'lock'/'rlock'/'sync' when ``value`` constructs a primitive.
+
+    Sees through ``lockwatch.maybe_wrap("name", threading.RLock())`` —
+    the runtime recorder must not hide the lock from the static pass.
+    """
+    if not isinstance(value, ast.Call):
+        return None
+    name = _tail_name(value.func)
+    if name == "maybe_wrap" and len(value.args) == 2:
+        return _ctor_kind(value.args[1])
+    if name in LOCK_CTORS:
+        return LOCK_CTORS[name]
+    if name in SYNC_CTORS:
+        return "sync"
+    return None
+
+
+def _ctor_class(value) -> Optional[str]:
+    """Class basename when ``value`` looks like ``ClassName(...)``."""
+    if isinstance(value, ast.Call):
+        name = _tail_name(value.func)
+        if name and name[0].isupper():
+            return name
+    return None
+
+
+def _annotation_type(ann) -> Optional[Tuple[str, str]]:
+    """('plain'|'ctr', ClassName) from an annotation expression.
+
+    ``Dict[str, RequestState]`` → ('ctr', 'RequestState');
+    ``Optional[RequestState]`` → ('plain', 'RequestState').
+    Unknown shapes → None (the access is simply not typed).
+    """
+    head = None
+    if isinstance(ann, ast.Subscript):
+        head = _tail_name(ann.value)
+    names = [
+        n.id if isinstance(n, ast.Name) else n.attr
+        for n in ast.walk(ann)
+        if isinstance(n, (ast.Name, ast.Attribute))
+    ]
+    classish = [
+        n for n in names
+        if n and n[0].isupper() and n not in _CONTAINER_HEADS
+        and n != "Optional"
+    ]
+    if not classish:
+        return None
+    kind = "ctr" if head in _CONTAINER_HEADS else "plain"
+    return (kind, classish[-1])
+
+
+# -- type inference ----------------------------------------------------------
+@dataclasses.dataclass
+class Env:
+    """Per-function inference context for one walk."""
+
+    prog: Program
+    func: FuncInfo
+    # local name -> ("plain"|"ctr", ClassName)
+    locals: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # "Class.method" -> ClassName returned (reviewed modeling table)
+    returns: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def mod(self) -> str:
+        return self.func.mod
+
+    @property
+    def cls(self) -> Optional[str]:
+        return self.func.cls
+
+
+def infer(expr, env: Env) -> Optional[Tuple[str, str]]:
+    """Abstract type of ``expr``: ('plain'|'ctr', ClassName) or None."""
+    prog = env.prog
+    if isinstance(expr, ast.Name):
+        if expr.id == "self" and env.cls:
+            return ("plain", env.cls)
+        return env.locals.get(expr.id)
+    if isinstance(expr, ast.Attribute):
+        base = infer(expr.value, env)
+        if base is not None and base[0] == "plain":
+            cinfo = prog.classes.get(base[1])
+            if cinfo is not None:
+                t = cinfo.attr_types.get(expr.attr)
+                if t is not None:
+                    return t
+        return None
+    if isinstance(expr, ast.Subscript):
+        base = infer(expr.value, env)
+        if base is not None and base[0] == "ctr":
+            return ("plain", base[1])
+        return None
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        # list(X) / sorted(X) wrappers keep the element type.
+        if isinstance(fn, ast.Name) and fn.id in ("list", "sorted"):
+            if expr.args:
+                return infer(expr.args[0], env)
+            return None
+        name = _tail_name(fn)
+        if name in prog.classes:
+            return ("plain", name)
+        if isinstance(fn, ast.Attribute):
+            recv = infer(fn.value, env)
+            if recv is not None:
+                if recv[0] == "ctr" and name in _CONTAINER_ELT_METHODS:
+                    return ("plain", recv[1])
+                if recv[0] == "ctr" and name == "values":
+                    return ("ctr", recv[1])
+                if recv[0] == "plain":
+                    ret = env.returns.get(f"{recv[1]}.{name}")
+                    if ret is not None:
+                        return ("plain", ret)
+    return None
+
+
+def iter_elt(expr, env: Env) -> Optional[Tuple[str, str]]:
+    """Type of the loop variable in ``for x in <expr>``."""
+    t = infer(expr, env)
+    if t is not None and t[0] == "ctr":
+        return ("plain", t[1])
+    return None
+
+
+# -- lock identity -----------------------------------------------------------
+def lock_id(expr, env: Env) -> Optional[Tuple[str, str]]:
+    """(canonical id, 'lock'|'rlock') when ``expr`` names a known lock.
+
+    ``self._lock`` in a method of C → ``C._lock``; a module-global
+    ``_lock`` → ``mod._lock``; ``degrade_mod._lock`` resolves through
+    the importing module's aliases.
+    """
+    prog = env.prog
+    if isinstance(expr, ast.Name):
+        k = prog.module_locks.get((env.mod, expr.id))
+        if k is not None:
+            return (f"{env.mod.rsplit('.', 1)[-1]}.{expr.id}", k)
+        t = env.locals.get(expr.id)
+        if t is not None and t[0] == "plain":
+            # A local bound to a lock-typed object (rare; fixtures).
+            cinfo = prog.classes.get(t[1])
+            if cinfo is None:
+                return None
+        return None
+    if isinstance(expr, ast.Attribute):
+        # module-alias attribute: faults_mod._lock
+        if isinstance(expr.value, ast.Name):
+            alias = expr.value.id
+            target = prog.imports.get(env.mod, {}).get(alias)
+            if target is not None:
+                for (m, n), k in prog.module_locks.items():
+                    if n == expr.attr and (
+                        m == target or m.rsplit(".", 1)[-1] == target
+                    ):
+                        return (f"{m.rsplit('.', 1)[-1]}.{n}", k)
+        base = infer(expr.value, env)
+        if base is not None and base[0] == "plain":
+            cinfo = prog.classes.get(base[1])
+            if cinfo is not None:
+                k = cinfo.attr_kinds.get(expr.attr)
+                if k in ("lock", "rlock"):
+                    return (f"{base[1]}.{expr.attr}", k)
+    return None
+
+
+def module_short(mod: str) -> str:
+    return mod.rsplit(".", 1)[-1]

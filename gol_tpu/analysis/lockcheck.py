@@ -1,0 +1,856 @@
+"""lockcheck — thread-safety verifier for the host plane.
+
+The serving tier (PR 12) and the elasticity plane (PR 14) reintroduced
+real shared-mutable-state concurrency around the pure step function:
+``ThreadingHTTPServer`` handler threads, the ``ServeScheduler`` drive
+loop, the async snapshot writer, and the metrics observer all touch the
+same objects.  This pass proves, from the AST alone (nothing analyzed
+is ever imported or executed), three properties per deployment cell:
+
+- **inventory** — every ``threading.Lock/RLock/Condition`` and every
+  thread entry point (configured roots plus discovered
+  ``threading.Thread(target=...)`` sites) is enumerated, so a new lock
+  or thread cannot appear without the analyzer seeing it.
+- **lock-order** — the cross-module lock acquisition graph built from
+  nested ``with lock:`` scopes (interprocedurally, through resolved
+  calls) must be acyclic; a cycle is a potential deadlock.  Acquiring a
+  non-reentrant ``Lock`` already held is a self-deadlock and reported
+  directly.  RLock/Condition re-entry is legal and adds no edge.
+- **guarded-fields** — fields of the classes in the cell's discipline
+  table that are reachable from ≥2 thread labels and mutated anywhere
+  must be accessed only while holding their owning lock.  Violations
+  are ``file:line`` findings.  Intentional lock-free patterns (e.g. the
+  telemetry shed handoff) are *waived*, not silenced: the committed
+  ``concurrency_waivers.json`` carries a one-line justification per
+  key, waived findings render as INFO, and a waiver that matches no
+  finding is itself an ERROR (stale waivers rot the discipline table).
+
+Construction is exempt by design: the walk never descends into
+``__init__`` bodies — pre-publication objects are single-threaded, and
+treating constructor writes as shared accesses would drown the report.
+Sync-primitive-typed fields (Event/Queue) are exempt: touching the
+primitive object is the thread-safe operation itself.
+
+TEETH: the committed broken fixtures under
+``tests/data/concurrency_fixtures/`` (a real lock inversion and an
+unguarded cross-thread write) are analyzed on every run and MUST fail;
+a fixture coming back green means the analyzer lost its witness.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from gol_tpu.analysis import hostwalk
+from gol_tpu.analysis.hostwalk import Env, FuncInfo, Program
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+WAIVER_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "concurrency_waivers.json"
+)
+FIXTURE_DIR = os.path.join(
+    _REPO_ROOT, "tests", "data", "concurrency_fixtures"
+)
+
+
+@dataclasses.dataclass
+class LockCellConfig:
+    """One deployment topology: which modules run which threads."""
+
+    name: str
+    # (short module name, absolute file path)
+    modules: List[Tuple[str, str]]
+    # (thread label, function suffix) — see Program.find
+    roots: List[Tuple[str, str]]
+    # class basename -> owning lock id (None = no lock exists; every
+    # shared mutated access needs a waiver)
+    guarded: Dict[str, Optional[str]]
+    # "Class.method" -> returned class basename (reviewed modeling
+    # table for factories the inferencer cannot see through)
+    returns: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # "Class.attr" -> callee suffixes: calling the attribute invokes
+    # these (the EventLog.observer -> MetricsRegistry.observe binding)
+    callbacks: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # callee suffix -> thread label: function-valued arguments of this
+    # callee run later on that thread (the async-writer submit queue)
+    deferred: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # caller suffix -> extra callee suffixes the AST cannot resolve
+    extra_edges: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # (class, attr) -> class basename: type facts the inferencer
+    # cannot derive (plumbed-through constructor results)
+    attr_types: Dict[Tuple[str, str], str] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+def _pkg(rel: str) -> Tuple[str, str]:
+    mod = rel[:-3].replace("/", ".").replace(".__init__", "")
+    return (mod, os.path.join(_PKG_DIR, rel))
+
+
+def default_lock_matrix() -> List[LockCellConfig]:
+    serve_modules = [
+        _pkg("serve/scheduler.py"),
+        _pkg("serve/server.py"),
+        _pkg("serve/journal.py"),
+        ("serve.main", os.path.join(_PKG_DIR, "serve", "__main__.py")),
+        _pkg("telemetry/__init__.py"),
+        _pkg("telemetry/metrics.py"),
+        _pkg("resilience/health.py"),
+        _pkg("resilience/degrade.py"),
+        _pkg("resilience/faults.py"),
+    ]
+    runtime_modules = [
+        _pkg("runtime.py"),
+        _pkg("utils/checkpoint.py"),
+        _pkg("telemetry/__init__.py"),
+        _pkg("telemetry/metrics.py"),
+        _pkg("resilience/degrade.py"),
+        _pkg("resilience/faults.py"),
+    ]
+    return [
+        LockCellConfig(
+            name="lock/serve",
+            modules=serve_modules,
+            roots=[
+                ("http", "serve.server:_Handler.do_GET"),
+                ("http", "serve.server:_Handler.do_POST"),
+                ("main", "serve.main:main"),
+                ("main", "ServeScheduler.run_once"),
+                ("main", "ServeScheduler.run_until_drained"),
+                ("main", "ServeScheduler.drain"),
+                ("main", "ServeScheduler.close"),
+            ],
+            guarded={
+                "ServeScheduler": "ServeScheduler._lock",
+                "RequestState": "ServeScheduler._lock",
+                "Journal": "ServeScheduler._lock",
+                "HealthMonitor": "ServeScheduler._lock",
+                "EventLog": "ServeScheduler._lock",
+                "MetricsRegistry": "MetricsRegistry._lock",
+            },
+            returns={
+                "ServeScheduler.get_result": "RequestState",
+                "ServeScheduler.submit": "RequestState",
+            },
+            callbacks={
+                "EventLog.observer": ["MetricsRegistry.observe"],
+            },
+        ),
+        LockCellConfig(
+            name="lock/runtime",
+            modules=runtime_modules,
+            roots=[
+                ("main", "GolRuntime.run"),
+                ("metrics-http", "telemetry.metrics:_Handler.do_GET"),
+                ("ckpt-writer", "AsyncSnapshotWriter._loop"),
+            ],
+            guarded={
+                "EventLog": None,
+                "MetricsRegistry": "MetricsRegistry._lock",
+                "AsyncSnapshotWriter": None,
+            },
+            callbacks={
+                "EventLog.observer": ["MetricsRegistry.observe"],
+            },
+            deferred={
+                "AsyncSnapshotWriter.submit": "ckpt-writer",
+            },
+            attr_types={
+                ("GolRuntime", "_live_events"): "EventLog",
+                ("GolRuntime", "_ckpt_writer"): "AsyncSnapshotWriter",
+            },
+        ),
+    ]
+
+
+# -- waivers -----------------------------------------------------------------
+def load_waivers(
+    section: str, path: Optional[str] = None
+) -> Dict[str, str]:
+    """key -> one-line justification for one pass's section."""
+    p = path or WAIVER_PATH
+    if not os.path.exists(p):
+        return {}
+    with open(p) as f:
+        data = json.load(f)
+    known = {"version", "lockcheck", "spmdcheck"}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(
+            f"unknown sections in {os.path.basename(p)}: {sorted(unknown)}"
+        )
+    out: Dict[str, str] = {}
+    for entry in data.get(section, []):
+        if set(entry) != {"key", "why"} or not entry["why"].strip():
+            raise ValueError(
+                f"waiver entries need exactly 'key' and a non-empty "
+                f"'why': {entry!r}"
+            )
+        out[entry["key"]] = entry["why"]
+    return out
+
+
+# -- the walk ----------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Access:
+    cls: str
+    attr: str
+    path: str
+    lineno: int
+    label: str
+    held: FrozenSet[str]
+    is_write: bool
+
+    @property
+    def key(self) -> str:
+        return f"{self.cls}.{self.attr}"
+
+
+class _CellWalker:
+    def __init__(self, prog: Program, cfg: LockCellConfig) -> None:
+        self.prog = prog
+        self.cfg = cfg
+        self.accesses: Set[Access] = set()
+        # (held_lock, acquired_lock) -> (path, lineno)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.lock_errors: List[Finding] = []
+        self.roots_walked: List[Tuple[str, str]] = []
+        self._memo: Set[Tuple[str, FrozenSet[str], str]] = set()
+        for (c, a), t in cfg.attr_types.items():
+            info = prog.classes.get(c)
+            if info is not None:
+                info.attr_types.setdefault(a, ("plain", t))
+
+    # .. roots ..............................................................
+    def run(self) -> None:
+        for label, suffix in self.cfg.roots:
+            fi = self.prog.find(suffix)
+            if fi is None:
+                self.lock_errors.append(
+                    Finding(
+                        ERROR, "inventory",
+                        f"configured root {suffix!r} not found — the "
+                        f"entry-point table is stale",
+                    )
+                )
+                continue
+            self.roots_walked.append((label, fi.key))
+            self._visit(fi, frozenset(), label)
+        walked_keys = {key for _, key in self.roots_walked}
+        for site in self.prog.thread_sites:
+            fi, label = self._resolve_thread(site)
+            # A function already rooted under a configured label is not
+            # re-rooted under its thread-name label (one root per
+            # entry-point function; the label is just its display name).
+            if fi is not None and fi.key not in walked_keys:
+                walked_keys.add(fi.key)
+                self.roots_walked.append((label, fi.key))
+                self._visit(fi, frozenset(), label)
+
+    def _resolve_thread(self, site) -> Tuple[Optional[FuncInfo], str]:
+        target = None
+        label = None
+        for kw in site.call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                label = str(kw.value.value)
+        if target is None:
+            return None, ""
+        env = self._env(site.func) if site.func else None
+        fi = self._resolve_callee(target, env) if env else None
+        if fi is None and isinstance(target, ast.Name):
+            fi = self.prog.functions.get(f"{site.mod}:{target.id}")
+        if fi is None:
+            return None, ""
+        return fi, label or fi.key.rsplit(".", 1)[-1]
+
+    def _env(self, fi: FuncInfo) -> Env:
+        env = Env(self.prog, fi, returns=dict(self.cfg.returns))
+        node = fi.node
+        if hasattr(node, "args"):
+            for arg in node.args.args + node.args.kwonlyargs:
+                if arg.annotation is not None:
+                    t = hostwalk._annotation_type(arg.annotation)
+                    if t is not None:
+                        env.locals[arg.arg] = t
+        return env
+
+    # .. function visit .....................................................
+    def _visit(
+        self, fi: FuncInfo, held: FrozenSet[str], label: str
+    ) -> None:
+        memo_key = (fi.key, held, label)
+        if memo_key in self._memo:
+            return
+        self._memo.add(memo_key)
+        if fi.key.rsplit(".", 1)[-1] == "__init__":
+            return  # construction phase: pre-publication, one thread
+        env = self._env(fi)
+        self._stmts(list(fi.node.body), env, held, label, fi)
+        for suffix in self.cfg.extra_edges.get(
+            fi.key.split(":", 1)[-1], []
+        ):
+            callee = self.prog.find(suffix)
+            if callee is not None:
+                self._visit(callee, held, label)
+
+    def _stmts(self, stmts, env, held, label, fi) -> FrozenSet[str]:
+        for st in stmts:
+            held = self._stmt(st, env, held, label, fi)
+        return held
+
+    def _stmt(self, st, env, held, label, fi) -> FrozenSet[str]:
+        if isinstance(st, ast.With):
+            inner = held
+            path = _rel(self.prog.paths[env.mod])
+            for item in st.items:
+                lid = hostwalk.lock_id(item.context_expr, env)
+                if lid is not None:
+                    inner = self._acquire(lid, inner, st, label, path)
+                else:
+                    self._expr(item.context_expr, env, held, label, fi)
+            self._stmts(st.body, env, inner, label, fi)
+            return held
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return held  # nested defs run when called, not when defined
+        if isinstance(st, ast.Assign):
+            self._expr(st.value, env, held, label, fi)
+            t = hostwalk.infer(st.value, env)
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name) and t is not None:
+                    env.locals[tgt.id] = t
+                self._target(tgt, env, held, label, fi)
+            return held
+        if isinstance(st, ast.AugAssign):
+            self._expr(st.value, env, held, label, fi)
+            self._record_attr(st.target, env, held, label, fi, write=True)
+            return held
+        if isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value, env, held, label, fi)
+            if isinstance(st.target, ast.Name):
+                t = hostwalk._annotation_type(st.annotation)
+                if t is not None:
+                    env.locals[st.target.id] = t
+            else:
+                self._target(st.target, env, held, label, fi)
+            return held
+        if isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test, env, held, label, fi)
+            self._stmts(st.body, env, held, label, fi)
+            self._stmts(st.orelse, env, held, label, fi)
+            return held
+        if isinstance(st, ast.For):
+            self._expr(st.iter, env, held, label, fi)
+            if isinstance(st.target, ast.Name):
+                t = hostwalk.iter_elt(st.iter, env)
+                if t is not None:
+                    env.locals[st.target.id] = t
+            self._stmts(st.body, env, held, label, fi)
+            self._stmts(st.orelse, env, held, label, fi)
+            return held
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, env, held, label, fi)
+            for h in st.handlers:
+                self._stmts(h.body, env, held, label, fi)
+            self._stmts(st.orelse, env, held, label, fi)
+            self._stmts(st.finalbody, env, held, label, fi)
+            return held
+        if isinstance(st, ast.Expr):
+            # Bare acquire()/release() statements adjust the held set
+            # for the remainder of the suite.
+            if isinstance(st.value, ast.Call):
+                fn = st.value.func
+                if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "acquire", "release"
+                ):
+                    lid = hostwalk.lock_id(fn.value, env)
+                    if lid is not None:
+                        if fn.attr == "acquire":
+                            return self._acquire(
+                                lid, held, st, label,
+                                _rel(self.prog.paths[env.mod]),
+                            )
+                        return held - {lid[0]}
+            self._expr(st.value, env, held, label, fi)
+            return held
+        if isinstance(st, ast.Return) and st.value is not None:
+            self._expr(st.value, env, held, label, fi)
+            return held
+        if isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._expr(st.exc, env, held, label, fi)
+            return held
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, env, held, label, fi)
+        return held
+
+    def _acquire(self, lid, held, node, label, path) -> FrozenSet[str]:
+        name, kind = lid
+        if name in held:
+            if kind == "lock":
+                self.lock_errors.append(
+                    Finding(
+                        ERROR, "lock-order",
+                        f"non-reentrant lock {name} re-acquired while "
+                        f"already held (self-deadlock) at {path}:"
+                        f"{node.lineno} [thread {label!r}]",
+                    )
+                )
+            return held
+        for h in held:
+            self.edges.setdefault((h, name), (path, node.lineno))
+        return held | {name}
+
+    def _target(self, tgt, env, held, label, fi) -> None:
+        if isinstance(tgt, ast.Attribute):
+            self._record_attr(tgt, env, held, label, fi, write=True)
+        elif isinstance(tgt, ast.Subscript):
+            # d[k] = v on a guarded attribute mutates the field.
+            if isinstance(tgt.value, ast.Attribute):
+                self._record_attr(
+                    tgt.value, env, held, label, fi, write=True
+                )
+            self._expr(tgt.slice, env, held, label, fi)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._target(e, env, held, label, fi)
+
+    # .. expressions ........................................................
+    def _expr(self, e, env, held, label, fi) -> None:
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._call(e, env, held, label, fi)
+            return
+        if isinstance(e, ast.Attribute):
+            self._record_attr(e, env, held, label, fi, write=False)
+            self._expr(e.value, env, held, label, fi)
+            return
+        if isinstance(e, (ast.Lambda, ast.FunctionDef)):
+            return  # deferred bodies run where they are invoked
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._expr(child, env, held, label, fi)
+            elif isinstance(child, ast.comprehension):
+                self._expr(child.iter, env, held, label, fi)
+                for cond in child.ifs:
+                    self._expr(cond, env, held, label, fi)
+
+    def _call(self, call, env, held, label, fi) -> None:
+        p = self.prog
+        fn = call.func
+        # receiver mutation: self._requests.clear() writes the field
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in hostwalk.MUTATING_METHODS
+            and isinstance(fn.value, ast.Attribute)
+        ):
+            self._record_attr(fn.value, env, held, label, fi, write=True)
+        callees: List[FuncInfo] = []
+        deferred_label: Optional[str] = None
+        callee = self._resolve_callee(fn, env)
+        if callee is not None:
+            callees.append(callee)
+            tail = callee.key.split(":", 1)[-1]
+            for suffix, lbl in self.cfg.deferred.items():
+                if tail == suffix or tail.endswith("." + suffix):
+                    deferred_label = lbl
+        # callback attributes: self.observer(rec)
+        if isinstance(fn, ast.Attribute) and callee is None:
+            recv = hostwalk.infer(fn.value, env)
+            if recv is not None and recv[0] == "plain":
+                for suffix in self.cfg.callbacks.get(
+                    f"{recv[1]}.{fn.attr}", []
+                ):
+                    cb = p.find(suffix)
+                    if cb is not None:
+                        callees.append(cb)
+        if isinstance(fn, ast.Attribute):
+            self._record_attr(fn, env, held, label, fi, write=False)
+            self._expr(fn.value, env, held, label, fi)
+        for c in callees:
+            self._visit(c, held, label)
+        # function-valued arguments are invoked (now, or later on the
+        # deferred executor's thread with nothing held)
+        for arg in list(call.args) + [
+            kw.value for kw in call.keywords
+        ]:
+            target = self._resolve_callee(arg, env)
+            if target is not None:
+                if deferred_label is not None:
+                    self._visit(target, frozenset(), deferred_label)
+                else:
+                    self._visit(target, held, label)
+            else:
+                self._expr(arg, env, held, label, fi)
+
+    def _resolve_callee(self, fn, env) -> Optional[FuncInfo]:
+        p = self.prog
+        if isinstance(fn, ast.Name):
+            nested = p.functions.get(f"{env.func.key}.{fn.id}")
+            if nested is not None:
+                return nested
+            mod_fn = p.functions.get(f"{env.mod}:{fn.id}")
+            if mod_fn is not None:
+                return mod_fn
+            return None
+        if isinstance(fn, ast.Attribute):
+            if fn.attr.startswith("__") and fn.attr != "__call__":
+                return None
+            if isinstance(fn.value, ast.Name):
+                alias = fn.value.id
+                target = p.imports.get(env.mod, {}).get(alias)
+                if target is not None:
+                    short = target.rsplit(".", 1)[-1]
+                    for key, info in p.functions.items():
+                        m, rest = key.split(":", 1)
+                        if rest == fn.attr and (
+                            m == target
+                            or m.rsplit(".", 1)[-1] == short
+                        ):
+                            return info
+            recv = hostwalk.infer(fn.value, env)
+            if recv is not None and recv[0] == "plain":
+                m = p.method(recv[1], fn.attr)
+                if m is not None and m.key.rsplit(".", 1)[-1] != "__init__":
+                    return m
+        return None
+
+    def _record_attr(self, node, env, held, label, fi, write) -> None:
+        if not isinstance(node, ast.Attribute):
+            return
+        recv = hostwalk.infer(node.value, env)
+        if recv is None or recv[0] != "plain":
+            return
+        cls = recv[1]
+        if cls not in self.cfg.guarded:
+            return
+        info = self.prog.classes.get(cls)
+        if info is not None:
+            kind = info.attr_kinds.get(node.attr)
+            if kind in ("lock", "rlock", "sync"):
+                return  # the primitive itself is the synchronization
+        m = self.prog.method(cls, node.attr)
+        if m is not None:
+            if m.is_property:
+                self._visit(m, held, label)
+            return  # methods are calls, not field state
+        self.accesses.add(
+            Access(
+                cls, node.attr, _rel(self.prog.paths[env.mod]),
+                node.lineno, label, held, write,
+            )
+        )
+
+
+def _rel(path: str) -> str:
+    try:
+        return os.path.relpath(path, _REPO_ROOT)
+    except ValueError:
+        return path
+
+
+# -- cycle detection ---------------------------------------------------------
+def find_cycle(
+    edges: Dict[Tuple[str, str], Tuple[str, int]]
+) -> Optional[List[str]]:
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for nxt in graph.get(n, []):
+            c = color.get(nxt, WHITE)
+            if c == GRAY:
+                return stack[stack.index(nxt):] + [nxt]
+            if c == WHITE:
+                got = dfs(nxt)
+                if got is not None:
+                    return got
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color.get(n, WHITE) == WHITE:
+            got = dfs(n)
+            if got is not None:
+                return got
+    return None
+
+
+# -- per-cell analysis -------------------------------------------------------
+def analyze_cell(
+    cfg: LockCellConfig, waivers: Dict[str, str]
+) -> Tuple[EngineReport, Set[str]]:
+    prog = Program.load(cfg.modules)
+    walker = _CellWalker(prog, cfg)
+    walker.run()
+
+    inventory: List[Finding] = [
+        f for f in walker.lock_errors if f.check == "inventory"
+    ]
+    for cname, info in sorted(prog.classes.items()):
+        for attr, kind in sorted(info.attr_kinds.items()):
+            if kind in ("lock", "rlock"):
+                inventory.append(
+                    Finding(
+                        INFO, "inventory",
+                        f"lock {cname}.{attr} ({kind}) in "
+                        f"{_rel(prog.paths[info.mod])}",
+                    )
+                )
+    for (mod, name), kind in sorted(prog.module_locks.items()):
+        inventory.append(
+            Finding(
+                INFO, "inventory",
+                f"lock {hostwalk.module_short(mod)}.{name} ({kind}) in "
+                f"{_rel(prog.paths[mod])}",
+            )
+        )
+    for label, key in walker.roots_walked:
+        inventory.append(
+            Finding(INFO, "inventory", f"thread root [{label}] {key}")
+        )
+
+    order: List[Finding] = [
+        f for f in walker.lock_errors if f.check == "lock-order"
+    ]
+    for (a, b), (path, lineno) in sorted(walker.edges.items()):
+        order.append(
+            Finding(
+                INFO, "lock-order", f"edge {a} -> {b} ({path}:{lineno})"
+            )
+        )
+    cycle = find_cycle(walker.edges)
+    if cycle is not None:
+        order.append(
+            Finding(
+                ERROR, "lock-order",
+                "lock-order cycle (potential deadlock): "
+                + " -> ".join(cycle),
+            )
+        )
+
+    guarded: List[Finding] = []
+    used_waivers: Set[str] = set()
+    by_field: Dict[Tuple[str, str], List[Access]] = {}
+    for acc in walker.accesses:
+        by_field.setdefault((acc.cls, acc.attr), []).append(acc)
+    for (cls, attr), accs in sorted(by_field.items()):
+        labels = {a.label for a in accs}
+        mutated = any(a.is_write for a in accs)
+        if len(labels) < 2 or not mutated:
+            continue
+        owner = cfg.guarded[cls]
+        key = f"{cls}.{attr}"
+        for acc in sorted(accs, key=lambda a: (a.path, a.lineno)):
+            if owner is not None and owner in acc.held:
+                continue
+            verb = "written" if acc.is_write else "read"
+            need = owner if owner is not None else "any lock (none exists)"
+            if key in waivers:
+                used_waivers.add(key)
+                guarded.append(
+                    Finding(
+                        INFO, "guarded-fields",
+                        f"waived: {key} {verb} without {need} from "
+                        f"thread {acc.label!r} at {acc.path}:"
+                        f"{acc.lineno} — {waivers[key]}",
+                    )
+                )
+            else:
+                guarded.append(
+                    Finding(
+                        ERROR, "guarded-fields",
+                        f"{key} {verb} without {need} from thread "
+                        f"{acc.label!r} at {acc.path}:{acc.lineno} "
+                        f"(held: {sorted(acc.held) or '{}'}; field is "
+                        f"shared by threads {sorted(labels)})",
+                    )
+                )
+
+    report = EngineReport(
+        config_name=cfg.name,
+        checks=[
+            CheckResult.from_findings("inventory", inventory),
+            CheckResult.from_findings("lock-order", order),
+            CheckResult.from_findings("guarded-fields", guarded),
+        ],
+    )
+    return report, used_waivers
+
+
+# -- teeth -------------------------------------------------------------------
+def _fixture_cell(name: str) -> Optional[LockCellConfig]:
+    path = os.path.join(FIXTURE_DIR, name)
+    if not os.path.exists(path):
+        return None
+    return LockCellConfig(
+        name=f"fixture/{name}",
+        modules=[(name[:-3], path)],
+        roots=[],
+        guarded={},
+    )
+
+
+def run_lock_teeth() -> EngineReport:
+    """Analyze the committed broken fixtures; they MUST fail."""
+    checks: List[CheckResult] = []
+
+    inv = _fixture_cell("broken_lock_inversion.py")
+    if inv is None:
+        checks.append(
+            CheckResult.skipped(
+                "teeth-inversion", "fixture dir not present"
+            )
+        )
+    else:
+        rep, _ = analyze_cell(inv, {})
+        errs = [
+            f
+            for c in rep.checks
+            if c.check == "lock-order"
+            for f in c.findings
+            if f.severity == ERROR and "cycle" in f.message
+        ]
+        if errs:
+            checks.append(
+                CheckResult.from_findings(
+                    "teeth-inversion",
+                    [
+                        Finding(
+                            INFO, "teeth-inversion",
+                            f"fixture correctly flagged: {errs[0].message}",
+                        )
+                    ],
+                )
+            )
+        else:
+            checks.append(
+                CheckResult.from_findings(
+                    "teeth-inversion",
+                    [
+                        Finding(
+                            ERROR, "teeth-inversion",
+                            "broken_lock_inversion.py produced NO "
+                            "lock-order cycle — the deadlock detector "
+                            "lost its witness",
+                        )
+                    ],
+                )
+            )
+
+    ug = _fixture_cell("broken_unguarded_write.py")
+    if ug is None:
+        checks.append(
+            CheckResult.skipped(
+                "teeth-unguarded", "fixture dir not present"
+            )
+        )
+    else:
+        ug.guarded = {"Worker": "Worker._lock"}
+        rep, _ = analyze_cell(ug, {})
+        errs = [
+            f
+            for c in rep.checks
+            if c.check == "guarded-fields"
+            for f in c.findings
+            if f.severity == ERROR
+        ]
+        if errs:
+            checks.append(
+                CheckResult.from_findings(
+                    "teeth-unguarded",
+                    [
+                        Finding(
+                            INFO, "teeth-unguarded",
+                            f"fixture correctly flagged: {errs[0].message}",
+                        )
+                    ],
+                )
+            )
+        else:
+            checks.append(
+                CheckResult.from_findings(
+                    "teeth-unguarded",
+                    [
+                        Finding(
+                            ERROR, "teeth-unguarded",
+                            "broken_unguarded_write.py produced NO "
+                            "guarded-field violation — the discipline "
+                            "check lost its witness",
+                        )
+                    ],
+                )
+            )
+    return EngineReport(config_name="lock/teeth", checks=checks)
+
+
+# -- entry point -------------------------------------------------------------
+def run_lock_checks(
+    matrix: Optional[List[LockCellConfig]] = None,
+    waiver_path: Optional[str] = None,
+) -> List[EngineReport]:
+    try:
+        waivers = load_waivers("lockcheck", waiver_path)
+        waiver_err = None
+    except ValueError as e:
+        waivers, waiver_err = {}, str(e)
+    reports: List[EngineReport] = []
+    used: Set[str] = set()
+    for cfg in matrix if matrix is not None else default_lock_matrix():
+        rep, used_keys = analyze_cell(cfg, waivers)
+        used |= used_keys
+        reports.append(rep)
+    reports.append(run_lock_teeth())
+
+    wfindings: List[Finding] = []
+    if waiver_err is not None:
+        wfindings.append(Finding(ERROR, "waivers", waiver_err))
+    for key, why in sorted(waivers.items()):
+        if key in used:
+            wfindings.append(
+                Finding(INFO, "waivers", f"in use: {key} — {why}")
+            )
+        else:
+            wfindings.append(
+                Finding(
+                    ERROR, "waivers",
+                    f"stale waiver {key!r}: no current finding matches "
+                    f"it — remove the entry or restore the pattern it "
+                    f"documents",
+                )
+            )
+    reports.append(
+        EngineReport(
+            config_name="lock/waivers",
+            checks=[CheckResult.from_findings("waivers", wfindings)],
+        )
+    )
+    return reports

@@ -1,0 +1,114 @@
+"""lockwatch — env-gated runtime witness for the static lock graph.
+
+lockcheck (static) proves the lock-order graph acyclic from the AST;
+this module records what *actually* happens at runtime so a serve run
+can assert the dynamic acquisition order is a subgraph of the static
+one.  Off by default and zero-cost when off: ``maybe_wrap`` returns
+the raw lock unless ``GOL_LOCKWATCH=1`` is set, so production paths
+carry no indirection.
+
+Usage (already wired in the serve scheduler and metrics registry)::
+
+    self._lock = lockwatch.maybe_wrap(
+        "ServeScheduler._lock", threading.RLock()
+    )
+
+With the env var set, every acquisition records a per-thread held
+stack and emits ``(outermost_held, acquired)`` edges into a module
+registry; :func:`check` returns the edges that violate a static edge
+set and :func:`find_cycle` reuses lockcheck's cycle detector.  The
+serve stress test runs with the recorder on and asserts (a) no cycle
+and (b) every dynamic edge appears in lockcheck's static graph.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_VAR = "GOL_LOCKWATCH"
+
+_registry_lock = threading.Lock()
+_edges: Set[Tuple[str, str]] = set()
+_acquires: Dict[str, int] = {}
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(ENV_VAR))
+
+
+def reset() -> None:
+    with _registry_lock:
+        _edges.clear()
+        _acquires.clear()
+
+
+def edges() -> Set[Tuple[str, str]]:
+    with _registry_lock:
+        return set(_edges)
+
+
+def acquire_counts() -> Dict[str, int]:
+    with _registry_lock:
+        return dict(_acquires)
+
+
+class WatchedLock:
+    """Context-manager/acquire-release proxy that records order."""
+
+    def __init__(self, name: str, lock) -> None:
+        self.name = name
+        self._lock = lock
+
+    def _held_stack(self) -> List[str]:
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        return stack
+
+    def acquire(self, *args, **kwargs):
+        got = self._lock.acquire(*args, **kwargs)
+        if got:
+            stack = self._held_stack()
+            with _registry_lock:
+                _acquires[self.name] = _acquires.get(self.name, 0) + 1
+                if self.name not in stack:  # reentrancy adds no edge
+                    for held in stack:
+                        _edges.add((held, self.name))
+            stack.append(self.name)
+        return got
+
+    def release(self) -> None:
+        stack = self._held_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        elif self.name in stack:  # out-of-order release; stay balanced
+            stack.remove(self.name)
+        self._lock.release()
+
+    def __enter__(self) -> "WatchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def maybe_wrap(name: str, lock):
+    """The one call sites use: free when the recorder is off."""
+    if not enabled():
+        return lock
+    return WatchedLock(name, lock)
+
+
+def find_cycle() -> Optional[List[str]]:
+    from gol_tpu.analysis.lockcheck import find_cycle as _fc
+
+    return _fc({e: ("", 0) for e in edges()})
+
+
+def check(static_edges: Set[Tuple[str, str]]) -> Set[Tuple[str, str]]:
+    """Dynamic edges the static lock-order graph does not predict."""
+    return edges() - set(static_edges)

@@ -1,0 +1,423 @@
+"""spmdcheck — rank-symmetry verifier for host collectives.
+
+Every process of a multi-host run executes the same host program; the
+collectives it issues (`multihost.allgather_host_ints`, the
+`sync_global_devices` save/row barriers, `fetch_global`'s replication
+gather) are rendezvous points ALL ranks must reach in the same order.
+A collective dominated by a branch only some ranks take — the classic
+``if jax.process_index() == 0:`` mistake — deadlocks the job: rank 0
+waits in the collective, everyone else is already past it (or vice
+versa).  "Persistent and Partitioned MPI for Stencil Communication"
+(PAPERS.md) frames the same fact at the MPI layer: the communication
+*schedule*, not just the payload, is the correctness surface.
+
+The pass is a whole-package AST scan (nothing is imported):
+
+- **taint** — ``jax.process_index()`` results, names assigned from
+  them, and ``.is_coordinator`` reads are *rank-divergent*.
+  ``jax.process_count()`` and collective results are uniform by
+  construction (every rank computes the same value), so the pervasive
+  ``if jax.process_count() == 1: return`` short-circuits stay green.
+- **sites** — every call to a collective (directly, or through a
+  function this package defines that transitively issues one) is
+  enumerated as INFO; a site inside a rank-tainted branch, or after a
+  rank-tainted early return in the same function, is an ERROR.
+- **waivers** — same committed allowlist as lockcheck
+  (``concurrency_waivers.json``, section ``spmdcheck``), keyed by
+  ``file:function``; stale entries are errors.
+
+TEETH: ``tests/data/concurrency_fixtures/broken_rank_gated_collective
+.py`` MUST produce a divergence ERROR on every run.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from gol_tpu.analysis.lockcheck import (
+    FIXTURE_DIR,
+    load_waivers,
+)
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_DIR)
+
+# The rendezvous primitives of this codebase's host plane.
+COLLECTIVES = {
+    "allgather_host_ints",
+    "fetch_global",
+    "sync_global_devices",
+    "process_allgather",
+    "broadcast_one_to_all",
+}
+# Calls that *produce* a rank-divergent value.
+_TAINT_CALLS = {"process_index"}
+_TAINT_ATTRS = {"is_coordinator"}
+# Uniform by construction — never taint, even though they mention jax.
+_UNIFORM_CALLS = {"process_count", "device_count", "local_device_count"}
+
+
+def _package_files() -> List[Tuple[str, str]]:
+    out = []
+    for path in sorted(
+        glob.glob(os.path.join(_PKG_DIR, "**", "*.py"), recursive=True)
+    ):
+        rel = os.path.relpath(path, _PKG_DIR)
+        if rel.startswith(("analysis" + os.sep,)):
+            continue  # the analyzers themselves name collectives in data
+        mod = rel[:-3].replace(os.sep, ".").replace(".__init__", "")
+        out.append((mod, path))
+    return out
+
+
+def _rel(path: str) -> str:
+    try:
+        return os.path.relpath(path, _REPO_ROOT)
+    except ValueError:
+        return path
+
+
+class _FnScan(ast.NodeVisitor):
+    """Per-function scan: collective sites + their divergence state."""
+
+    def __init__(self, summaries: Set[str]) -> None:
+        self.summaries = summaries  # local fn names that issue collectives
+        self.tainted: Set[str] = set()
+        # (lineno, callee name, divergence reason or None)
+        self.sites: List[Tuple[int, str, Optional[str]]] = []
+        self.calls: Set[str] = set()
+        self._div_depth = 0  # inside a rank-tainted branch
+        self._div_after: Optional[str] = None  # past a tainted early return
+
+    # .. taint ..............................................................
+    def _expr_tainted(self, e) -> bool:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return True
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in _TAINT_ATTRS
+            ):
+                return True
+            if isinstance(node, ast.Call):
+                name = _callee_name(node)
+                if name in _TAINT_CALLS:
+                    return True
+        return False
+
+    # .. statements .........................................................
+    def visit_Assign(self, node) -> None:
+        if self._expr_tainted(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.add(t.id)
+        self._scan_calls(node.value)
+
+    def scan_suite(self, stmts) -> None:
+        """Walk one statement list with suite-scoped divergence.
+
+        A rank-tainted If whose arm escapes (return/raise/...) makes
+        only the *rest of this suite* divergent: if the suite itself
+        sits inside e.g. ``if sharding is None:`` where every path
+        returns, code after the enclosing block never runs under
+        divergence and must stay green (write_host_dumps' shape).
+        """
+        saved = self._div_after
+        for st in stmts:
+            self.visit(st)
+            if (
+                self._div_after is None
+                and isinstance(st, ast.If)
+                and self._expr_tainted(st.test)
+                and _branch_escapes(st)
+            ):
+                self._div_after = (
+                    f"follows a rank-conditional early return at line "
+                    f"{st.lineno}"
+                )
+        self._div_after = saved
+
+    def visit_If(self, node) -> None:
+        self._scan_calls(node.test)
+        tainted = self._expr_tainted(node.test)
+        if tainted:
+            self._div_depth += 1
+        self.scan_suite(node.body)
+        self.scan_suite(node.orelse)
+        if tainted:
+            self._div_depth -= 1
+
+    def visit_While(self, node) -> None:
+        self._scan_calls(node.test)
+        tainted = self._expr_tainted(node.test)
+        if tainted:
+            self._div_depth += 1
+        self.scan_suite(node.body)
+        self.scan_suite(node.orelse)
+        if tainted:
+            self._div_depth -= 1
+
+    def visit_For(self, node) -> None:
+        self._scan_calls(node.iter)
+        self.scan_suite(node.body)
+        self.scan_suite(node.orelse)
+
+    def visit_With(self, node) -> None:
+        for item in node.items:
+            self._scan_calls(item.context_expr)
+        self.scan_suite(node.body)
+
+    visit_AsyncWith = visit_With
+    visit_AsyncFor = visit_For
+
+    def visit_Try(self, node) -> None:
+        self.scan_suite(node.body)
+        for h in node.handlers:
+            self.scan_suite(h.body)
+        self.scan_suite(node.orelse)
+        self.scan_suite(node.finalbody)
+
+    def visit_FunctionDef(self, node) -> None:
+        # Nested defs inherit the enclosing divergence state only when
+        # walked explicitly; treat them as part of this function (they
+        # run on the same rank's schedule).
+        self.scan_suite(node.body)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def generic_visit(self, node) -> None:
+        if isinstance(node, ast.expr):
+            self._scan_calls(node)
+            return
+        super().generic_visit(node)
+
+    def visit_Expr(self, node) -> None:
+        self._scan_calls(node.value)
+
+    def visit_Return(self, node) -> None:
+        if node.value is not None:
+            self._scan_calls(node.value)
+
+    # .. collective sites ...................................................
+    def _scan_calls(self, e) -> None:
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node)
+            if name is None:
+                continue
+            self.calls.add(name)
+            if name in COLLECTIVES or name in self.summaries:
+                reason = None
+                if self._div_depth > 0:
+                    reason = "inside a rank-conditional branch"
+                elif self._div_after is not None:
+                    reason = self._div_after
+                self.sites.append((node.lineno, name, reason))
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _branch_escapes(node: ast.If) -> bool:
+    """True when either arm of the If leaves the function."""
+    def arm(stmts) -> bool:
+        return any(
+            isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+            for s in stmts
+        )
+    return arm(node.body) or arm(node.orelse)
+
+
+def _functions(tree: ast.Module):
+    """Module-level functions and class methods.  Nested defs are NOT
+    yielded separately — they are scanned as part of their enclosing
+    function (sharing its divergence state), so yielding them again
+    would double-report every site they contain."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(
+                    item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield item
+
+
+def analyze_files(
+    files: List[Tuple[str, str]], waivers: Dict[str, str]
+) -> Tuple[List[Finding], Set[str]]:
+    """Two fixpoint rounds: first learn which package functions issue
+    collectives transitively, then classify every site."""
+    trees: Dict[str, ast.Module] = {}
+    for mod, path in files:
+        with open(path) as f:
+            trees[mod] = ast.parse(f.read(), filename=path)
+
+    # round 1: transitive may-issue-collective summaries (by basename;
+    # collisions only widen the net, never shrink it)
+    issue: Set[str] = set()
+    calls_of: Dict[str, Set[str]] = {}
+    for mod, tree in trees.items():
+        for fn in _functions(tree):
+            scan = _FnScan(set())
+            scan.scan_suite(fn.body)
+            calls_of.setdefault(fn.name, set()).update(scan.calls)
+            if scan.sites:
+                issue.add(fn.name)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls_of.items():
+            if name not in issue and callees & issue:
+                issue.add(name)
+                changed = True
+
+    # round 2: site classification with summaries active
+    findings: List[Finding] = []
+    used: Set[str] = set()
+    path_of = dict(files)
+    for mod, tree in trees.items():
+        rel = _rel(path_of[mod])
+        for fn in _functions(tree):
+            scan = _FnScan(issue - {fn.name})
+            scan.scan_suite(fn.body)
+            for lineno, name, reason in scan.sites:
+                direct = name in COLLECTIVES
+                kind = "collective" if direct else "collective-caller"
+                if reason is None:
+                    if direct:
+                        findings.append(
+                            Finding(
+                                INFO, "spmd-sites",
+                                f"{kind} {name} at {rel}:{lineno} "
+                                f"(in {fn.name}) — all ranks reach it",
+                            )
+                        )
+                    continue
+                key = f"{os.path.basename(rel)}:{fn.name}"
+                if key in waivers:
+                    used.add(key)
+                    findings.append(
+                        Finding(
+                            INFO, "spmd-divergence",
+                            f"waived: {kind} {name} at {rel}:{lineno} "
+                            f"{reason} — {waivers[key]}",
+                        )
+                    )
+                else:
+                    findings.append(
+                        Finding(
+                            ERROR, "spmd-divergence",
+                            f"{kind} {name} at {rel}:{lineno} (in "
+                            f"{fn.name}) {reason}: ranks that skip the "
+                            f"branch never reach the rendezvous — "
+                            f"SPMD divergence deadlock",
+                        )
+                    )
+    return findings, used
+
+
+def run_spmd_teeth() -> CheckResult:
+    path = os.path.join(FIXTURE_DIR, "broken_rank_gated_collective.py")
+    if not os.path.exists(path):
+        return CheckResult.skipped(
+            "teeth-rank-gated", "fixture dir not present"
+        )
+    findings, _ = analyze_files([("fixture", path)], {})
+    errs = [
+        f for f in findings
+        if f.severity == ERROR and f.check == "spmd-divergence"
+    ]
+    if errs:
+        return CheckResult.from_findings(
+            "teeth-rank-gated",
+            [
+                Finding(
+                    INFO, "teeth-rank-gated",
+                    f"fixture correctly flagged: {errs[0].message}",
+                )
+            ],
+        )
+    return CheckResult.from_findings(
+        "teeth-rank-gated",
+        [
+            Finding(
+                ERROR, "teeth-rank-gated",
+                "broken_rank_gated_collective.py produced NO divergence "
+                "error — the SPMD check lost its witness",
+            )
+        ],
+    )
+
+
+def run_spmd_checks(
+    files: Optional[List[Tuple[str, str]]] = None,
+    waiver_path: Optional[str] = None,
+) -> List[EngineReport]:
+    try:
+        waivers = load_waivers("spmdcheck", waiver_path)
+        waiver_err = None
+    except ValueError as e:
+        waivers, waiver_err = {}, str(e)
+    findings, used = analyze_files(
+        files if files is not None else _package_files(), waivers
+    )
+    wfindings: List[Finding] = []
+    if waiver_err is not None:
+        wfindings.append(Finding(ERROR, "waivers", waiver_err))
+    for key, why in sorted(waivers.items()):
+        if key in used:
+            wfindings.append(
+                Finding(INFO, "waivers", f"in use: {key} — {why}")
+            )
+        else:
+            wfindings.append(
+                Finding(
+                    ERROR, "waivers",
+                    f"stale waiver {key!r}: no current finding matches "
+                    f"it — remove the entry or restore the pattern it "
+                    f"documents",
+                )
+            )
+    return [
+        EngineReport(
+            config_name="spmd/collectives",
+            checks=[
+                CheckResult.from_findings(
+                    "spmd-sites",
+                    [f for f in findings if f.check == "spmd-sites"],
+                ),
+                CheckResult.from_findings(
+                    "spmd-divergence",
+                    [f for f in findings if f.check == "spmd-divergence"],
+                ),
+            ],
+        ),
+        EngineReport(
+            config_name="spmd/teeth", checks=[run_spmd_teeth()]
+        ),
+        EngineReport(
+            config_name="spmd/waivers",
+            checks=[CheckResult.from_findings("waivers", wfindings)],
+        ),
+    ]

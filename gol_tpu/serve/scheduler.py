@@ -215,7 +215,11 @@ class ServeScheduler:
         self.keep_journal_segments = keep_journal_segments
         self.compact_every = compact_every
 
-        self._lock = threading.RLock()
+        from gol_tpu.analysis import lockwatch
+
+        self._lock = lockwatch.maybe_wrap(
+            "ServeScheduler._lock", threading.RLock()
+        )
         self._groups: Dict[tuple, _BucketGroup] = {}
         self._requests: Dict[str, RequestState] = {}
         self._next_ordinal = 0
@@ -286,7 +290,11 @@ class ServeScheduler:
         self._journal = journal_mod.Journal(
             os.path.join(state_dir, "journal.jsonl")
         )
-        self._replay_journal()
+        # Under the lock: replay mutates _requests/_groups, and a
+        # supervisor may point the HTTP listener at the scheduler
+        # before replay finishes (lockcheck: guarded-fields).
+        with self._lock:
+            self._replay_journal()
 
     # -- admission -----------------------------------------------------------
     def submit(self, obj: dict) -> RequestState:
@@ -372,7 +380,28 @@ class ServeScheduler:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
+
+    def peek(self, request_id: str) -> Optional[dict]:
+        """Locked point-in-time snapshot of one request's lifecycle.
+
+        The HTTP handlers read through this, never the live
+        :class:`RequestState`: field-at-a-time reads racing the drive
+        loop could observe a terminal status before its result payload
+        lands (lockcheck: guarded-fields, docs/ANALYSIS.md), answering
+        202 for a request that is already finished.
+        """
+        with self._lock:
+            state = self._requests.get(request_id)
+            if state is None:
+                return None
+            return {
+                "id": state.request.id,
+                "status": state.status,
+                "generation": state.generation,
+                "result": state.result,
+            }
 
     @property
     def ready(self) -> bool:
@@ -717,7 +746,6 @@ class ServeScheduler:
         return d is not None and (now - state.submitted_t) > d
 
     def _cancel(self, state: RequestState, grp: _BucketGroup) -> None:
-        state.status = "expired"
         payload = {
             "id": state.request.id,
             "status": "expired",
@@ -726,7 +754,10 @@ class ServeScheduler:
             "generation": state.generation,
             "generations": state.request.generations,
         }
+        # result before status: a terminal status must never be
+        # observable without its payload (same ordering as _finish).
         state.result = payload
+        state.status = "expired"
         self._write_result(payload)
         self._journal_write(
             journal_mod.record(

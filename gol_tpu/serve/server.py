@@ -152,13 +152,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return
         if wait:
             state.done.wait()
-        if state.result is not None:
-            self._json(200, state.result)
+        # Read back through the scheduler's locked snapshot, never the
+        # live RequestState: handler threads racing the drive loop can
+        # otherwise observe a terminal status before its result payload
+        # (lockcheck: guarded-fields, docs/ANALYSIS.md).
+        snap = self.scheduler.peek(state.request.id)
+        if snap is not None and snap["result"] is not None:
+            self._json(200, snap["result"])
         else:
             ticket = {
                 "id": state.request.id,
-                "status": state.status,
-                "generation": state.generation,
+                "status": snap["status"] if snap else "queued",
+                "generation": snap["generation"] if snap else 0,
             }
             if "id" not in body:
                 # Exactly-once admission keys on the id.  This one was
@@ -173,18 +178,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self._json(202, ticket)
 
     def _result(self, request_id: str) -> None:
-        state = self.scheduler.get_result(request_id)
-        if state is None:
+        snap = self.scheduler.peek(request_id)
+        if snap is None:
             self._json(404, {"error": f"unknown request {request_id!r}"})
-        elif state.result is not None:
-            self._json(200, state.result)
+        elif snap["result"] is not None:
+            self._json(200, snap["result"])
         else:
             self._json(
                 202,
                 {
                     "id": request_id,
-                    "status": state.status,
-                    "generation": state.generation,
+                    "status": snap["status"],
+                    "generation": snap["generation"],
                 },
             )
 

@@ -79,7 +79,13 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        from gol_tpu.analysis import lockwatch
+
+        # Identity unless GOL_LOCKWATCH=1 (the runtime lock-order
+        # recorder; see gol_tpu/analysis/lockwatch.py).
+        self._lock = lockwatch.maybe_wrap(
+            "MetricsRegistry._lock", threading.Lock()
+        )
         self.generation = 0
         self.chunks_total = 0
         self.generations_total = 0

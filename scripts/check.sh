@@ -44,26 +44,30 @@
 #                       at the chunk boundary, regrows on restore,
 #                       hedges a straggler — every request byte-equal,
 #                       no restart, v11 verdicts on the stream)
-#  14. tier-1 tests    (the exact ROADMAP.md command)
+#  14. lockcheck       (host-plane concurrency: lock-order graph,
+#                       guarded-field discipline, SPMD collective
+#                       consistency — AST-only, no jax backend;
+#                       docs/ANALYSIS.md "The concurrency matrix")
+#  15. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/12] lint =="
+echo "== [1/15] lint =="
 bash scripts/lint.sh
 
-echo "== [2/12] static verifier (gol_tpu.analysis) =="
+echo "== [2/15] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/12] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/15] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/12] stats smoke (in-graph simulation statistics) =="
+echo "== [4/15] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -72,34 +76,37 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/12] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/15] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/12] batch smoke (docs/BATCHING.md) =="
+echo "== [6/15] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/12] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/15] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/12] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/15] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/12] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+echo "== [9/15] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
 JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
-echo "== [10/12] halo smoke (pipelined depth-k exchange, PR 9) =="
+echo "== [10/15] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/14] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+echo "== [11/15] chaos smoke (docs/RESILIENCE.md, fault plane) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
-echo "== [12/14] serve smoke (docs/SERVING.md, serving tier) =="
+echo "== [12/15] serve smoke (docs/SERVING.md, serving tier) =="
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
-echo "== [13/14] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
+echo "== [13/15] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
 python scripts/elastic_smoke.py
 
-echo "== [14/14] tier-1 tests =="
+echo "== [14/15] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
+python -m gol_tpu.analysis --concurrency
+
+echo "== [15/15] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -1,0 +1,38 @@
+"""BROKEN fixture (never imported — parsed only, by lockcheck teeth).
+
+A textbook AB/BA lock inversion: the poller takes journal_lock then
+stats_lock, the reporter takes stats_lock then journal_lock.  Each
+order is individually fine; together they deadlock the moment both
+threads hold their first lock.  lockcheck MUST report a lock-order
+cycle here — if it stops doing so, the deadlock detector has lost its
+witness (see gol_tpu/analysis/lockcheck.py TEETH).
+"""
+
+import threading
+
+journal_lock = threading.Lock()
+stats_lock = threading.Lock()
+
+_journal = []
+_stats = {"polls": 0}
+
+
+def poller() -> None:
+    while True:
+        with journal_lock:
+            _journal.append("poll")
+            with stats_lock:
+                _stats["polls"] += 1
+
+
+def reporter() -> None:
+    while True:
+        with stats_lock:
+            n = _stats["polls"]
+            with journal_lock:
+                _journal.append(f"report:{n}")
+
+
+def start() -> None:
+    threading.Thread(target=poller, name="poller", daemon=True).start()
+    threading.Thread(target=reporter, name="reporter", daemon=True).start()

@@ -1,0 +1,29 @@
+"""BROKEN fixture (never imported — parsed only, by spmdcheck teeth).
+
+The classic SPMD divergence deadlock: a collective gated on
+``jax.process_index()``.  Rank 0 enters the allgather and waits for
+peers that already skipped the branch.  spmdcheck MUST flag both the
+branch-gated site and the one shadowed by a rank-conditional early
+return — if either goes green, the divergence check lost its witness.
+"""
+
+import jax
+
+from gol_tpu.parallel import multihost
+
+
+def save_manifest(generation: int) -> list:
+    gathered = []
+    if jax.process_index() == 0:
+        # BUG: only rank 0 reaches the rendezvous.
+        gathered = multihost.allgather_host_ints(generation)
+    return gathered
+
+
+def publish(generation: int) -> int:
+    me = jax.process_index()
+    if me != 0:
+        return 0
+    # BUG: every rank but 0 returned above; this barrier never forms.
+    vals = multihost.allgather_host_ints(generation)
+    return max(vals)

@@ -1,0 +1,45 @@
+"""BROKEN fixture (never imported — parsed only, by lockcheck teeth).
+
+The pre-PR-16 serve-tier shape: a drive loop mutates request state
+under the worker's lock, while an HTTP-handler-like reader thread
+reads the same fields with no lock at all.  ``Worker.status`` is
+shared by two thread entry points and mutated, so every lock-free
+access is a guarded-field violation lockcheck MUST flag.
+"""
+
+import threading
+
+
+class Worker:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.status = "queued"
+        self.result = None
+
+    def run_once(self) -> None:
+        with self._lock:
+            self.status = "running"
+            self.result = {"ok": True}
+            self.status = "done"
+
+
+def drive(worker: Worker) -> None:
+    while True:
+        worker.run_once()
+
+
+def handler(worker: Worker) -> dict:
+    # BUG: terminal status can be observed before result is published,
+    # and neither read holds worker._lock.
+    if worker.status == "done":
+        return worker.result
+    return {"status": worker.status}
+
+
+def start(worker: Worker) -> None:
+    threading.Thread(
+        target=drive, args=(worker,), name="drive", daemon=True
+    ).start()
+    threading.Thread(
+        target=handler, args=(worker,), name="http", daemon=True
+    ).start()

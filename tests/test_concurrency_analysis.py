@@ -1,0 +1,389 @@
+"""gol_tpu.analysis lockcheck + spmdcheck: the host-plane passes.
+
+Same doctrine as test_analysis.py: a verifier that has never caught a
+bug is a verifier that does not work.  Each committed broken fixture
+must fail its pass (the teeth), the clean tree must pass with zero
+unwaivered findings, and the waiver ledger must round-trip — entries in
+use show as INFO, stale entries and malformed files are themselves
+errors.  Pure-AST: nothing here imports jax or evolves a board.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from gol_tpu.analysis import hostwalk, lockcheck, spmdcheck
+from gol_tpu.analysis.report import ERROR, INFO, AnalysisReport
+
+FIXTURES = lockcheck.FIXTURE_DIR
+
+
+def _lock_errors(report, check):
+    return [
+        f
+        for c in report.checks
+        if c.check == check
+        for f in c.findings
+        if f.severity == ERROR
+    ]
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+# -- teeth: every fixture must fail its pass ---------------------------------
+
+
+def test_fixture_lock_inversion_flagged():
+    cell = lockcheck.LockCellConfig(
+        name="fixture/inversion",
+        modules=[
+            (
+                "broken_lock_inversion",
+                os.path.join(FIXTURES, "broken_lock_inversion.py"),
+            )
+        ],
+        roots=[],
+        guarded={},
+    )
+    rep, _ = lockcheck.analyze_cell(cell, {})
+    errs = _lock_errors(rep, "lock-order")
+    assert errs, "inversion fixture produced no lock-order error"
+    assert any("cycle" in f.message for f in errs)
+
+
+def test_fixture_unguarded_write_flagged():
+    cell = lockcheck.LockCellConfig(
+        name="fixture/unguarded",
+        modules=[
+            (
+                "broken_unguarded_write",
+                os.path.join(FIXTURES, "broken_unguarded_write.py"),
+            )
+        ],
+        roots=[],
+        guarded={"Worker": "Worker._lock"},
+    )
+    rep, _ = lockcheck.analyze_cell(cell, {})
+    errs = _lock_errors(rep, "guarded-fields")
+    assert errs, "unguarded fixture produced no guarded-field error"
+    assert any("without" in f.message for f in errs)
+
+
+def test_fixture_rank_gated_collective_flagged():
+    path = os.path.join(FIXTURES, "broken_rank_gated_collective.py")
+    findings, _ = spmdcheck.analyze_files([("fixture", path)], {})
+    errs = [
+        f
+        for f in findings
+        if f.severity == ERROR and f.check == "spmd-divergence"
+    ]
+    # both shapes must trip: collective inside the rank branch AND
+    # collective after a rank-conditional early return
+    assert len(errs) >= 2
+    assert any("inside a rank-conditional branch" in f.message for f in errs)
+    assert any("early return" in f.message for f in errs)
+
+
+def test_teeth_reports_pass_with_committed_fixtures():
+    teeth = lockcheck.run_lock_teeth()
+    assert all(c.status == "PASS" for c in teeth.checks), [
+        (c.check, c.status) for c in teeth.checks
+    ]
+    spmd_teeth = spmdcheck.run_spmd_teeth()
+    assert spmd_teeth.status == "PASS"
+
+
+# -- clean tree --------------------------------------------------------------
+
+
+def test_head_lockcheck_green():
+    """The committed tree carries zero unwaivered lock findings."""
+    rep = AnalysisReport()
+    rep.engines.extend(lockcheck.run_lock_checks())
+    assert rep.exit_code == 0, rep.render_text()
+
+
+def test_head_spmdcheck_green():
+    rep = AnalysisReport()
+    rep.engines.extend(spmdcheck.run_spmd_checks())
+    assert rep.exit_code == 0, rep.render_text()
+
+
+def test_head_inventory_names_the_serve_locks():
+    reports = lockcheck.run_lock_checks()
+    serve = next(r for r in reports if r.config_name == "lock/serve")
+    inv = [
+        f.message
+        for c in serve.checks
+        if c.check == "inventory"
+        for f in c.findings
+    ]
+    assert any("ServeScheduler._lock" in m for m in inv)
+    assert any("MetricsRegistry._lock" in m for m in inv)
+    assert any("[http]" in m for m in inv), "http thread root missing"
+
+
+def test_head_lock_order_edges_are_acyclic_and_scheduler_rooted():
+    reports = lockcheck.run_lock_checks()
+    serve = next(r for r in reports if r.config_name == "lock/serve")
+    edges = [
+        f.message
+        for c in serve.checks
+        if c.check == "lock-order"
+        for f in c.findings
+        if f.severity == INFO and f.message.startswith("edge ")
+    ]
+    assert any(
+        "ServeScheduler._lock -> MetricsRegistry._lock" in m for m in edges
+    ), edges
+
+
+def test_cli_concurrency_fast_path():
+    from gol_tpu.analysis.__main__ import main as verify_main
+
+    assert verify_main(["--concurrency"]) == 0
+    assert verify_main(["--concurrency", "--list"]) == 0
+
+
+# -- waiver ledger -----------------------------------------------------------
+
+
+def _waiver_file(tmp_path, data):
+    p = tmp_path / "waivers.json"
+    p.write_text(json.dumps(data))
+    return str(p)
+
+
+def test_waiver_round_trip(tmp_path):
+    """A waived guarded-field finding demotes to INFO and reads as
+    in-use; removing the pattern would make the same entry stale."""
+    cell = lockcheck.LockCellConfig(
+        name="fixture/unguarded",
+        modules=[
+            (
+                "broken_unguarded_write",
+                os.path.join(FIXTURES, "broken_unguarded_write.py"),
+            )
+        ],
+        roots=[],
+        guarded={"Worker": "Worker._lock"},
+    )
+    plain_rep, _ = lockcheck.analyze_cell(cell, {})
+    keys = {
+        f.message.split()[0]
+        for f in _lock_errors(plain_rep, "guarded-fields")
+    }
+    assert keys
+    waivers = {k: "test: tolerated torn read" for k in keys}
+    rep, used = lockcheck.analyze_cell(cell, waivers)
+    assert not _lock_errors(rep, "guarded-fields")
+    assert used == set(waivers)
+    waived = [
+        f
+        for c in rep.checks
+        if c.check == "guarded-fields"
+        for f in c.findings
+        if f.severity == INFO and f.message.startswith("waived:")
+    ]
+    assert len(waived) == sum(
+        1 for _ in _lock_errors(plain_rep, "guarded-fields")
+    )
+
+
+def test_stale_waiver_is_an_error(tmp_path):
+    path = _waiver_file(
+        tmp_path,
+        {
+            "version": 1,
+            "lockcheck": [
+                {"key": "Ghost.field", "why": "pattern no longer exists"}
+            ],
+            "spmdcheck": [],
+        },
+    )
+    reports = lockcheck.run_lock_checks(matrix=[], waiver_path=path)
+    wcell = next(r for r in reports if r.config_name == "lock/waivers")
+    errs = _lock_errors(wcell, "waivers")
+    assert errs and "stale waiver" in errs[0].message
+
+
+def test_unknown_waiver_section_rejected(tmp_path):
+    path = _waiver_file(
+        tmp_path, {"version": 1, "lockcheck": [], "typocheck": []}
+    )
+    with pytest.raises(ValueError, match="unknown sections"):
+        lockcheck.load_waivers("lockcheck", path)
+    # the runner turns the same rejection into a report-level error
+    reports = lockcheck.run_lock_checks(matrix=[], waiver_path=path)
+    wcell = next(r for r in reports if r.config_name == "lock/waivers")
+    assert _lock_errors(wcell, "waivers")
+
+
+def test_malformed_waiver_entry_rejected(tmp_path):
+    for bad in (
+        {"key": "A.b"},  # missing why
+        {"key": "A.b", "why": "   "},  # blank why
+        {"key": "A.b", "why": "ok", "extra": 1},  # unknown field
+    ):
+        path = _waiver_file(
+            tmp_path, {"version": 1, "lockcheck": [bad], "spmdcheck": []}
+        )
+        with pytest.raises(ValueError, match="waiver entries"):
+            lockcheck.load_waivers("lockcheck", path)
+
+
+def test_committed_waiver_file_loads_and_is_fully_in_use():
+    for section in ("lockcheck", "spmdcheck"):
+        assert lockcheck.load_waivers(section) is not None
+    reports = lockcheck.run_lock_checks() + spmdcheck.run_spmd_checks()
+    for rep in reports:
+        if not rep.config_name.endswith("/waivers"):
+            continue
+        for c in rep.checks:
+            assert c.status == "PASS", rep.config_name
+            for f in c.findings:
+                assert f.message.startswith("in use:"), f.message
+
+
+# -- analyzer semantics on synthetic programs --------------------------------
+
+
+def test_self_deadlock_on_plain_lock_reacquire(tmp_path):
+    path = _write(
+        tmp_path,
+        "reacquire.py",
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    self.n += 1
+        """,
+    )
+    cell = lockcheck.LockCellConfig(
+        name="fixture/reacquire",
+        modules=[("reacquire", path)],
+        roots=[("main", "Box.outer")],
+        guarded={},
+    )
+    rep, _ = lockcheck.analyze_cell(cell, {})
+    errs = _lock_errors(rep, "lock-order")
+    assert errs and "re-acquir" in errs[0].message.lower()
+
+
+def test_rlock_reentrancy_is_clean(tmp_path):
+    path = _write(
+        tmp_path,
+        "reentrant.py",
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    self.n += 1
+        """,
+    )
+    cell = lockcheck.LockCellConfig(
+        name="fixture/reentrant",
+        modules=[("reentrant", path)],
+        roots=[("main", "Box.outer")],
+        guarded={},
+    )
+    rep, _ = lockcheck.analyze_cell(cell, {})
+    assert not _lock_errors(rep, "lock-order")
+
+
+def test_spmd_divergence_after_return_is_suite_scoped(tmp_path):
+    """A rank-gated early return nested inside a block whose every path
+    returns must not poison code after the enclosing block (the
+    write_host_dumps shape); the same return at function level must."""
+    path = _write(
+        tmp_path,
+        "scoped.py",
+        """
+        import jax
+        from gol_tpu.parallel import multihost
+
+        def nested_escape_is_clean(sharding):
+            if sharding is None:
+                if jax.process_index() == 0:
+                    return 1
+                return 0
+            return multihost.allgather_host_ints(3)
+
+        def toplevel_escape_diverges():
+            if jax.process_index() != 0:
+                return 0
+            return multihost.allgather_host_ints(3)
+        """,
+    )
+    findings, _ = spmdcheck.analyze_files([("scoped", path)], {})
+    errs = [f for f in findings if f.severity == ERROR]
+    assert len(errs) == 1, [f.message for f in errs]
+    assert "toplevel_escape_diverges" in errs[0].message
+
+
+def test_spmd_uniform_gate_is_clean(tmp_path):
+    """process_count() is rank-uniform — branching on it is fine."""
+    path = _write(
+        tmp_path,
+        "uniform.py",
+        """
+        import jax
+        from gol_tpu.parallel import multihost
+
+        def gather_when_multiprocess():
+            if jax.process_count() > 1:
+                return multihost.allgather_host_ints(3)
+            return [3]
+        """,
+    )
+    findings, _ = spmdcheck.analyze_files([("uniform", path)], {})
+    assert not [f for f in findings if f.severity == ERROR]
+
+
+def test_hostwalk_sees_through_lockwatch_wrap(tmp_path):
+    """Wrapping a lock for runtime recording must not hide it from the
+    static inventory (or every guarded-field check would go blind)."""
+    path = _write(
+        tmp_path,
+        "wrapped.py",
+        """
+        import threading
+        from gol_tpu.analysis import lockwatch
+
+        class Box:
+            def __init__(self):
+                self._lock = lockwatch.maybe_wrap(
+                    "Box._lock", threading.RLock()
+                )
+        """,
+    )
+    prog = hostwalk.Program.load([("wrapped", path)])
+    assert prog.classes["Box"].attr_kinds.get("_lock") == "rlock"

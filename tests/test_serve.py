@@ -695,3 +695,97 @@ def test_midflight_join_does_not_rewind_residents(tmp_path):
         )
     finally:
         sched.close()
+
+
+# -- lockcheck regressions (docs/ANALYSIS.md "Concurrency matrix") ------------
+
+
+def test_terminal_status_never_stamped_before_result(tmp_path):
+    """Red/green pin on the _finish/_cancel write order.  The HTTP
+    handlers snapshot request state via peek(); lockcheck found the old
+    _cancel stamped ``status="expired"`` before building its payload,
+    so a racing reader could observe a terminal status with
+    ``result=None`` and answer 202 forever.  A sentinel subclass
+    asserts the ordering at the exact write sites, on both terminal
+    paths (deadline expiry and normal completion)."""
+    import gol_tpu.serve.scheduler as sched_mod
+
+    torn = []
+
+    class OrderedState(sched_mod.RequestState):
+        def __setattr__(self, name, value):
+            if (
+                name == "status"
+                and value in ("done", "expired")
+                and getattr(self, "result", None) is None
+            ):
+                torn.append((self.request.id, value))
+            super().__setattr__(name, value)
+
+    real = sched_mod.RequestState
+    sched_mod.RequestState = OrderedState
+    try:
+        sched = ServeScheduler(
+            str(tmp_path / "state"), quantum=32, slots=2, chunk=2
+        )
+        try:
+            sched.submit(
+                {"id": "doomed", "pattern": 4, "size": 32,
+                 "generations": 500, "deadline_s": 0.0}
+            )
+            sched.submit(
+                {"id": "fine", "pattern": 4, "size": 32,
+                 "generations": 4}
+            )
+            sched.run_until_drained()
+            assert sched.get_result("doomed").status == "expired"
+            assert sched.get_result("fine").status == "done"
+        finally:
+            sched.close()
+    finally:
+        sched_mod.RequestState = real
+    assert torn == []
+
+
+def test_peek_takes_the_scheduler_lock(tmp_path):
+    """peek() is the locked snapshot the handlers read through; a
+    reader blocked behind a held scheduler lock is exactly the
+    consistency the old unlocked field reads never had."""
+    import threading
+
+    sched = ServeScheduler(str(tmp_path / "state"), quantum=32)
+    try:
+        sched.submit(
+            {"id": "r", "pattern": 4, "size": 32, "generations": 4}
+        )
+        assert sched.peek("missing") is None
+        snap = sched.peek("r")
+        assert snap["status"] == "queued" and snap["result"] is None
+
+        acquired, released = threading.Event(), threading.Event()
+
+        def hold():
+            with sched._lock:
+                acquired.set()
+                released.wait(5.0)
+
+        holder = threading.Thread(target=hold)
+        holder.start()
+        assert acquired.wait(5.0)
+        got = []
+        reader = threading.Thread(
+            target=lambda: got.append(sched.peek("r"))
+        )
+        reader.start()
+        reader.join(0.3)
+        assert reader.is_alive(), "peek returned without the lock"
+        released.set()
+        reader.join(5.0)
+        holder.join(5.0)
+        assert got and got[0]["id"] == "r"
+
+        sched.run_until_drained()
+        snap = sched.peek("r")
+        assert snap["status"] == "done" and snap["result"] is not None
+    finally:
+        sched.close()

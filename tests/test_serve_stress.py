@@ -1,0 +1,167 @@
+"""Concurrency stress for the serving tier (docs/ANALYSIS.md).
+
+N client threads hammer /simulate + /result + /metrics over real HTTP
+while the main thread drives the batch loop and deadline requests
+expire mid-flight.  The assertions are the serving tier's concurrency
+contract: every admitted id reaches exactly one terminal journal state
+(no duplicate completes, no resurrection), double-submissions admit
+once, and a terminal HTTP answer always carries its payload.
+
+Runs with the lockwatch recorder on (GOL_LOCKWATCH=1): afterwards the
+dynamically observed lock-acquisition edges must be acyclic AND a
+subset of the static lock-order graph lockcheck proved — the runtime
+witness that the AST model covers what the threads actually did.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import pathlib
+import threading
+import time
+import urllib.request
+
+import jax
+
+from gol_tpu.analysis import hostwalk, lockcheck, lockwatch
+from gol_tpu.serve import journal as journal_mod
+from gol_tpu.serve.client import Backpressure, SimClient
+from gol_tpu.serve.scheduler import ServeScheduler
+from gol_tpu.serve.server import ServeServer
+from gol_tpu.telemetry.metrics import MetricsRegistry
+
+jax.config.update("jax_platforms", "cpu")
+
+N_CLIENTS = 5
+REQS_PER_CLIENT = 4  # odd ordinals carry an already-lapsed deadline
+
+
+def _client_ids(i: int):
+    return [f"c{i}-r{j}" for j in range(REQS_PER_CLIENT)]
+
+
+def _hammer(base_url: str, i: int, out: dict, errors: list) -> None:
+    c = SimClient(base_url, timeout=30.0)
+    try:
+        for j, rid in enumerate(_client_ids(i)):
+            req = {
+                "id": rid, "pattern": 4, "size": 32, "generations": 6,
+            }
+            if j % 2 == 1:
+                req["deadline_s"] = 0.0
+                req["generations"] = 500
+            for attempt in range(50):
+                try:
+                    c.submit(req)
+                    break
+                except Backpressure:
+                    time.sleep(0.05)
+            else:
+                raise RuntimeError(f"{rid}: backpressure never cleared")
+            # double-submit the same id: admission must stay
+            # exactly-once even while other threads race the queue
+            c.submit(req)
+            with urllib.request.urlopen(
+                base_url + "/metrics", timeout=30.0
+            ) as r:
+                assert r.status == 200 and b"gol_serve" in r.read()
+        for rid in _client_ids(i):
+            out[rid] = c.wait_for(rid, timeout_s=120.0, poll_s=0.01)
+    except BaseException as e:  # surfaced by the main thread
+        errors.append((i, repr(e)))
+
+
+def test_stress_exactly_once_terminal_and_lock_witness(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(lockwatch.ENV_VAR, "1")
+    lockwatch.reset()
+
+    state_dir = tmp_path / "state"
+    registry = MetricsRegistry()
+    sched = ServeScheduler(
+        str(state_dir), quantum=32, slots=4, chunk=2, queue_depth=64,
+        telemetry_dir=str(tmp_path / "tm"), run_id="stress",
+        registry=registry,
+    )
+    srv = ServeServer(sched, 0, registry=registry)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    results: dict = {}
+    errors: list = []
+    clients = [
+        threading.Thread(target=_hammer, args=(base, i, results, errors))
+        for i in range(N_CLIENTS)
+    ]
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            if not sched.run_once():
+                time.sleep(0.002)
+
+    driver = threading.Thread(target=drive)
+    try:
+        driver.start()
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join(timeout=180.0)
+            assert not t.is_alive(), "client thread hung"
+        stop.set()
+        driver.join(timeout=60.0)
+        assert not driver.is_alive()
+        sched.drain()
+        sched.run_until_drained()
+    finally:
+        stop.set()
+        srv.close()
+        sched.close()
+
+    assert errors == []
+
+    # every request reached a terminal payload, deadlines really fired
+    all_ids = [rid for i in range(N_CLIENTS) for rid in _client_ids(i)]
+    assert sorted(results) == sorted(all_ids)
+    for rid, payload in results.items():
+        assert payload["status"] in ("done", "expired"), (rid, payload)
+        assert payload["id"] == rid
+    expired = [r for r in results.values() if r["status"] == "expired"]
+    done = [r for r in results.values() if r["status"] == "done"]
+    assert len(expired) == N_CLIENTS * (REQS_PER_CLIENT // 2)
+    assert len(done) == N_CLIENTS * (REQS_PER_CLIENT - REQS_PER_CLIENT // 2)
+
+    # journal: exactly one admit and exactly one terminal per id
+    entries, torn = journal_mod.replay(str(state_dir / "journal.jsonl"))
+    assert torn == 0
+    assert sorted(entries) == sorted(all_ids)
+    for rid, entry in entries.items():
+        assert entry["status"] in ("completed", "cancelled"), (rid, entry)
+    counts: dict = collections.defaultdict(collections.Counter)
+    for seg in sorted(pathlib.Path(state_dir).glob("journal*.jsonl")):
+        for ln in open(seg):
+            rec = json.loads(ln)
+            counts[rec["id"]][rec["rec"]] += 1
+    for rid in all_ids:
+        assert counts[rid]["admit"] == 1, (rid, counts[rid])
+        terminal = counts[rid]["complete"] + counts[rid]["cancel"]
+        assert terminal == 1, (rid, counts[rid])
+
+    # the registry saw the run and still renders
+    text = registry.render()
+    assert "gol_serve" in text
+
+    # lockwatch witness: the dynamic acquisition graph is acyclic and
+    # inside the static lock-order graph lockcheck proved
+    assert lockwatch.acquire_counts().get("ServeScheduler._lock", 0) > 0
+    assert lockwatch.find_cycle() is None
+    serve_cell = next(
+        c for c in lockcheck.default_lock_matrix()
+        if c.name == "lock/serve"
+    )
+    prog = hostwalk.Program.load(serve_cell.modules)
+    walker = lockcheck._CellWalker(prog, serve_cell)
+    walker.run()
+    unexpected = lockwatch.check(set(walker.edges))
+    assert unexpected == set(), unexpected
