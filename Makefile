@@ -2,12 +2,13 @@
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
 # smoke + halo smoke + chaos smoke + serve smoke + elastic smoke +
-# lockcheck + tier-1 tests (see scripts/check.sh).
+# lockcheck + trace smoke + tier-1 tests (see scripts/check.sh).
 
 .PHONY: lint verify lockcheck test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
-	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke
+	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke \
+	trace-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -126,6 +127,16 @@ serve-smoke:
 # script forces its own 8-device virtual CPU ring.
 elastic-smoke:
 	python scripts/elastic_smoke.py
+
+# Request-tracing smoke (docs/OBSERVABILITY.md "Request tracing &
+# SLOs"): the committed v12 fixture round-trips through `telemetry
+# trace --perfetto` and the export validates against the committed
+# docs/schemas/perfetto_trace.schema.json contract.
+trace-smoke:
+	JAX_PLATFORMS=cpu python -m gol_tpu.telemetry trace \
+	    tests/data/telemetry_v12 --perfetto /tmp/_trace_export.json
+	python scripts/validate_trace_export.py /tmp/_trace_export.json \
+	    docs/schemas/perfetto_trace.schema.json
 
 # Open-loop serving load curve -> SERVE_r{N}.json (CPU: admission /
 # queue dynamics; the TPU headline command is pinned in the note).

@@ -12,12 +12,21 @@ rate, and the p50/p99 end-to-end latency from the server's own
 submission window; its max shows how deep the bounded buffer actually
 ran.
 
+Since schema v12 each per-rate server runs with a telemetry stream
+attached, and the rows carry what the trace plane reconstructs from it:
+the p50/p99 **latency decomposition** (queue / compute / stall /
+interference / hedge, from every committed request's result payload —
+docs/OBSERVABILITY.md "Request tracing & SLOs") and the **SLO
+evaluation** (burn rate per objective, :mod:`gol_tpu.telemetry.slo`),
+so a rate row says not just how fast the tier went but *where the time
+went* and whether the objectives held.
+
 The committed artifact (SERVE_rNN.json at the repo root) carries the
 ledger header so ``python -m gol_tpu.telemetry ledger ingest`` routes it
 (tool=servebench): each row lands as one throughput record (req/s,
-higher-is-better) and one latency record (p99 seconds,
-lower-is-better), so ``ledger check`` gates p99 regressions on TPU
-rounds the same way it gates cell rates.
+higher-is-better), latency records (p99 and queue-wait p99 seconds,
+lower-is-better), and one ``slo`` burn-rate record per objective — so
+``ledger check`` gates the tier on its objectives, not just its rate.
 
 CPU rounds pin the curve SHAPE (admission behavior, queue dynamics);
 the TPU headline row is the note's pinned command.
@@ -54,16 +63,21 @@ def run_curve(
     queue_depth: int,
     chunk: int,
     workdir: str,
+    slo_commit_s: float = 30.0,
 ) -> list:
     from gol_tpu.serve.client import Backpressure, SimClient
     from gol_tpu.serve.scheduler import ServeScheduler
     from gol_tpu.serve.server import ServeServer
+    from gol_tpu.telemetry import slo as slo_mod
+    from gol_tpu.telemetry import trace as trace_mod
 
     rows = []
     for r_i, rate in enumerate(rates):
         state = str(pathlib.Path(workdir) / f"rate{r_i}")
         sched = ServeScheduler(
             state, slots=slots, queue_depth=queue_depth, chunk=chunk,
+            telemetry_dir=str(pathlib.Path(workdir) / f"tel{r_i}"),
+            run_id=f"rate{r_i}",
         )
         srv = ServeServer(sched, 0)
         stop = threading.Event()
@@ -133,6 +147,25 @@ def run_curve(
         lats = sorted(
             sched.get_result(rid).result["latency_s"] for rid in accepted
         )
+        # The decomposition rides every result payload (same numbers
+        # the span tree reconstructs — one source of truth), so the row
+        # says where each rate's latency went, and the SLO engine turns
+        # the set into burn rates the ledger gates on.
+        decomps = [
+            sched.get_result(rid).result["decomposition"]
+            for rid in accepted
+        ]
+        slos = [
+            slo_mod.SLO(
+                name="commit_p99", metric="commit_latency_s",
+                target=slo_commit_s, budget=0.01,
+            ),
+            slo_mod.SLO(
+                name="queue_frac_p50", metric="queue_fraction",
+                target=0.5, budget=0.05, percentile=0.50,
+            ),
+        ]
+        slo_rows = slo_mod.evaluate(slos, decomps)
         rows.append(
             {
                 "offered_rps": rate,
@@ -145,14 +178,19 @@ def run_curve(
                 "p50_s": _percentile(lats, 0.50),
                 "p99_s": _percentile(lats, 0.99),
                 "max_queue_depth": max_queue,
+                "decomposition": trace_mod.decomposition_percentiles(
+                    decomps
+                ),
+                "slo": slo_rows,
             }
         )
+        burn = max((s["burn_rate"] for s in slo_rows), default=0.0)
         print(
             f"  offered {rate:>6.1f}/s  completed {len(accepted):>3} "
             f"rejected {rejected:>3}  achieved "
             f"{rows[-1]['achieved_rps']:.1f}/s  "
             f"p50 {rows[-1]['p50_s']:.3f}s p99 {rows[-1]['p99_s']:.3f}s "
-            f"maxq {max_queue}"
+            f"maxq {max_queue}  worst-burn {burn:.2f}"
         )
     return rows
 
@@ -171,6 +209,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--queue-depth", type=int, default=8)
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--round", type=int, default=1)
+    ap.add_argument(
+        "--slo-commit-s", type=float, default=30.0, metavar="SECONDS",
+        help="commit-latency SLO target evaluated per row "
+        "(p99 over the trace decompositions, 1%% error budget)",
+    )
     ap.add_argument("--out", default=None)
     ns = ap.parse_args(argv)
 
@@ -183,6 +226,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rows = run_curve(
         rates, ns.requests, ns.size, ns.generations, ns.slots,
         ns.queue_depth, ns.chunk, workdir,
+        slo_commit_s=ns.slo_commit_s,
     )
     payload = dict(
         header=ledger_mod.artifact_header("servebench"),
@@ -191,8 +235,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "Each row: N small worlds offered at a fixed rate to a real "
             "HTTP server (ephemeral port, journal on tmpfs); completed "
             "vs 429-rejected counts, achieved req/s over the full "
-            "drain, and p50/p99 end-to-end latency from the server's "
-            "latency_s stamps. CPU rounds pin the curve shape "
+            "drain, p50/p99 end-to-end latency from the server's "
+            "latency_s stamps, the p50/p99 latency decomposition "
+            "(queue/compute/stall/interference/hedge) from the v12 "
+            "trace plane, and per-objective SLO burn rates. "
+            "CPU rounds pin the curve shape "
             "(admission + queue dynamics); the TPU headline is: "
             "python benchmarks/servebench.py --size 256 "
             "--generations 64 --rates 16,64,256 --requests 96 "

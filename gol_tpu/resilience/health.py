@@ -60,6 +60,18 @@ class Verdict:
             out["baseline_s"] = round(self.baseline_s, 6)
         return out
 
+    def to_span_attrs(self) -> dict:
+        """The same payload reshaped for a v12 trace span's ``attrs``
+        block (gol_tpu/telemetry/trace.py): the span's ``name`` already
+        says what kind of verdict it is, and the chunk span it parents
+        to already carries ``wall_s`` — so the key becomes ``kind`` and
+        the wall is dropped.  One source of truth with :meth:`to_event`,
+        two stream shapes."""
+        out = self.to_event()
+        out["kind"] = out.pop("verdict")
+        out.pop("wall_s", None)
+        return out
+
 
 class HealthMonitor:
     """Chunk-boundary health sampling over ``num_devices`` devices.

@@ -128,6 +128,24 @@ class SimClient:
             f"request {request_id!r} not terminal after {timeout_s}s"
         )
 
+    def trace_summary(self, request_id: str) -> dict:
+        """The client-side view of its own trace (schema v12): the
+        ``trace_id`` plus, once terminal, the server's latency
+        decomposition — enough for a caller to log "my request spent
+        X s queued, Y s computing, Z s stalled" and to hand the id to
+        ``python -m gol_tpu.telemetry trace --request <id>`` for the
+        full span tree.  Works mid-flight too (202 tickets carry the
+        trace id; the decomposition is then empty)."""
+        status, payload = self.result(request_id)
+        if status == 404:
+            raise KeyError(f"server does not know {request_id!r}")
+        return {
+            "id": request_id,
+            "status": payload.get("status"),
+            "trace_id": payload.get("trace_id", ""),
+            "decomposition": payload.get("decomposition", {}),
+        }
+
     def healthz(self) -> dict:
         status, payload = self._call("GET", "/healthz")
         if status != 200:

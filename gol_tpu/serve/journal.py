@@ -8,11 +8,16 @@ transition:
 
 - ``admit``    — the full request, the commitment.  Written BEFORE the
   client hears 200/202: if the admit cannot be made durable, the request
-  is rejected, never half-accepted.
+  is rejected, never half-accepted.  Since schema v12 the admit also
+  carries the request's ``trace_id`` (gol_tpu/telemetry/trace.py):
+  compaction preserves admits verbatim and replay restores the id, so a
+  crash-replayed request keeps its trace identity and the reader
+  stitches its pre-crash spans back onto the replaying run's.
 - ``start``    — the request entered a batch slot (advisory: replay
   re-runs *started* work from the initial pattern, which is exact —
   Life is deterministic).
-- ``complete`` — the result file landed (its fingerprint rides along).
+- ``complete`` — the result file landed (its fingerprint and
+  ``trace_id`` ride along, cross-correlating journal and trace stream).
 - ``cancel``   — a deadline expired at a chunk boundary.
 
 Recovery is a pure fold over the records (:func:`replay`): admitted ids

@@ -58,6 +58,21 @@ replay of that bucket's chunk from the fingerprint-verified last-good
 stack, with the guard's fingerprint picking the winner.  With
 ``mesh_devices=0`` (the default) groups run unsharded and none of this
 machinery exists — the compiled chunk programs are byte-identical.
+
+**Request tracing** (schema v12, :mod:`gol_tpu.telemetry.trace`,
+docs/OBSERVABILITY.md "Request tracing & SLOs"): every admitted request
+gets a ``trace_id`` stamped on the journal's admit/complete records (so
+a crash-replayed request keeps its identity and the reader stitches its
+pre-crash spans back on), and when telemetry is attached the scheduler
+emits one span per lifecycle phase — queue wait, every masked chunk the
+request rode (with device wall, co-resident count, and roofline
+utilization), hedge replays, live reshards, and the terminal root span
+carrying the queue/compute/interference/hedge/stall decomposition that
+also rides the result payload.  All of it is host-side bookkeeping
+after the ``force_ready`` fences: tracing on/off never changes the
+compiled chunk programs (the trace-identity pin in tests/test_trace.py)
+and the phase accumulators run unconditionally, so result payloads have
+one shape regardless of whether a stream is attached.
 """
 
 from __future__ import annotations
@@ -74,6 +89,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from gol_tpu.serve import journal as journal_mod
+from gol_tpu.telemetry import trace as trace_mod
 
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 _ENGINES = ("auto", "dense", "bitpack", "pallas_bitpack")
@@ -142,6 +158,21 @@ class RequestState:
         self.generation = 0
         self.remaining = request.generations
         self.submitted_t = time.time()
+        # Tracing (schema v12): ``trace_id`` is minted at admission and
+        # journal-restored on crash replay; ``queued_t`` opens the
+        # CURRENT wait epoch (reset by construction at requeue, so a
+        # replayed request's pre-crash time reads as stall, not queue
+        # wait); ``phase_s`` accrues the latency decomposition the
+        # result payload and the root span both report.  All of it is
+        # maintained whether or not telemetry is attached — one payload
+        # shape, one code path.
+        self.trace_id = ""
+        self.queued_t = self.submitted_t
+        self.chunk_span_id: Optional[str] = None
+        self.phase_s = {
+            "queue": 0.0, "compute": 0.0, "interference": 0.0,
+            "hedge": 0.0,
+        }
         self.started_t: Optional[float] = None
         self.result: Optional[dict] = None
         self.stats: List[dict] = []
@@ -274,6 +305,16 @@ class ServeScheduler:
             if attempt > 0:
                 self._events.restart_event(attempt)
 
+        # Span ids are epoch-prefixed by run id so a crash-replayed
+        # request's pre- and post-crash spans (same trace_id, different
+        # process) can never collide.  With no telemetry attached the
+        # recorder is disabled and every span call is a no-op.
+        self._tracer = trace_mod.SpanRecorder(
+            events=self._events,
+            registry=registry,
+            epoch=self._events.run_id if self._events is not None else "",
+        )
+
         if mesh_devices > 0:
             from gol_tpu.batch import engines as batch_engines
 
@@ -336,8 +377,13 @@ class ServeScheduler:
                     retry_after=self._retry_after(grp),
                 )
             ordinal = self._next_ordinal
+            # The trace id rides the durable admit record: compaction
+            # preserves admits verbatim and replay restores the id, so
+            # a crash-replayed request reconstructs its pre-crash spans.
+            trace_id = trace_mod.new_trace_id(req.id)
             rec = journal_mod.record(
-                "admit", req.id, request=req.to_dict(), ordinal=ordinal
+                "admit", req.id, request=req.to_dict(), ordinal=ordinal,
+                trace_id=trace_id,
             )
             if not self._journal_write(rec):
                 # The admit could not be made durable: this request was
@@ -356,10 +402,14 @@ class ServeScheduler:
                 )
             self._next_ordinal = ordinal + 1
             state = RequestState(req, ordinal, self._initial_board(req))
+            state.trace_id = trace_id
             self._requests[req.id] = state
             grp.queue.append(state)
             self.admitted_total += 1
-            self._emit("admit", req.id, bucket=grp.label, **self._depths())
+            self._emit(
+                "admit", req.id, bucket=grp.label, trace_id=trace_id,
+                **self._depths(),
+            )
             return state
 
     def get_result(self, request_id: str) -> Optional[RequestState]:
@@ -400,6 +450,7 @@ class ServeScheduler:
                 "id": state.request.id,
                 "status": state.status,
                 "generation": state.generation,
+                "trace_id": state.trace_id,
                 "result": state.result,
             }
 
@@ -674,8 +725,13 @@ class ServeScheduler:
                 continue  # a foreign/unreadable admit record
             ordinal = int(admit.get("ordinal", self._next_ordinal))
             self._next_ordinal = max(self._next_ordinal, ordinal + 1)
+            # The original trace id (if the journal predates v12, mint a
+            # fresh one): pre-crash spans in the dead run's rank file
+            # join the spans this process emits under one trace.
+            trace_id = admit.get("trace_id") or trace_mod.new_trace_id(rid)
             if entry["status"] in ("completed", "cancelled"):
                 state = RequestState(req, ordinal, np.zeros((1, 1), np.uint8))
+                state.trace_id = trace_id
                 state.status = (
                     "done" if entry["status"] == "completed" else "expired"
                 )
@@ -684,16 +740,23 @@ class ServeScheduler:
                 self._requests[rid] = state
                 continue
             state = RequestState(req, ordinal, self._initial_board(req))
+            state.trace_id = trace_id
             t = admit.get("t")
             if isinstance(t, (int, float)) and not isinstance(t, bool):
                 # Deadlines and latency are measured from the ORIGINAL
                 # admission, not from this restart — a deadlined request
                 # must not get a fresh budget every supervised restart.
+                # ``queued_t`` deliberately stays at construction time:
+                # the wait epoch restarts now, so the crash gap reads as
+                # stall in the decomposition, never as queue wait.
                 state.submitted_t = float(t)
             self._requests[rid] = state
             grp = self._group_for(req)
             grp.queue.append(state)
-            self._emit("requeue", rid, bucket=grp.label, **self._depths())
+            self._emit(
+                "requeue", rid, bucket=grp.label, trace_id=trace_id,
+                **self._depths(),
+            )
 
     def _load_result(self, rid: str) -> Optional[dict]:
         path = os.path.join(self.results_dir, f"{rid}.json")
@@ -746,6 +809,16 @@ class ServeScheduler:
         return d is not None and (now - state.submitted_t) > d
 
     def _cancel(self, state: RequestState, grp: _BucketGroup) -> None:
+        end_t = time.time()
+        if state.status == "queued":
+            # A never-started request spent its whole life waiting: the
+            # queue span closes at cancellation, not slot assignment.
+            state.phase_s["queue"] += max(end_t - state.queued_t, 0.0)
+            self._tracer.span(
+                state.trace_id, state.request.id, "queue",
+                state.queued_t, end_t, bucket=grp.label,
+            )
+        decomp = self._decomposition(state, end_t)
         payload = {
             "id": state.request.id,
             "status": "expired",
@@ -753,6 +826,8 @@ class ServeScheduler:
             "deadline_s": state.request.deadline_s,
             "generation": state.generation,
             "generations": state.request.generations,
+            "trace_id": state.trace_id,
+            "decomposition": decomp,
         }
         # result before status: a terminal status must never be
         # observable without its payload (same ordering as _finish).
@@ -762,13 +837,23 @@ class ServeScheduler:
         self._journal_write(
             journal_mod.record(
                 "cancel", state.request.id, reason="deadline",
-                generation=state.generation,
+                generation=state.generation, trace_id=state.trace_id,
             )
+        )
+        self._tracer.span(
+            state.trace_id, state.request.id, "cancel", end_t,
+            time.time(), bucket=grp.label, generation=state.generation,
+        )
+        self._tracer.span(
+            state.trace_id, state.request.id, "request",
+            state.submitted_t, end_t, parent_id=None,
+            span_id=trace_mod.ROOT_SPAN_ID, status="expired", **decomp,
         )
         self.cancelled_total += 1
         self._emit(
             "deadline", state.request.id, bucket=grp.label,
-            generation=state.generation, **self._depths(),
+            generation=state.generation, trace_id=state.trace_id,
+            **self._depths(),
         )
         state.done.set()
 
@@ -792,8 +877,16 @@ class ServeScheduler:
                 if slot is not None or not grp.queue:
                     continue
                 state = grp.queue.popleft()
+                now = time.time()
+                # The queue span closes here: waiting ends at slot
+                # assignment (bucket-group join), whatever happens next.
+                state.phase_s["queue"] += max(now - state.queued_t, 0.0)
+                self._tracer.span(
+                    state.trace_id, state.request.id, "queue",
+                    state.queued_t, now, bucket=grp.label,
+                )
                 state.status = "running"
-                state.started_t = time.time()
+                state.started_t = now
                 grp.slots[k] = state
                 grp.stack = None  # membership changed: rebuild
                 grp.last_good = None
@@ -804,7 +897,7 @@ class ServeScheduler:
                 )
                 self._emit(
                     "start", state.request.id, bucket=grp.label,
-                    **self._depths(),
+                    trace_id=state.trace_id, **self._depths(),
                 )
 
     def _build_stack(self, grp: _BucketGroup) -> None:
@@ -865,15 +958,22 @@ class ServeScheduler:
         restores = 0
         audits = None
         straggler = False
+        straggler_verdicts: list = []
         pre_good = grp.last_good if self.guard else None
         while True:
+            w0 = time.time()
             t0 = time.perf_counter()
             candidate = compiled(grp.stack, grp.hs, grp.ws)
             force_ready(candidate)
             wall = time.perf_counter() - t0
             if self._health is not None:
                 hv = self._health.heartbeat(gen_after, wall)
-                if any(v.kind == "straggler" for v in hv):
+                # Only the final (surviving) iteration's verdicts ride
+                # the chunk span — earlier iterations are rolled back.
+                straggler_verdicts = [
+                    v for v in hv if v.kind == "straggler"
+                ]
+                if straggler_verdicts:
                     straggler = True
             if self._plan_on:
                 candidate = faults_mod.apply_board_faults(
@@ -915,10 +1015,49 @@ class ServeScheduler:
                     "fingerprint verification"
                 )
             grp.stack = restored
+        # Chunk attribution (host-side, post-fence — never traced): the
+        # span window [w0, w1] covers the surviving iteration only; the
+        # guard's rollback-replays before it land in the stall residual.
+        # Each rider's own share of the chunk is wall/co_resident; the
+        # rest is interference from the co-residents it shared the
+        # masked program with.
+        w1 = time.time()
+        from gol_tpu import telemetry as telemetry_mod
+
+        util = telemetry_mod.roofline_utilization(
+            grp.engine,
+            len(grp.slots) * grp.shape[0] * grp.shape[1]
+            // max(self._cur_n, 1),
+            take, 1, self._cur_mesh is not None, wall,
+        )
+        co = len(active)
+        dur = max(w1 - w0, 0.0)
+        for _, s in active:
+            s.phase_s["compute"] += dur / co
+            s.phase_s["interference"] += dur * (co - 1) / co
+            s.chunk_span_id = self._tracer.span(
+                s.trace_id, s.request.id, "chunk", w0, w1,
+                bucket=grp.label, take=take, wall_s=round(wall, 6),
+                co_resident=co, utilization=util, generation=gen_after,
+            )
+            for v in straggler_verdicts:
+                self._tracer.span(
+                    s.trace_id, s.request.id, "straggler", w0, w1,
+                    parent_id=s.chunk_span_id, **v.to_span_attrs(),
+                )
         if straggler and self.guard and pre_good is not None:
+            h0 = time.time()
             candidate, audits = self._hedge_replay(
                 grp, compiled, pre_good, candidate, audits, gen_after
             )
+            h1 = time.time()
+            for _, s in active:
+                s.phase_s["hedge"] += h1 - h0
+                self._tracer.span(
+                    s.trace_id, s.request.id, "hedge", h0, h1,
+                    parent_id=s.chunk_span_id or trace_mod.ROOT_SPAN_ID,
+                    bucket=grp.label, generation=gen_after,
+                )
         grp.gens = gen_after
         self._total_gens += take
         grp.stack = candidate
@@ -931,7 +1070,7 @@ class ServeScheduler:
             )
             self._events.chunk_event(
                 self._chunk_index, take, grp.gens, wall,
-                cells * take, None,
+                cells * take, util,
                 batch={
                     "bucket": list(grp.shape),
                     "B": len(grp.slots),
@@ -998,9 +1137,14 @@ class ServeScheduler:
             return
         new_mesh = batch_engines.make_batch_mesh(devices=devices)
         moved = 0
+        r0 = time.time()
+        riders: List[Tuple[_BucketGroup, RequestState]] = []
         for grp in self._groups.values():
             if grp.stack is None:
                 continue
+            riders.extend(
+                (grp, s) for s in grp.slots if s is not None
+            )
             plan = redistribute.plan_worlds(
                 len(grp.slots), self._cur_n, n
             )
@@ -1024,6 +1168,16 @@ class ServeScheduler:
             # a fact of the stream (the serve drills assert on it).
             self._emit_reshard(
                 redistribute.plan_worlds(self.slots, self._cur_n, n)
+            )
+        # Every in-flight rider gets a reshard span over the whole
+        # transition window — the time shows up in its stall phase, and
+        # the span says why (docs/OBSERVABILITY.md).
+        r1 = time.time()
+        for grp, s in riders:
+            self._tracer.span(
+                s.trace_id, s.request.id, "reshard", r0, r1,
+                bucket=grp.label, src_devices=self._cur_n,
+                dst_devices=n,
             )
         self._cur_mesh = new_mesh
         self._cur_n = n
@@ -1104,11 +1258,36 @@ class ServeScheduler:
         )
         return hedge, h_audits
 
+    def _decomposition(self, state: RequestState, end_t: float) -> dict:
+        """The five-phase latency decomposition from the accumulators.
+        Stall is the residual — scheduler overhead, guard replays,
+        reshard windows, and (for a crash-replayed request, whose
+        accumulators restarted with the process) the crash gap — so the
+        phases sum to ``e2e_s`` exactly by construction.  The read side
+        (:func:`gol_tpu.telemetry.trace.decompose`) recomputes the same
+        quantity from the spans alone; write and read agreeing is the
+        1%-additivity acceptance check."""
+        e2e = max(end_t - state.submitted_t, 0.0)
+        p = state.phase_s
+        accounted = (
+            p["queue"] + p["compute"] + p["interference"] + p["hedge"]
+        )
+        return {
+            "e2e_s": round(e2e, 6),
+            "queue_s": round(p["queue"], 6),
+            "compute_s": round(p["compute"], 6),
+            "interference_s": round(p["interference"], 6),
+            "hedge_s": round(p["hedge"], 6),
+            "stall_s": round(max(e2e - accounted, 0.0), 6),
+        }
+
     def _finish(self, state: RequestState, grp: _BucketGroup) -> None:
         from gol_tpu.utils import guard as guard_mod
 
         fp = guard_mod.fingerprint_np(state.board)
-        latency = time.time() - state.submitted_t
+        end_t = time.time()
+        latency = end_t - state.submitted_t
+        decomp = self._decomposition(state, end_t)
         payload = {
             "id": state.request.id,
             "status": "done",
@@ -1121,6 +1300,8 @@ class ServeScheduler:
             "fingerprint": int(fp),
             "population": int(state.board.sum()),
             "latency_s": round(latency, 6),
+            "trace_id": state.trace_id,
+            "decomposition": decomp,
             "board": encode_board(state.board),
         }
         if state.request.stream_stats:
@@ -1130,8 +1311,22 @@ class ServeScheduler:
         self._journal_write(
             journal_mod.record(
                 "complete", state.request.id, fingerprint=int(fp),
-                generation=state.generation,
+                generation=state.generation, trace_id=state.trace_id,
             )
+        )
+        # The commit span covers making the result durable; the root
+        # span ends at ``end_t``, where ``latency_s`` is measured — so
+        # read-side e2e equals the payload's latency, and the commit
+        # tail (fsync, journal append) shows as a child past the root's
+        # edge rather than silently inflating every latency number.
+        self._tracer.span(
+            state.trace_id, state.request.id, "commit", end_t,
+            time.time(), bucket=grp.label, fingerprint=int(fp),
+        )
+        self._tracer.span(
+            state.trace_id, state.request.id, "request",
+            state.submitted_t, end_t, parent_id=None,
+            span_id=trace_mod.ROOT_SPAN_ID, status="done", **decomp,
         )
         state.result = payload
         state.status = "done"
@@ -1140,7 +1335,7 @@ class ServeScheduler:
         self._emit(
             "complete", state.request.id, bucket=grp.label,
             latency_s=payload["latency_s"], generation=state.generation,
-            **self._depths(),
+            trace_id=state.trace_id, **self._depths(),
         )
         state.done.set()
         self._completions_since_compact += 1

@@ -164,6 +164,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 "id": state.request.id,
                 "status": snap["status"] if snap else "queued",
                 "generation": snap["generation"] if snap else 0,
+                # The trace id rides every in-flight answer so a caller
+                # can correlate its request with `telemetry trace`
+                # before (or without) the terminal payload landing.
+                "trace_id": snap["trace_id"] if snap else "",
             }
             if "id" not in body:
                 # Exactly-once admission keys on the id.  This one was
@@ -190,6 +194,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     "id": request_id,
                     "status": snap["status"],
                     "generation": snap["generation"],
+                    "trace_id": snap["trace_id"],
                 },
             )
 

@@ -39,6 +39,14 @@ of it:
   ``PERF_LEDGER.jsonl``) with a >N%-regression CI gate; and
   ``--metrics-port`` serves the same in-process event stream as
   Prometheus text (:mod:`gol_tpu.telemetry.metrics`).
+- Schema v12: the serving tier threads a request-scoped span tree
+  through every lifecycle phase (``span`` events keyed by ``trace_id``);
+  ``python -m gol_tpu.telemetry trace <dir>`` rebuilds the trees, prints
+  the queue/compute/stall/interference/hedge latency decomposition,
+  exports Chrome-trace/Perfetto JSON (:mod:`gol_tpu.telemetry.trace`),
+  and evaluates declarative SLOs with burn rates
+  (:mod:`gol_tpu.telemetry.slo`) — docs/OBSERVABILITY.md, "Request
+  tracing & SLOs".
 
 Purity invariant: everything here is host-side Python running strictly
 outside compiled code, after the ``force_ready`` fences — emission can
@@ -55,7 +63,21 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 11 (this round) adds the health-plane event
+# Version 12 (this round) adds the request-scoped tracing plane
+# (docs/OBSERVABILITY.md, "Request tracing & SLOs"): a ``span`` record is
+# one node of a request's span tree — ``trace_id`` (minted at admission,
+# carried on the journal's admit/complete records so crash-replayed
+# requests keep their pre-crash spans), ``request_id``, ``span_id`` /
+# ``parent_id`` (the root span's id is the literal ``"root"``), ``name``
+# (``request`` / ``queue`` / ``chunk`` / ``hedge`` / ``reshard`` /
+# ``straggler`` / ``cancel`` / ``commit``), wall-clock ``start_t`` /
+# ``end_t``, and an ``attrs`` block (chunk spans: device ``wall_s``,
+# ``co_resident`` count, roofline ``utilization``; the root span: the
+# queue/compute/interference/hedge/stall latency decomposition).
+# ``python -m gol_tpu.telemetry trace`` rebuilds the trees
+# (:mod:`gol_tpu.telemetry.trace`); :mod:`gol_tpu.telemetry.slo`
+# evaluates declarative objectives over them.
+# Version 11 added the health-plane event
 # (docs/RESILIENCE.md, "Live elasticity"): a ``health`` record marks one
 # verdict of :mod:`gol_tpu.resilience.health` — ``verdict`` is one of
 # ``device_loss`` / ``device_restore`` (a device left or rejoined the
@@ -122,11 +144,15 @@ from typing import Dict, Optional
 # resilience events — ``preempt``, ``resume``, ``restart``
 # (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
-# readable: every v1-v10 event type and field survives unchanged, so
+# readable: every v1-v11 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5/v6/v7/v8/v9/v10 fixture tests).
-SCHEMA_VERSION = 11
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+# committed v1/v2/v3/v4/v5/v6/v7/v8/v9/v10/v11/v12 fixture tests).
+# Streams NEWER than this reader refuse loudly: ``validate_record``
+# raises a "schema vN is newer than this reader supports" SchemaError
+# (exit 2 at the CLI) instead of letting a consumer KeyError on a field
+# it has never heard of.
+SCHEMA_VERSION = 12
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -198,6 +224,14 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # extras carry device, alive, rank, wall_s, baseline_s, winner.
     # ``generation`` is the chunk boundary that produced it.
     "health": frozenset({"verdict", "generation"}),
+    # v12: one node of a request's span tree (gol_tpu/telemetry/trace.py,
+    # docs/OBSERVABILITY.md "Request tracing & SLOs"): ``span_id`` /
+    # optional ``parent_id`` link the tree (root id = "root"); ``name``
+    # is request/queue/chunk/hedge/reshard/straggler/cancel/commit;
+    # ``start_t``/``end_t`` are wall-clock; extras ride in ``attrs``.
+    "span": frozenset(
+        {"trace_id", "request_id", "span_id", "name", "start_t", "end_t"}
+    ),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -236,8 +270,18 @@ def validate_record(rec: dict) -> None:
     if missing:
         raise SchemaError(f"{event}: missing fields {sorted(missing)}")
     if event == "run_header" and rec["schema"] not in SUPPORTED_SCHEMAS:
+        schema = rec["schema"]
+        if isinstance(schema, int) and schema > SCHEMA_VERSION:
+            # A future-versioned stream: fail loudly and actionably
+            # (exit 2 at the CLI), never a KeyError three consumers deep
+            # on a field this reader has never heard of.
+            raise SchemaError(
+                f"run_header: schema v{schema} is newer than this reader "
+                f"supports (max v{SCHEMA_VERSION}) — upgrade gol_tpu to "
+                "read this stream"
+            )
         raise SchemaError(
-            f"run_header: schema {rec['schema']!r} not in supported "
+            f"run_header: schema {schema!r} not in supported "
             f"{SUPPORTED_SCHEMAS}"
         )
 
@@ -556,6 +600,33 @@ class EventLog:
         "Live elasticity")."""
         self.emit(
             "health", verdict=verdict, generation=generation, **extra
+        )
+
+    def span_event(
+        self,
+        trace_id: str,
+        request_id: str,
+        span_id: str,
+        name: str,
+        start_t: float,
+        end_t: float,
+        **extra,
+    ) -> None:
+        """One node of a request's span tree (v12): ``extra`` carries
+        ``parent_id`` (absent on the root span, whose id is the literal
+        ``"root"``) and the ``attrs`` block — chunk spans stamp device
+        ``wall_s``/``co_resident``/``utilization``, the root span the
+        latency decomposition (docs/OBSERVABILITY.md, "Request tracing
+        & SLOs")."""
+        self.emit(
+            "span",
+            trace_id=trace_id,
+            request_id=request_id,
+            span_id=span_id,
+            name=name,
+            start_t=start_t,
+            end_t=end_t,
+            **extra,
         )
 
     def stats_event(

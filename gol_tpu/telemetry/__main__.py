@@ -1,5 +1,6 @@
 """``python -m gol_tpu.telemetry
 {summarize <dir> | diff <a> <b> | watch <dir> |
+ trace <dir> [--request ID] [--perfetto out.json] [--slo FILE] |
  ledger ingest|show|check}``."""
 
 import sys

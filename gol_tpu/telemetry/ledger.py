@@ -29,6 +29,12 @@ comparable measurements ever compare.  ``kind`` partitions the gate:
 - ``throughput`` — a headline rate (higher is better): gated;
 - ``equivalence`` — a pass/fail dryrun (1.0/0.0): gated (a flip to
   0 is a 100% regression);
+- ``latency`` — a percentile in seconds (lower is better): gated —
+  servebench p99, and (v12) the queue-wait p99 from the trace plane's
+  latency decomposition;
+- ``slo`` — an objective's burn rate (lower is better, ≤1.0 = the
+  error budget holds; :mod:`gol_tpu.telemetry.slo`): gated, so the
+  serving tier is held to its objectives, not just its rate;
 - ``attribution`` — a phase breakdown (halobench seconds/gen): shown in
   trends, **never gated** — its measurement method legitimately evolves
   between rounds (the r5 anti-DCE rework changed ``exchange_s``
@@ -432,9 +438,13 @@ def _multichip_records(
 
 def _serve_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
     """SERVE_r*.json (servebench): each offered-rate row lands as one
-    throughput record (achieved req/s, higher) and one latency record
-    (p99 seconds, lower) — so ``ledger check`` gates serving-tier p99
-    regressions exactly the way it gates cell rates."""
+    throughput record (achieved req/s, higher), one latency record
+    (p99 seconds, lower), and — since schema v12 — one queue-wait p99
+    latency record plus one ``slo`` record per evaluated objective
+    (burn rate, lower: ≤1.0 means the error budget holds).  ``ledger
+    check`` gates every non-attribution kind, so a burn-rate regression
+    fails CI exactly the way a throughput drop does — the tier is gated
+    on its objectives, not just its rate."""
     backend = (data.get("header") or {}).get("backend", "cpu")
     shape = (
         f"{data.get('size')}^2x{data.get('generations')}"
@@ -474,6 +484,44 @@ def _serve_records(data: dict, source: str, round_: Optional[int]) -> List[dict]
                     direction="lower",
                     round_=round_,
                     extra=extra,
+                )
+            )
+        queue_p99 = ((row.get("decomposition") or {}).get("queue_s") or {}).get(
+            "p99"
+        )
+        if queue_p99 is not None:
+            out.append(
+                _record(
+                    label + ":queue_p99",
+                    queue_p99,
+                    "s",
+                    source,
+                    "servebench",
+                    backend,
+                    kind="latency",
+                    direction="lower",
+                    round_=round_,
+                    extra=extra,
+                )
+            )
+        for slo_row in row.get("slo") or []:
+            out.append(
+                _record(
+                    label + f":slo_{slo_row['name']}",
+                    slo_row["burn_rate"],
+                    "burn-rate",
+                    source,
+                    "servebench",
+                    backend,
+                    kind="slo",
+                    direction="lower",
+                    round_=round_,
+                    extra={
+                        "target": slo_row.get("target"),
+                        "observed": slo_row.get("observed"),
+                        "violations": slo_row.get("violations"),
+                        "requests": slo_row.get("requests"),
+                    },
                 )
             )
     return out
@@ -641,10 +689,21 @@ def check_records(
         newest, best = recs[-1], _best(recs)
         if _worse(newest, best, threshold):
             sign = "-" if newest["direction"] == "higher" else "+"
-            pct = 100.0 * abs(newest["value"] - best["value"]) / best["value"]
+            # A best of 0 is legitimate for lower-is-better kinds (an
+            # SLO burn rate that never burned): any nonzero newest is a
+            # regression, but the relative-percent framing has no
+            # denominator — report the absolute move instead.
+            if best["value"]:
+                delta = (
+                    100.0 * abs(newest["value"] - best["value"])
+                    / best["value"]
+                )
+                move = f"{sign}{delta:.1f}%"
+            else:
+                move = f"{sign}{abs(newest['value'] - best['value']):.4g}"
             flags.append(
                 f"regression: {fp}: newest {newest['value']:.4g} "
-                f"{newest['unit']} ({newest['source']}) is {sign}{pct:.1f}% "
+                f"{newest['unit']} ({newest['source']}) is {move} "
                 f"vs best {best['value']:.4g} ({best['source']}) — "
                 f"threshold {100 * threshold:.0f}%"
             )

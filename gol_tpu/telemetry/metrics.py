@@ -42,6 +42,11 @@ has been observed — docs/SERVING.md)::
     gol_serve_completed_total         results written (counter)
     gol_serve_deadline_total          chunk-boundary cancels (counter)
     gol_serve_request_seconds_*       admit→complete latency histogram
+    gol_serve_queue_wait_seconds_*    queue-wait histogram, fed from v12
+                                      queue spans (one source of truth
+                                      with `telemetry trace`)
+    gol_serve_stall_fraction_*        stall/e2e histogram from the root
+                                      spans' latency decomposition
 
 Health-plane metrics (schema v11, emitted only once a ``health`` event
 has been observed — docs/RESILIENCE.md, "Live elasticity")::
@@ -69,6 +74,12 @@ from typing import Dict, Optional
 #: small-world simulation requests on a warm scheduler land in the
 #: sub-second buckets; the top buckets catch queueing under load.
 SERVE_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0)
+
+#: Upper bounds of the stall-fraction histogram (stall seconds over
+#: end-to-end seconds, from the root span's decomposition) — a healthy
+#: tier sits in the low buckets; a tier losing time to guard replays,
+#: reshards, or scheduler overhead climbs toward 1.0.
+STALL_FRACTION_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9)
 
 
 class MetricsRegistry:
@@ -118,6 +129,21 @@ class MetricsRegistry:
         }
         self.serve_latency_sum = 0.0
         self.serve_latency_count = 0
+        # Request tracing (schema v12): both histograms are fed from
+        # the SAME span records the JSONL stream carries — the scrape
+        # surface and `telemetry trace` can never disagree about queue
+        # wait or stall because one emission feeds both.
+        self.span_seen = False
+        self.serve_queue_wait_buckets: Dict[float, int] = {
+            le: 0 for le in SERVE_LATENCY_BUCKETS
+        }
+        self.serve_queue_wait_sum = 0.0
+        self.serve_queue_wait_count = 0
+        self.serve_stall_buckets: Dict[float, int] = {
+            le: 0 for le in STALL_FRACTION_BUCKETS
+        }
+        self.serve_stall_sum = 0.0
+        self.serve_stall_count = 0
         self.health_seen = False
         self.health_alive_devices: Optional[int] = None
         self.health_device_loss_total = 0
@@ -180,6 +206,30 @@ class MetricsRegistry:
                     self.serve_queue_depth = rec["queue_depth"]
                 if "inflight" in rec:
                     self.serve_inflight = rec["inflight"]
+            elif event == "span":
+                name = rec.get("name")
+                if name == "queue":
+                    self.span_seen = True
+                    wait = max(rec["end_t"] - rec["start_t"], 0.0)
+                    self.serve_queue_wait_sum += wait
+                    self.serve_queue_wait_count += 1
+                    for le in self.serve_queue_wait_buckets:
+                        if wait <= le:
+                            self.serve_queue_wait_buckets[le] += 1
+                elif name == "request":
+                    attrs = rec.get("attrs") or {}
+                    e2e = attrs.get("e2e_s")
+                    stall = attrs.get("stall_s")
+                    if isinstance(e2e, (int, float)) and e2e > 0 and (
+                        isinstance(stall, (int, float))
+                    ):
+                        self.span_seen = True
+                        frac = min(max(stall / e2e, 0.0), 1.0)
+                        self.serve_stall_sum += frac
+                        self.serve_stall_count += 1
+                        for le in self.serve_stall_buckets:
+                            if frac <= le:
+                                self.serve_stall_buckets[le] += 1
             elif event == "health":
                 self.health_seen = True
                 verdict = rec.get("verdict")
@@ -339,6 +389,51 @@ class MetricsRegistry:
                 lines.append(
                     f"gol_serve_request_seconds_count "
                     f"{self.serve_latency_count}"
+                )
+            if self.span_seen:
+                lines.append(
+                    "# HELP gol_serve_queue_wait_seconds Queue-wait "
+                    "seconds from v12 queue spans."
+                )
+                lines.append(
+                    "# TYPE gol_serve_queue_wait_seconds histogram"
+                )
+                for le, n in sorted(self.serve_queue_wait_buckets.items()):
+                    lines.append(
+                        f'gol_serve_queue_wait_seconds_bucket{{le="{le}"}}'
+                        f" {n}"
+                    )
+                lines.append(
+                    'gol_serve_queue_wait_seconds_bucket{le="+Inf"} '
+                    f"{self.serve_queue_wait_count}"
+                )
+                lines.append(
+                    "gol_serve_queue_wait_seconds_sum "
+                    f"{self.serve_queue_wait_sum}"
+                )
+                lines.append(
+                    "gol_serve_queue_wait_seconds_count "
+                    f"{self.serve_queue_wait_count}"
+                )
+                lines.append(
+                    "# HELP gol_serve_stall_fraction Stall share of "
+                    "end-to-end latency from v12 root spans."
+                )
+                lines.append("# TYPE gol_serve_stall_fraction histogram")
+                for le, n in sorted(self.serve_stall_buckets.items()):
+                    lines.append(
+                        f'gol_serve_stall_fraction_bucket{{le="{le}"}} {n}'
+                    )
+                lines.append(
+                    'gol_serve_stall_fraction_bucket{le="+Inf"} '
+                    f"{self.serve_stall_count}"
+                )
+                lines.append(
+                    f"gol_serve_stall_fraction_sum {self.serve_stall_sum}"
+                )
+                lines.append(
+                    f"gol_serve_stall_fraction_count "
+                    f"{self.serve_stall_count}"
                 )
             if self.health_seen:
                 if self.health_alive_devices is not None:

@@ -665,6 +665,21 @@ def render_run(run: Run, out) -> None:
             file=out,
         )
 
+    spans = run.records("span", rank=rank0)
+    if spans:
+        # Schema v12 (docs/OBSERVABILITY.md, "Request tracing & SLOs"):
+        # a one-line census pointing at the real tool — root spans are
+        # terminals, so traces != roots means requests still in flight
+        # (or crashed: their roots live in the replaying run's file).
+        traces = {r["trace_id"] for r in spans}
+        roots = sum(1 for r in spans if r["span_id"] == "root")
+        print(
+            f"  trace: {len(spans)} span(s) across {len(traces)} "
+            f"trace(s), {roots} complete — `telemetry trace` for the "
+            "decomposition",
+            file=out,
+        )
+
     healths = run.records("health", rank=rank0)
     if healths:
         # Schema v11 (docs/RESILIENCE.md, "Live elasticity"): verdict
@@ -872,6 +887,26 @@ def main(argv=None) -> int:
         sp.add_argument(
             "--ledger", dest="ledger_path", default=None, metavar="FILE"
         )
+    pt = sub.add_parser(
+        "trace",
+        help="rebuild per-request span trees: latency decomposition, "
+        "SLO burn rates, Perfetto export (docs/OBSERVABILITY.md)",
+    )
+    pt.add_argument("directory")
+    pt.add_argument(
+        "--request", default=None, metavar="ID",
+        help="render one request's full span tree instead of the table",
+    )
+    pt.add_argument(
+        "--perfetto", default=None, metavar="FILE",
+        help="export Chrome-trace/Perfetto JSON (load at "
+        "ui.perfetto.dev or chrome://tracing)",
+    )
+    pt.add_argument(
+        "--slo", default=None, metavar="FILE",
+        help="declarative objectives JSON (default: the built-in "
+        "commit-p99 + queue-fraction objectives)",
+    )
     pw = sub.add_parser(
         "watch", help="live dashboard tailing a run's rank files"
     )
@@ -909,6 +944,16 @@ def main(argv=None) -> int:
                 else ledger_mod.DEFAULT_THRESHOLD,
                 (ns.backend,),
                 sys.stdout,
+            )
+        if ns.command == "trace":
+            from gol_tpu.telemetry import trace as trace_mod
+
+            return trace_mod.main_trace(
+                ns.directory,
+                sys.stdout,
+                request=ns.request,
+                perfetto=ns.perfetto,
+                slo_path=ns.slo,
             )
         if ns.command == "watch":
             from gol_tpu.telemetry import watch as watch_mod

@@ -48,26 +48,31 @@
 #                       guarded-field discipline, SPMD collective
 #                       consistency — AST-only, no jax backend;
 #                       docs/ANALYSIS.md "The concurrency matrix")
-#  15. tier-1 tests    (the exact ROADMAP.md command)
+#  15. trace smoke     (request tracing, docs/OBSERVABILITY.md: the
+#                       committed v12 fixture round-trips through
+#                       `telemetry trace --perfetto` and the export
+#                       validates against the committed JSON schema —
+#                       CI teeth for the export format)
+#  16. tier-1 tests    (the exact ROADMAP.md command)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/15] lint =="
+echo "== [1/16] lint =="
 bash scripts/lint.sh
 
-echo "== [2/15] static verifier (gol_tpu.analysis) =="
+echo "== [2/16] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/15] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/16] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/15] stats smoke (in-graph simulation statistics) =="
+echo "== [4/16] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -76,37 +81,43 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/15] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/16] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/15] batch smoke (docs/BATCHING.md) =="
+echo "== [6/16] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/15] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/16] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/15] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/16] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/15] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+echo "== [9/16] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
 JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
-echo "== [10/15] halo smoke (pipelined depth-k exchange, PR 9) =="
+echo "== [10/16] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/15] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+echo "== [11/16] chaos smoke (docs/RESILIENCE.md, fault plane) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
-echo "== [12/15] serve smoke (docs/SERVING.md, serving tier) =="
+echo "== [12/16] serve smoke (docs/SERVING.md, serving tier) =="
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
-echo "== [13/15] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
+echo "== [13/16] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
 python scripts/elastic_smoke.py
 
-echo "== [14/15] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
+echo "== [14/16] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
 python -m gol_tpu.analysis --concurrency
 
-echo "== [15/15] tier-1 tests =="
+echo "== [15/16] trace smoke (docs/OBSERVABILITY.md, request tracing) =="
+JAX_PLATFORMS=cpu python -m gol_tpu.telemetry trace \
+    tests/data/telemetry_v12 --perfetto /tmp/_trace_export.json
+python scripts/validate_trace_export.py /tmp/_trace_export.json \
+    docs/schemas/perfetto_trace.schema.json
+
+echo "== [16/16] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

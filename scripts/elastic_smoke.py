@@ -155,9 +155,14 @@ def run(tmp: str, env: dict) -> int:
     ):
         return _fail("journal not fully terminal after the drill")
     recs = _events(tm)
+    from gol_tpu import telemetry
+
     headers = [r for r in recs if r.get("event") == "run_header"]
-    if headers and headers[0].get("schema") != 11:
-        return _fail(f"stream schema {headers[0].get('schema')} != 11")
+    if headers and headers[0].get("schema") != telemetry.SCHEMA_VERSION:
+        return _fail(
+            f"stream schema {headers[0].get('schema')} != "
+            f"{telemetry.SCHEMA_VERSION}"
+        )
     verdicts = [r["verdict"] for r in recs if r.get("event") == "health"]
     if "device_loss" not in verdicts:
         return _fail("no device_loss verdict — the loss never registered")

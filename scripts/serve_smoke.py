@@ -185,9 +185,14 @@ def phase_a(tmp: str, env: dict) -> int:
         for r in recs
     ):
         return _fail("the injected bitflip never failed an audit")
+    from gol_tpu import telemetry
+
     headers = [r for r in recs if r.get("event") == "run_header"]
-    if headers and headers[0].get("schema") != 10:
-        return _fail(f"stream schema {headers[0].get('schema')} != 10")
+    if headers and headers[0].get("schema") != telemetry.SCHEMA_VERSION:
+        return _fail(
+            f"stream schema {headers[0].get('schema')} != "
+            f"{telemetry.SCHEMA_VERSION}"
+        )
     print(
         "serve-smoke: phase A ok — crash mid-batch, supervised restart "
         "re-admitted from the journal, every request completed exactly "

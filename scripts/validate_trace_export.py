@@ -1,0 +1,84 @@
+"""Validate a Perfetto trace export against the committed schema.
+
+check.sh's trace stage round-trips the committed v12 fixture through
+``python -m gol_tpu.telemetry trace --perfetto`` and then runs this —
+so the export format has CI teeth: a field rename or shape drift fails
+the gate against ``docs/schemas/perfetto_trace.schema.json`` instead of
+silently shipping a file Perfetto can no longer load.  Beyond the
+schema, the structural invariants the schema language can't say are
+checked here: complete (``ph: "X"``) events must carry non-negative
+``ts``/``dur``, and every referenced ``tid`` must have a thread-name
+metadata event.
+
+Usage: python scripts/validate_trace_export.py EXPORT.json [SCHEMA.json]
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+DEFAULT_SCHEMA = REPO / "docs" / "schemas" / "perfetto_trace.schema.json"
+
+
+def main(argv=None) -> int:
+    from gol_tpu.telemetry.trace import validate_json_schema
+
+    args = list(sys.argv[1:] if argv is None else argv)
+    if not args or len(args) > 2:
+        print(__doc__.strip().splitlines()[-1], file=sys.stderr)
+        return 2
+    export_path = args[0]
+    schema_path = args[1] if len(args) == 2 else str(DEFAULT_SCHEMA)
+    with open(export_path) as f:
+        doc = json.load(f)
+    with open(schema_path) as f:
+        schema = json.load(f)
+
+    errors = validate_json_schema(doc, schema)
+    events = doc.get("traceEvents") or []
+    named_tids = set()
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            continue
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            named_tids.add(ev.get("tid"))
+        if ev.get("ph") == "X":
+            for key in ("ts", "dur"):
+                v = ev.get(key)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(
+                        f"$.traceEvents[{i}]: ph=X needs {key} >= 0, "
+                        f"got {v!r}"
+                    )
+    missing = {
+        ev.get("tid")
+        for ev in events
+        if isinstance(ev, dict) and ev.get("ph") == "X"
+    } - named_tids
+    if missing:
+        errors.append(
+            f"tids {sorted(missing)} have spans but no thread_name "
+            "metadata event"
+        )
+
+    if errors:
+        for e in errors:
+            print(f"validate_trace_export: {e}", file=sys.stderr)
+        return 1
+    n_spans = sum(1 for ev in events if ev.get("ph") == "X")
+    print(
+        f"validate_trace_export: OK — {n_spans} span(s) on "
+        f"{len(named_tids)} track(s) conform to "
+        f"{pathlib.Path(schema_path).name}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

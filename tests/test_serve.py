@@ -396,7 +396,10 @@ def test_deadline_cancels_running_slot_survivor_stays_bit_equal(tmp_path):
 def test_replay_restores_original_admit_time_for_deadlines(tmp_path):
     """Journal replay restores ``submitted_t`` from the admit record's
     ``t`` — a deadlined request must not get a fresh deadline budget on
-    every supervised restart (nor undercount ``latency_s``)."""
+    every supervised restart (nor undercount ``latency_s``) — and
+    restores the v12 ``trace_id`` stamped on the admit, so a replayed
+    request keeps its trace identity and the reader can stitch its
+    pre-crash spans back on (gol_tpu/telemetry/trace.py)."""
     import os as os_mod
     import time as time_mod
 
@@ -407,7 +410,10 @@ def test_replay_restores_original_admit_time_for_deadlines(tmp_path):
         "id": "stale", "pattern": 4, "size": 32, "generations": 500,
         "engine": "auto", "deadline_s": 60.0, "stream_stats": False,
     }
-    rec = journal_mod.record("admit", "stale", request=req, ordinal=0)
+    rec = journal_mod.record(
+        "admit", "stale", request=req, ordinal=0,
+        trace_id="tr-stale-precrash",
+    )
     rec["t"] = time_mod.time() - 120.0  # admitted two minutes ago
     j.append(rec)
     j.close()
@@ -416,8 +422,13 @@ def test_replay_restores_original_admit_time_for_deadlines(tmp_path):
         state = sched.get_result("stale")
         assert state is not None
         assert state.submitted_t == rec["t"]  # not restart time
+        assert state.trace_id == "tr-stale-precrash"  # original, not fresh
+        # The wait epoch restarts at replay: the crash gap must read as
+        # stall in the decomposition, never as queue wait.
+        assert state.queued_t > rec["t"]
         sched.run_until_drained()  # 60s deadline lapsed 60s ago
         assert state.status == "expired"
+        assert state.result["trace_id"] == "tr-stale-precrash"
     finally:
         sched.close()
 

@@ -1,0 +1,226 @@
+"""Schema v12 (request-trace spans) + v1–v11 back-compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..11}.py.
+Here:
+
+- the v12 addition round-trips: ``span`` records one node of a
+  request's span tree (trace_id/span_id/parent_id, name, start/end,
+  attrs — docs/OBSERVABILITY.md "Request tracing & SLOs");
+- the committed v12 fixture is a REAL traced serve run — three
+  completed requests plus a deadline cancel, with queue/chunk/commit
+  spans, root-span decompositions, and trace_ids on the serve events;
+- **back-compat**: all ELEVEN committed fixtures — PR 2 (v1) through
+  PR 17 (v12) — still load, merge, and render in one ``summarize``
+  pass (exit 0) with the trace census line;
+- a stream from a FUTURE schema fails loudly ("newer than this reader
+  supports", exit 2) instead of KeyError'ing deep in a consumer;
+- the ``gol_serve_queue_wait_seconds``/``gol_serve_stall_fraction``
+  histograms are fed from the same span records (single source of
+  truth with `telemetry trace`).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+import pytest
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+    9: DATA / "telemetry_v9" / "pr12run.rank0.jsonl",
+    11: DATA / "telemetry_v11" / "pr14run.rank0.jsonl",
+    12: DATA / "telemetry_v12" / "pr17run.rank0.jsonl",
+}
+
+
+def _v12_stream(directory, run_id="v12"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header({"driver": "serve", "engine": "auto", "slots": 4})
+        ev.span_event(
+            "tr-a-1", "a", "q#1", "queue", 1.0, 1.5,
+            parent_id="root", attrs={"bucket": "32x32/bitpack"},
+        )
+        ev.span_event(
+            "tr-a-1", "a", "q#2", "chunk", 1.5, 2.0,
+            parent_id="root",
+            attrs={"co_resident": 2, "utilization": 0.5, "take": 4},
+        )
+        ev.span_event(
+            "tr-a-1", "a", "root", "request", 1.0, 2.0,
+            attrs={
+                "status": "done", "e2e_s": 1.0, "queue_s": 0.5,
+                "compute_s": 0.25, "interference_s": 0.25,
+                "hedge_s": 0.0, "stall_s": 0.0,
+            },
+        )
+        return ev.path
+
+
+def test_v12_span_roundtrip(tmp_path):
+    path = _v12_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 12
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 13))
+    spans = [r for r in recs if r["event"] == "span"]
+    assert [s["name"] for s in spans] == ["queue", "chunk", "request"]
+    assert all(s["trace_id"] == "tr-a-1" for s in spans)
+    assert spans[0]["parent_id"] == "root"
+    assert spans[2]["span_id"] == "root"
+    assert "parent_id" not in spans[2]  # the root has no parent
+    assert spans[1]["attrs"]["co_resident"] == 2
+    assert spans[2]["attrs"]["stall_s"] == 0.0
+
+
+def test_span_event_validates_required_fields(tmp_path):
+    with telemetry.EventLog(
+        str(tmp_path), run_id="bad", process_index=0
+    ) as ev:
+        ev.run_header({})
+        with pytest.raises(telemetry.SchemaError, match="span"):
+            ev.emit("span", trace_id="t", request_id="r")  # no ids/times
+
+
+def test_committed_fixture_schemas():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v12_fixture_is_a_real_traced_serve_run():
+    """The committed stream came from a real scheduler run: three
+    completed requests and one deadline cancel, each with a complete
+    span tree whose decomposition phases sum to its e2e latency."""
+    recs = [json.loads(ln) for ln in FIXTURES[12].open()]
+    assert recs[0]["config"]["driver"] == "serve"
+    spans = [r for r in recs if r["event"] == "span"]
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    assert len(by_trace) == 4
+    serve = [r for r in recs if r["event"] == "serve"]
+    # Cross-correlation: every admit carries the trace_id its spans use.
+    admit_tids = {
+        r["trace_id"] for r in serve if r["action"] == "admit"
+    }
+    assert admit_tids == set(by_trace)
+    statuses = []
+    for tid, tree in by_trace.items():
+        ids = {s["span_id"] for s in tree}
+        assert "root" in ids
+        # No orphans: every parent resolves within the trace.
+        assert all(
+            s.get("parent_id") is None or s["parent_id"] in ids
+            for s in tree
+        )
+        root = next(s for s in tree if s["span_id"] == "root")
+        a = root["attrs"]
+        statuses.append(a["status"])
+        parts = (
+            a["queue_s"] + a["compute_s"] + a["interference_s"]
+            + a["hedge_s"] + a["stall_s"]
+        )
+        assert parts == pytest.approx(a["e2e_s"], rel=0.01, abs=1e-5)
+    assert statuses.count("done") == 3 and statuses.count("expired") == 1
+    chunk_spans = [s for s in spans if s["name"] == "chunk"]
+    assert chunk_spans and all(
+        s["attrs"]["co_resident"] >= 1 and s["attrs"]["take"] >= 1
+        for s in chunk_spans
+    )
+    # Chunk utilization comes from the roofline model, not a placeholder.
+    assert any(
+        isinstance(s["attrs"].get("utilization"), float)
+        for s in chunk_spans
+    )
+
+
+def test_v1_to_v12_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v12_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "pr12run", "pr14run", "pr17run", "v12",
+    ):
+        assert run_id in out
+    assert "trace:" in out and "`telemetry trace`" in out
+
+
+def test_future_schema_fails_loudly_not_keyerror(tmp_path, capsys):
+    """A stream one schema ahead of this reader must exit 2 with a
+    "newer than supported" message — never a KeyError from a consumer
+    touching a field it has never heard of."""
+    future = telemetry.SCHEMA_VERSION + 1
+    (tmp_path / "fut.rank0.jsonl").write_text(
+        json.dumps(
+            {
+                "event": "run_header", "t": 0.0, "schema": future,
+                "run_id": "fut", "process_index": 0, "process_count": 1,
+                "config": {},
+            }
+        )
+        + "\n"
+        # A record type this reader has no REQUIRED_FIELDS entry for —
+        # the version check must fire before anything touches it.
+        + json.dumps(
+            {"event": "from_the_future", "t": 1.0, "wormhole": True}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert f"schema v{future} is newer than this reader supports" in err
+    assert f"max v{telemetry.SCHEMA_VERSION}" in err
+
+
+def test_bogus_nonint_schema_still_exits_2(tmp_path):
+    (tmp_path / "bad.rank0.jsonl").write_text(
+        json.dumps(
+            {"event": "run_header", "t": 0.0, "schema": "twelve",
+             "run_id": "bad", "process_index": 0, "process_count": 1,
+             "config": {}}
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+
+
+def test_span_metrics_histograms(tmp_path):
+    """gol_serve_queue_wait_seconds / gol_serve_stall_fraction are fed
+    from the SAME span records the JSONL carries — and stay absent
+    until a span is observed."""
+    from gol_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    assert "gol_serve_queue_wait_seconds" not in reg.render()
+    assert "gol_serve_stall_fraction" not in reg.render()
+    for ln in open(_v12_stream(tmp_path)):
+        reg.observe(json.loads(ln))
+    text = reg.render()
+    # The 0.5 s queue wait lands in the first le >= 0.5 bucket.
+    assert 'gol_serve_queue_wait_seconds_bucket{le="0.5"} 1' in text
+    assert 'gol_serve_queue_wait_seconds_bucket{le="0.1"} 0' in text
+    assert "gol_serve_queue_wait_seconds_sum 0.5" in text
+    assert "gol_serve_queue_wait_seconds_count 1" in text
+    # stall_s 0.0 over e2e 1.0 -> fraction 0, the lowest bucket.
+    assert 'gol_serve_stall_fraction_bucket{le="0.01"} 1' in text
+    assert "gol_serve_stall_fraction_count 1" in text
