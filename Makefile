@@ -2,13 +2,14 @@
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
 # smoke + halo smoke + chaos smoke + serve smoke + elastic smoke +
-# lockcheck + trace smoke + tier-1 tests (see scripts/check.sh).
+# lockcheck + trace smoke + tier-1 tests + postmortem smoke (see
+# scripts/check.sh).
 
 .PHONY: lint verify lockcheck test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
 	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke \
-	trace-smoke
+	trace-smoke postmortem-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -137,6 +138,15 @@ trace-smoke:
 	    tests/data/telemetry_v12 --perfetto /tmp/_trace_export.json
 	python scripts/validate_trace_export.py /tmp/_trace_export.json \
 	    docs/schemas/perfetto_trace.schema.json
+
+# Black-box postmortem smoke (docs/OBSERVABILITY.md "Black box &
+# postmortems"): crash a REAL server via the fault plane, validate the
+# *.blackbox.jsonl dump, run `telemetry postmortem` and assert the
+# verdict names the open request; the supervised replay then keeps the
+# verdict's promise; a graceful drain leaves no dump; a future-schema
+# dump refuses with exit 2.
+postmortem-smoke:
+	JAX_PLATFORMS=cpu python scripts/postmortem_smoke.py
 
 # Open-loop serving load curve -> SERVE_r{N}.json (CPU: admission /
 # queue dynamics; the TPU headline command is pinned in the note).

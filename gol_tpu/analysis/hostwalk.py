@@ -349,7 +349,8 @@ class Env:
     locals: Dict[str, Tuple[str, str]] = dataclasses.field(
         default_factory=dict
     )
-    # "Class.method" -> ClassName returned (reviewed modeling table)
+    # "Class.method" or bare module-function name -> ClassName returned
+    # (reviewed modeling table)
     returns: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     @property
@@ -392,8 +393,20 @@ def infer(expr, env: Env) -> Optional[Tuple[str, str]]:
         name = _tail_name(fn)
         if name in prog.classes:
             return ("plain", name)
+        if isinstance(fn, ast.Name):
+            # Module-function accessor from the modeling table, e.g.
+            # blackbox.recorder() -> FlightRecorder.
+            ret = env.returns.get(fn.id)
+            if ret is not None:
+                return ("plain", ret)
         if isinstance(fn, ast.Attribute):
             recv = infer(fn.value, env)
+            if recv is None:
+                # Same accessor reached through a module alias
+                # (blackbox.recorder() from serve.server).
+                ret = env.returns.get(name)
+                if ret is not None:
+                    return ("plain", ret)
             if recv is not None:
                 if recv[0] == "ctr" and name in _CONTAINER_ELT_METHODS:
                     return ("plain", recv[1])

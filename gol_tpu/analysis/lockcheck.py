@@ -112,6 +112,7 @@ def default_lock_matrix() -> List[LockCellConfig]:
         ("serve.main", os.path.join(_PKG_DIR, "serve", "__main__.py")),
         _pkg("telemetry/__init__.py"),
         _pkg("telemetry/metrics.py"),
+        _pkg("telemetry/blackbox.py"),
         _pkg("resilience/health.py"),
         _pkg("resilience/degrade.py"),
         _pkg("resilience/faults.py"),
@@ -121,6 +122,7 @@ def default_lock_matrix() -> List[LockCellConfig]:
         _pkg("utils/checkpoint.py"),
         _pkg("telemetry/__init__.py"),
         _pkg("telemetry/metrics.py"),
+        _pkg("telemetry/blackbox.py"),
         _pkg("resilience/degrade.py"),
         _pkg("resilience/faults.py"),
     ]
@@ -144,10 +146,14 @@ def default_lock_matrix() -> List[LockCellConfig]:
                 "HealthMonitor": "ServeScheduler._lock",
                 "EventLog": "ServeScheduler._lock",
                 "MetricsRegistry": "MetricsRegistry._lock",
+                "FlightRecorder": "FlightRecorder._lock",
             },
             returns={
                 "ServeScheduler.get_result": "RequestState",
                 "ServeScheduler.submit": "RequestState",
+                # blackbox module accessors: the process-default ring.
+                "recorder": "FlightRecorder",
+                "install": "FlightRecorder",
             },
             callbacks={
                 "EventLog.observer": ["MetricsRegistry.observe"],
@@ -165,6 +171,11 @@ def default_lock_matrix() -> List[LockCellConfig]:
                 "EventLog": None,
                 "MetricsRegistry": "MetricsRegistry._lock",
                 "AsyncSnapshotWriter": None,
+                "FlightRecorder": "FlightRecorder._lock",
+            },
+            returns={
+                "recorder": "FlightRecorder",
+                "install": "FlightRecorder",
             },
             callbacks={
                 "EventLog.observer": ["MetricsRegistry.observe"],

@@ -11,12 +11,18 @@ analog of the PR 4 resume path.
 
 Entries land as ``*-cache`` files; :func:`cache_entries` counts them so
 harnesses can assert hit/miss behavior without parsing JAX internals.
+:class:`CompileCacheProbe` turns that countable signal into the
+per-compile ``cache_hit``/``cache_key`` stamp on schema-v13 ``compile``
+events (docs/OBSERVABILITY.md): snapshot the entry set before a
+compile, diff after — a new entry means the compile MISSED and its
+filename is the persistent key; an unchanged set means XLA read an
+existing entry (hit).
 """
 
 from __future__ import annotations
 
 import os
-from typing import List
+from typing import List, Optional, Tuple
 
 
 def enable_compile_cache(directory: str) -> str:
@@ -57,3 +63,48 @@ def cache_entries(directory: str) -> List[str]:
     if not os.path.isdir(directory):
         return []
     return sorted(f for f in os.listdir(directory) if f.endswith("-cache"))
+
+
+def active_cache_dir() -> Optional[str]:
+    """The configured persistent-cache directory, or None (cache off)."""
+    import jax
+
+    try:
+        return jax.config.jax_compilation_cache_dir or None
+    except AttributeError:  # pragma: no cover - ancient jax
+        return None
+
+
+class CompileCacheProbe:
+    """Hit/miss verdict for exactly one compile.
+
+    Construct immediately before ``lowered.compile()``, call
+    :meth:`resolve` immediately after: ``(cache_hit, cache_key)`` where
+    ``cache_hit`` is None when no cache directory is configured (the
+    compile event then omits the stamp entirely), False with the new
+    entry's filename as the key when the compile wrote an entry, and
+    True (key None — XLA does not say which entry it read; the key is
+    stamped by the miss that wrote it) when the entry set is unchanged.
+    Entirely filesystem-side: zero effect on the compiled program, so
+    probe on/off is trace-identity trivial.
+    """
+
+    def __init__(self) -> None:
+        self.directory = active_cache_dir()
+        self._before = (
+            None
+            if self.directory is None
+            else set(cache_entries(self.directory))
+        )
+
+    def resolve(self) -> Tuple[Optional[bool], Optional[str]]:
+        if self.directory is None:
+            return None, None
+        new = [
+            e
+            for e in cache_entries(self.directory)
+            if e not in self._before
+        ]
+        if new:
+            return False, new[0]
+        return True, None

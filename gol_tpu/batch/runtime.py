@@ -351,6 +351,7 @@ class GolBatchRuntime:
         import time as time_mod
 
         from gol_tpu import telemetry as telemetry_mod
+        from gol_tpu.batch import cache as cache_mod
 
         evolvers = {}
         for bucket_id, bucket in enumerate(self.buckets):
@@ -378,6 +379,7 @@ class GolBatchRuntime:
                 args = (stack_spec, vec_spec, vec_spec) if masked else (
                     stack_spec,
                 )
+                probe = cache_mod.CompileCacheProbe()
                 with telemetry_mod.trace_annotation(
                     f"gol.batch.compile.{bucket_id}.{take}"
                 ):
@@ -390,12 +392,15 @@ class GolBatchRuntime:
                 if events is not None:
                     from gol_tpu.telemetry import stats as stats_mod
 
+                    cache_hit, cache_key = probe.resolve()
                     events.compile_event(
                         take,
                         t1 - t0,
                         t2 - t1,
                         memory=stats_mod.compiled_memory(compiled),
                         batch=self._batch_block(bucket_id),
+                        cache_hit=cache_hit,
+                        cache_key=cache_key,
                     )
         return evolvers
 
@@ -417,6 +422,13 @@ class GolBatchRuntime:
         from gol_tpu import telemetry as telemetry_mod
 
         events = telemetry_mod.EventLog(self.telemetry_dir, run_id=self.run_id)
+        # Arm the black box: dumps land next to the stream (unhandled
+        # exception + fault-plane crash.exit triggers).
+        telemetry_mod.blackbox.install(
+            self.telemetry_dir,
+            run_id=events.run_id,
+            process_index=events.process_index,
+        )
         if self.metrics_port is not None:
             # Single-process by CLI validation; attach before the header
             # emits so the registry sees every record.

@@ -754,8 +754,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             with sw.phase("compile"):
                 import time as time_mod
 
+                from gol_tpu.batch import cache as cache_mod
+
                 evolvers = {}
                 for take in set(schedule):
+                    probe = cache_mod.CompileCacheProbe()
                     t0 = time_mod.perf_counter()
                     evolvers[take] = _build_evolver(
                         ns.engine, mesh, take, rule, size, stats=ns.stats,
@@ -767,6 +770,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         # schema v2, the compiled memory footprint).
                         from gol_tpu.telemetry import stats as stats_mod
 
+                        cache_hit, cache_key = probe.resolve()
                         events.compile_event(
                             take,
                             0.0,
@@ -774,6 +778,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                             memory=stats_mod.compiled_memory(
                                 evolvers[take][0]
                             ),
+                            cache_hit=cache_hit,
+                            cache_key=cache_key,
                         )
                 place = evolvers[schedule[0]][1]
                 board = placed if placed is not None else place(vol)

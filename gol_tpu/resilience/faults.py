@@ -544,16 +544,38 @@ def rank_slowdown(generation: int) -> float:
 # -- site: the process -------------------------------------------------------
 
 
+# Crash-forensics hook (gol_tpu/telemetry/blackbox.py registers the
+# black-box dump here): ``os._exit`` skips flushes and atexit by
+# design, so the window between firing ``crash.exit`` and dying is the
+# ONLY place a flight-recorder dump can happen.  The hook must never
+# raise (it runs on the death path) — failures are swallowed so the
+# crash semantics stay exact.
+_crash_hook = None
+
+
+def register_crash_hook(hook) -> None:
+    """``hook(site, generation, code)`` runs just before a
+    ``crash.exit`` os._exit.  One slot — last registration wins."""
+    global _crash_hook
+    _crash_hook = hook
+
+
 def crash_or_stall(generation: int) -> None:
     """Chunk-boundary process faults: ``rank.stall`` sleeps ``delay_s``
     (recorded, so telemetry shows the stall), ``crash.exit`` dies on the
     spot via ``os._exit`` — no flushes, no atexit: the closest
     in-process stand-in for a machine loss, and exactly what the
-    supervisor's restart budget exists for."""
+    supervisor's restart budget exists for.  The registered crash hook
+    (black-box dump) is the one forensic exception."""
     spec = fire("rank.stall", generation)
     if spec is not None and spec.delay_s > 0:
         time.sleep(spec.delay_s)
     spec = fire("crash.exit", generation)
     if spec is not None:
         code = spec.value if spec.value >= 0 else 1
+        if _crash_hook is not None:
+            try:
+                _crash_hook("crash.exit", generation, code)
+            except Exception:
+                pass
         os._exit(code)

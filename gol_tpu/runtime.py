@@ -1141,6 +1141,9 @@ class GolRuntime:
                 static = ()
             else:
                 fn, dynamic, static = self._evolve_fn(take)
+            from gol_tpu.batch import cache as cache_mod
+
+            probe = cache_mod.CompileCacheProbe()
             with telemetry_mod.trace_annotation(f"gol.compile.{take}"):
                 t0 = time_mod.perf_counter()
                 lowered = fn.lower(*specs, *dynamic, *static)
@@ -1151,11 +1154,14 @@ class GolRuntime:
             if events is not None:
                 from gol_tpu.telemetry import stats as stats_mod
 
+                cache_hit, cache_key = probe.resolve()
                 events.compile_event(
                     take,
                     t1 - t0,
                     t2 - t1,
                     memory=stats_mod.compiled_memory(compiled),
+                    cache_hit=cache_hit,
+                    cache_key=cache_key,
                 )
         force_ready(board)
         return evolvers
@@ -1169,6 +1175,14 @@ class GolRuntime:
         from gol_tpu import telemetry as telemetry_mod
 
         events = telemetry_mod.EventLog(self.telemetry_dir, run_id=self.run_id)
+        # Arm the black box for this run: dumps land next to the stream
+        # (unhandled exception, fault-plane crash.exit — the signal
+        # triggers belong to entry points that own their handlers).
+        telemetry_mod.blackbox.install(
+            self.telemetry_dir,
+            run_id=events.run_id,
+            process_index=events.process_index,
+        )
         if self.metrics_port is not None and jax.process_index() == 0:
             # Attach before the header emits so the registry sees every
             # record; the server rides events.close() (rank 0 only — the

@@ -140,6 +140,20 @@ def main(argv=None) -> int:
     server = ServeServer(scheduler, ns.port, registry=registry)
     stop = server.stop_event
 
+    # Arm the black box's signal triggers (SIGABRT, faulthandler) BEFORE
+    # installing the graceful handler: _graceful then replaces the
+    # SIGTERM disposition, so a drain exits 0 with no dump while an
+    # abort still leaves one (docs/OBSERVABILITY.md "Black box").
+    from gol_tpu.telemetry import blackbox
+
+    # run_id/dump-dir identity was configured by the scheduler's own
+    # install; this call only arms the signal layer on top of it.
+    blackbox.install(
+        telemetry_dir or ns.state_dir,
+        process_index=0,
+        signals=True,
+    )
+
     def _graceful(signum, frame):
         scheduler.drain()
         stop.set()

@@ -89,6 +89,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from gol_tpu.serve import journal as journal_mod
+from gol_tpu.telemetry import blackbox
 from gol_tpu.telemetry import trace as trace_mod
 
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
@@ -218,6 +219,8 @@ class ServeScheduler:
         compact_every: int = 16,
         mesh_devices: int = 0,
         health=None,
+        storm_window_s: float = 10.0,
+        storm_threshold: int = 4,
     ) -> None:
         from gol_tpu.resilience import faults as faults_mod
 
@@ -279,6 +282,21 @@ class ServeScheduler:
         self._health = health
         self._complete_times: collections.deque = collections.deque(maxlen=32)
 
+        # Compile observability (docs/SERVING.md, "Compile storms"):
+        # the scheduler AOT-compiles one executable per (bucket shape,
+        # engine, take, mesh width) and caches it here — a cold entry
+        # stamps a v13 ``compile`` event with the persistent-cache
+        # verdict and feeds the storm detector: K cold compiles inside
+        # one ``storm_window_s`` admission window emit a ``storm``
+        # event and halve the admission queue depth until the window
+        # drains (bucketed serving's classic cold-start failure mode).
+        self.storm_window_s = storm_window_s
+        self.storm_threshold = storm_threshold
+        self._programs: Dict[tuple, object] = {}
+        self._cold_compiles: collections.deque = collections.deque()
+        self._storm_until = 0.0
+        self.storms_total = 0
+
         self._registry = registry
         self._events = None
         if telemetry_dir:
@@ -289,6 +307,7 @@ class ServeScheduler:
             )
             if registry is not None:
                 self._events.observer = registry.observe
+                self._events.on_shed = registry.count_shed
             header = {
                 "driver": "serve",
                 "engine": default_engine,
@@ -304,6 +323,18 @@ class ServeScheduler:
             attempt = _restart_attempt()
             if attempt > 0:
                 self._events.restart_event(attempt)
+        # Arm the black box (docs/OBSERVABILITY.md): dumps land next to
+        # the stream when telemetry is on, next to the journal when it
+        # is off — the recorder itself rings either way.  Signal
+        # triggers belong to the entry point (serve.__main__), which
+        # owns its handlers.
+        blackbox.install(
+            telemetry_dir or state_dir,
+            run_id=(
+                self._events.run_id if self._events is not None else run_id
+            ),
+            process_index=0,
+        )
 
         # Span ids are epoch-prefixed by run id so a crash-replayed
         # request's pre- and post-crash spans (same trace_id, different
@@ -640,11 +671,18 @@ class ServeScheduler:
     def _effective_queue_depth(self) -> int:
         """Admission depth, throttled proportional to lost capacity:
         with half the devices dead, each bucket accepts half its queue
-        (never below one slot — the tier keeps serving)."""
-        if self._health is None or self.mesh_devices <= 0:
-            return self.queue_depth
-        frac = len(self._health.alive) / float(self.mesh_devices)
-        return max(1, int(self.queue_depth * frac))
+        (never below one slot — the tier keeps serving).  A compile
+        storm (docs/SERVING.md, "Compile storms") additionally halves
+        the depth until its window drains: new bucket shapes are what
+        drive cold compiles, so slowing admissions is what lets the
+        warmed programs catch up."""
+        depth = self.queue_depth
+        if self._health is not None and self.mesh_devices > 0:
+            frac = len(self._health.alive) / float(self.mesh_devices)
+            depth = max(1, int(depth * frac))
+        if self.storm_active():
+            depth = max(1, depth // 2)
+        return depth
 
     def _depths(self) -> dict:
         return {
@@ -934,8 +972,115 @@ class ServeScheduler:
                 [a.fingerprint for a in audits],
             )
 
-    def _step_group(self, grp: _BucketGroup) -> None:
+    def _compiled_program(self, grp: _BucketGroup, take: int):
+        """The AOT executable for one (bucket shape, engine, take, mesh
+        width) — compilation as a first-class observable: a cold entry
+        is lowered + compiled explicitly (the same AOT discipline as
+        :meth:`GolBatchRuntime.compile_evolvers`, so chunk walls measure
+        steady-state execution, never a hidden first-call trace), stamps
+        a v13 ``compile`` event carrying the persistent-cache verdict,
+        and feeds the compile-storm detector."""
+        key = (grp.shape, grp.engine, len(grp.slots), take, self._cur_n)
+        cached = self._programs.get(key)
+        if cached is not None:
+            return cached
+        import jax
+
+        from gol_tpu.batch import cache as cache_mod
         from gol_tpu.batch import engines as batch_engines
+        from gol_tpu.models.state import CELL_DTYPE
+
+        jitted = batch_engines.compiled_batch_evolver(
+            grp.engine, take, True, self.tile_hint, self._cur_mesh
+        )
+        H, W = grp.shape
+        S = len(grp.slots)
+        if self._cur_mesh is not None:
+            stack_spec = jax.ShapeDtypeStruct(
+                (S, H, W),
+                CELL_DTYPE,
+                sharding=batch_engines.batch_sharding(self._cur_mesh),
+            )
+            vec_sharding = jax.sharding.NamedSharding(
+                self._cur_mesh,
+                jax.sharding.PartitionSpec(batch_engines.WORLDS),
+            )
+            vec_spec = jax.ShapeDtypeStruct(
+                (S,), np.int32, sharding=vec_sharding
+            )
+        else:
+            stack_spec = jax.ShapeDtypeStruct((S, H, W), CELL_DTYPE)
+            vec_spec = jax.ShapeDtypeStruct((S,), np.int32)
+        probe = cache_mod.CompileCacheProbe()
+        t0 = time.perf_counter()
+        lowered = jitted.lower(stack_spec, vec_spec, vec_spec)
+        t1 = time.perf_counter()
+        executable = lowered.compile()
+        t2 = time.perf_counter()
+        cache_hit, cache_key = probe.resolve()
+        self._programs[key] = executable
+        fields = dict(
+            chunk=take,
+            lower_s=t1 - t0,
+            compile_s=t2 - t1,
+            batch={
+                "bucket": list(grp.shape),
+                "B": S,
+                "masked": True,
+                "engine": grp.engine,
+            },
+        )
+        if cache_hit is not None:
+            fields["cache_hit"] = cache_hit
+            fields["cache_key"] = cache_key
+        if self._events is not None:
+            self._events.emit("compile", **fields)
+        else:
+            blackbox.record_event("compile", **fields)
+        if cache_hit is not True:
+            # Persistent-cache hits are fast loads, not cold compiles:
+            # a supervised restart against a hot cache must never read
+            # as a storm (docs/SERVING.md "Compile storms").
+            self._note_cold_compile()
+        return executable
+
+    def _note_cold_compile(self) -> None:
+        """One cold compile landed: slide the storm window, and past K
+        inside it emit the v13 ``storm`` event and engage the admission
+        throttle until the window drains."""
+        now = time.time()
+        w = self.storm_window_s
+        self._cold_compiles.append(now)
+        while self._cold_compiles and self._cold_compiles[0] < now - w:
+            self._cold_compiles.popleft()
+        if (
+            len(self._cold_compiles) >= self.storm_threshold
+            and now >= self._storm_until
+        ):
+            self._storm_until = now + w
+            self.storms_total += 1
+            fields = dict(
+                kind="compile",
+                count=len(self._cold_compiles),
+                window_s=w,
+                threshold=self.storm_threshold,
+                generation=self._total_gens,
+                throttled=True,
+            )
+            if self._events is not None:
+                self._events.emit("storm", **fields)
+            else:
+                blackbox.record_event("storm", **fields)
+                if self._registry is not None:
+                    self._registry.observe(
+                        {"event": "storm", "t": now, **fields}
+                    )
+
+    def storm_active(self) -> bool:
+        """True while the compile-storm admission throttle is engaged."""
+        return time.time() < self._storm_until
+
+    def _step_group(self, grp: _BucketGroup) -> None:
         from gol_tpu.resilience import faults as faults_mod
         from gol_tpu.utils import guard as guard_mod
         from gol_tpu.utils.timing import force_ready
@@ -946,9 +1091,7 @@ class ServeScheduler:
         take = min(
             self.chunk, min(s.remaining for _, s in active)
         )
-        compiled = batch_engines.compiled_batch_evolver(
-            grp.engine, take, True, self.tile_hint, self._cur_mesh
-        )
+        compiled = self._compiled_program(grp, take)
         if grp.stack is None:
             self._build_stack(grp)
         world_ids = tuple(
@@ -986,6 +1129,20 @@ class ServeScheduler:
                 for k, s in active:
                     self._events.guard_event(
                         audits[k], world=s.ordinal, bucket=grp.label,
+                        request_id=s.request.id,
+                    )
+            else:
+                # No file sink: the audits still ring in the black box
+                # (a postmortem's "last guard audit" must exist for
+                # every process, not just instrumented ones).
+                for k, s in active:
+                    a = audits[k]
+                    blackbox.record_event(
+                        "guard_audit",
+                        generation=a.generation, ok=a.ok,
+                        max_cell=a.max_cell, population=a.population,
+                        fingerprint=a.fingerprint,
+                        world=s.ordinal, bucket=grp.label,
                         request_id=s.request.id,
                     )
             bad = [k for k, s in active if not audits[k].ok]
@@ -1064,19 +1221,29 @@ class ServeScheduler:
         for _, s in active:
             s.remaining -= take
             s.generation += take
+        cells = sum(
+            s.request.size * s.request.size for _, s in active
+        )
+        batch_block = {
+            "bucket": list(grp.shape),
+            "B": len(grp.slots),
+            "masked": True,
+            "engine": grp.engine,
+        }
         if self._events is not None:
-            cells = sum(
-                s.request.size * s.request.size for _, s in active
-            )
             self._events.chunk_event(
                 self._chunk_index, take, grp.gens, wall,
-                cells * take, util,
-                batch={
-                    "bucket": list(grp.shape),
-                    "B": len(grp.slots),
-                    "masked": True,
-                    "engine": grp.engine,
-                },
+                cells * take, util, batch=batch_block,
+            )
+        else:
+            blackbox.record_event(
+                "chunk",
+                index=self._chunk_index, take=take, generation=grp.gens,
+                wall_s=wall,
+                updates_per_sec=(
+                    (cells * take / wall) if wall > 0 else 0.0
+                ),
+                roofline_util=util, batch=batch_block,
             )
         self._chunk_index += 1
         if (
@@ -1200,14 +1367,15 @@ class ServeScheduler:
                 generation=self._total_gens, live=True,
                 **plan.summary(), **extra,
             )
-        elif self._registry is not None:
-            self._registry.observe(
-                {
-                    "event": "reshard", "t": time.time(),
-                    "generation": self._total_gens, "live": True,
-                    **plan.summary(), **extra,
-                }
-            )
+            return
+        rec = {
+            "event": "reshard", "t": time.time(),
+            "generation": self._total_gens, "live": True,
+            **plan.summary(), **extra,
+        }
+        blackbox.record(rec)
+        if self._registry is not None:
+            self._registry.observe(rec)
 
     def _hedge_replay(
         self, grp: _BucketGroup, compiled, pre_good, candidate, audits,
@@ -1352,23 +1520,29 @@ class ServeScheduler:
     # -- internals: telemetry ------------------------------------------------
     def _emit(self, action: str, request_id: str, **extra) -> None:
         if self._events is not None:
+            # The EventLog's own emit() taps the black-box ring.
             self._events.serve_event(action, request_id, **extra)
-        elif self._registry is not None:
-            self._registry.observe(
-                {
-                    "event": "serve", "t": time.time(),
-                    "action": action, "request_id": request_id,
-                    **extra,
-                }
-            )
+            return
+        rec = {
+            "event": "serve", "t": time.time(),
+            "action": action, "request_id": request_id,
+            **extra,
+        }
+        blackbox.record(rec)
+        if self._registry is not None:
+            self._registry.observe(rec)
 
     def _drain_plane(self) -> None:
         from gol_tpu.resilience import degrade as degrade_mod
         from gol_tpu.resilience import faults as faults_mod
 
         if self._events is None:
-            faults_mod.drain_fired()
-            degrade_mod.drain_reports()
+            # No file sink: the fired/degraded ledgers still ring in
+            # the black box instead of vanishing.
+            for f in faults_mod.drain_fired():
+                blackbox.record_event("fault", **f)
+            for d in degrade_mod.drain_reports():
+                blackbox.record_event("degraded", **d)
             return
         for f in faults_mod.drain_fired():
             self._events.fault_event(**f)

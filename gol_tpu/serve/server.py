@@ -22,6 +22,9 @@ Endpoints::
                         admissions are shed, or through a live-reshard
                         window (docs/RESILIENCE.md "Live elasticity")
     GET  /metrics    -> Prometheus text (the gol_serve_* gauges)
+    GET  /debug/blackbox -> ndjson snapshot of the flight-recorder
+                        ring (schema v13, same bytes a crash dump
+                        would write) | 404 recorder disabled
     POST /shutdown   -> 200, then graceful drain: stop admitting,
                         finish every committed request, exit 0
 
@@ -116,12 +119,31 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/debug/blackbox":
+            # On-demand flight-recorder dump: the exact lines a crash
+            # would write, straight from the in-memory ring — no disk
+            # IO, so it works even when the telemetry dir is shed.
+            from gol_tpu.telemetry import blackbox
+
+            rec = blackbox.recorder()
+            if rec is None:
+                self.send_error(404, "black-box recorder disabled")
+                return
+            body = (
+                "\n".join(rec.dump_lines("debug.endpoint")) + "\n"
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif path.startswith("/result/"):
             self._result(path[len("/result/"):])
         else:
             self.send_error(
                 404,
-                "routes: /simulate /result/<id> /healthz /readyz /metrics",
+                "routes: /simulate /result/<id> /healthz /readyz "
+                "/metrics /debug/blackbox",
             )
 
     def do_POST(self):  # noqa: N802 - http.server API

@@ -63,7 +63,30 @@ import os
 import time
 from typing import Dict, Optional
 
-# Version 12 (this round) adds the request-scoped tracing plane
+# The always-on flight recorder (docs/OBSERVABILITY.md, "Black box &
+# postmortems").  Imported eagerly so the ring tap in :meth:`EventLog.
+# emit` is one attribute lookup; blackbox itself imports this package
+# only lazily (inside its dump path), so there is no cycle.
+from gol_tpu.telemetry import blackbox
+
+# Version 13 (this round) makes the process a black box and compilation
+# a first-class observable (docs/OBSERVABILITY.md, "Black box &
+# postmortems"): :mod:`gol_tpu.telemetry.blackbox` keeps a bounded
+# in-memory ring of the last N records — every event the v12 stream
+# would carry, captured even when no EventLog file sink is attached —
+# and dumps it as a ``<run_id>.blackbox.jsonl`` file on unhandled
+# exception, fatal signal, fault-plane ``crash.exit``, or on demand
+# (serve's ``GET /debug/blackbox``); ``python -m gol_tpu.telemetry
+# postmortem <dir>`` cross-checks a dump against the journal fold and
+# renders a one-page verdict.  On the stream itself, v13 adds a
+# ``storm`` record (the scheduler's compile-storm detector: K cold
+# compiles inside one admission window — ``kind``, ``count``,
+# ``window_s``, ``threshold``), stamps ``compile`` events with the
+# persistent-cache outcome (optional ``cache_hit`` / ``cache_key``,
+# :mod:`gol_tpu.batch.cache`), and lets a shedding EventLog leave one
+# last best-effort ``degraded`` record carrying the per-event-type
+# ``dropped`` census (today shed records vanish silently).
+# Version 12 added the request-scoped tracing plane
 # (docs/OBSERVABILITY.md, "Request tracing & SLOs"): a ``span`` record is
 # one node of a request's span tree — ``trace_id`` (minted at admission,
 # carried on the journal's admit/complete records so crash-replayed
@@ -146,13 +169,13 @@ from typing import Dict, Optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
 # readable: every v1-v11 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1/v2/v3/v4/v5/v6/v7/v8/v9/v10/v11/v12 fixture tests).
+# committed v1..v13 fixture tests).
 # Streams NEWER than this reader refuse loudly: ``validate_record``
 # raises a "schema vN is newer than this reader supports" SchemaError
 # (exit 2 at the CLI) instead of letting a consumer KeyError on a field
 # it has never heard of.
-SCHEMA_VERSION = 12
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+SCHEMA_VERSION = 13
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -163,6 +186,10 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
         {"schema", "run_id", "process_index", "process_count", "config"}
     ),
     # One per distinct chunk size: AOT lowering + compile durations.
+    # v13: optionally carries the persistent-cache outcome — ``cache_hit``
+    # (bool; omitted when no cache directory is configured) and
+    # ``cache_key`` (the new cache entry's key on a miss; null on a hit —
+    # the key is stamped when the entry is written).
     "compile": frozenset({"chunk", "lower_s", "compile_s"}),
     # One per executed chunk (including guard replays): the device wall
     # time between force_ready fences, and the roofline fraction.
@@ -232,6 +259,11 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     "span": frozenset(
         {"trace_id", "request_id", "span_id", "name", "start_t", "end_t"}
     ),
+    # v13: the scheduler's compile-storm detector fired — ``count`` cold
+    # compiles landed inside one ``window_s`` admission window (threshold
+    # K); the admission throttle engages until the window drains
+    # (docs/SERVING.md, "Compile storms").
+    "storm": frozenset({"kind", "count", "window_s", "threshold"}),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -349,11 +381,23 @@ class EventLog:
         # Thread-safe shed request (the disk-full checkpoint policy runs
         # on the async writer thread; file writes stay on this one).
         self._pending_shed: Optional[tuple] = None
+        # v13: drops are counted per event type while shedding (they
+        # still reach observer/on_shed — only the file write is lost),
+        # and close() leaves one last best-effort ``degraded`` record
+        # carrying the census.  ``on_shed`` is the live-counter tap the
+        # metrics registry attaches next to ``observer``
+        # (``gol_telemetry_shed_total``).
+        self.shed_counts: Dict[str, int] = {}
+        self.on_shed = None
 
     # -- envelope -----------------------------------------------------------
     def emit(self, event: str, **fields) -> None:
         rec = {"event": event, "t": time.time(), **fields}
         validate_record(rec)
+        # The black-box ring sees every validated record before the file
+        # does — a crash between here and the write still leaves the
+        # record recoverable from the dump (zero file IO on this tap).
+        blackbox.record(rec)
         self._write_contained(rec)
         if self.observer is not None:
             self.observer(rec)
@@ -364,6 +408,10 @@ class EventLog:
             self._pending_shed = None
             self._stamp_degraded(resource, "shed", reason)
         if self._shed:
+            event = rec["event"]
+            self.shed_counts[event] = self.shed_counts.get(event, 0) + 1
+            if self.on_shed is not None:
+                self.on_shed(rec)
             return
         try:
             if _telemetry_write_hook is not None:
@@ -404,6 +452,7 @@ class EventLog:
         }
         self.degraded = rec
         self._shed = True
+        blackbox.record(rec)
         try:
             self._f.write(json.dumps(rec, sort_keys=True) + "\n")
             self._f.flush()
@@ -413,6 +462,30 @@ class EventLog:
             self.observer(rec)
 
     def close(self) -> None:
+        if self._shed and self.shed_counts:
+            # One last best-effort stamp: how much the shed actually
+            # cost, per event type.  A stream shed by *policy* (disk-full
+            # checkpoint priority) still has a working telemetry disk,
+            # so the census usually lands; a stream shed by a broken
+            # disk loses it from the file but keeps it in the ring,
+            # the observer, and :attr:`degraded`.
+            rec = {
+                "event": "degraded",
+                "t": time.time(),
+                "resource": "telemetry",
+                "action": "shed_summary",
+                "dropped": dict(self.shed_counts),
+                "dropped_total": sum(self.shed_counts.values()),
+            }
+            self.degraded = rec
+            blackbox.record(rec)
+            try:
+                self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                pass
+            if self.observer is not None:
+                self.observer(rec)
         if not self._f.closed:
             self._f.close()
         if self.metrics_server is not None:
@@ -448,6 +521,8 @@ class EventLog:
         compile_s: float,
         memory: Optional[dict] = None,
         batch: Optional[dict] = None,
+        cache_hit: Optional[bool] = None,
+        cache_key: Optional[str] = None,
     ) -> None:
         """``memory`` (v2, optional): the compiled program's
         ``memory_analysis``/``cost_analysis`` distillation
@@ -456,10 +531,17 @@ class EventLog:
         limit compile *durations* never showed.  ``batch`` (v4,
         optional): the bucket this program serves (``bucket`` shape,
         ``B``, ``masked``, resolved ``engine``) — a persistent-cache hit
-        shows as near-zero ``compile_s`` on the same bucket block."""
+        shows as near-zero ``compile_s`` on the same bucket block.
+        ``cache_hit``/``cache_key`` (v13, optional): the persistent
+        compilation cache's verdict for this program
+        (:class:`gol_tpu.batch.cache.CompileCacheProbe`) — omitted
+        entirely when no cache directory is configured."""
         extra = {} if memory is None else {"memory": memory}
         if batch is not None:
             extra["batch"] = batch
+        if cache_hit is not None:
+            extra["cache_hit"] = cache_hit
+            extra["cache_key"] = cache_key
         self.emit(
             "compile", chunk=chunk, lower_s=lower_s, compile_s=compile_s,
             **extra,
@@ -590,6 +672,27 @@ class EventLog:
         bucket/queue_depth/inflight/latency_s/generation detail
         (docs/SERVING.md)."""
         self.emit("serve", action=action, request_id=request_id, **extra)
+
+    def storm_event(
+        self,
+        kind: str,
+        count: int,
+        window_s: float,
+        threshold: int,
+        **extra,
+    ) -> None:
+        """The compile-storm detector fired (v13): ``count`` cold
+        compiles landed inside one ``window_s`` admission window
+        against a threshold of K (docs/SERVING.md, "Compile storms");
+        ``extra`` carries generation/throttled detail."""
+        self.emit(
+            "storm",
+            kind=kind,
+            count=count,
+            window_s=window_s,
+            threshold=threshold,
+            **extra,
+        )
 
     def health_event(
         self, verdict: str, generation: int, **extra

@@ -1,0 +1,549 @@
+"""Always-on black-box flight recorder (docs/OBSERVABILITY.md).
+
+A process that dies with ``--telemetry`` off leaves nothing but a
+journal; one that dies mid-write leaves a stream that ends before the
+interesting part.  This module keeps the last N telemetry records in a
+bounded in-memory ring — every record the v13 stream would carry,
+captured even when no :class:`~gol_tpu.telemetry.EventLog` file sink is
+attached — and turns them into a ``<run_id>.blackbox.jsonl`` dump when
+the process dies:
+
+- **unhandled exception** — a chained ``sys.excepthook``;
+- **fatal signal** — SIGTERM/SIGABRT handlers (installed only where a
+  graceful handler does not own the signal; serve's drain handler
+  deliberately replaces the SIGTERM one, so a drain leaves *no* dump)
+  plus ``faulthandler.enable()`` for the C-level deaths Python
+  handlers cannot see;
+- **fault-plane crash** — :func:`gol_tpu.resilience.faults.
+  crash_or_stall` invokes the registered hook between firing
+  ``crash.exit`` and ``os._exit`` (the one window where "no flushes,
+  no atexit" still permits forensics);
+- **on demand** — serve's ``GET /debug/blackbox`` renders the same
+  lines over HTTP without touching disk.
+
+The hot path is :func:`record`: one lock acquisition and one deque
+append — zero file IO, zero jax interaction (the recorder runs strictly
+host-side after the ``force_ready`` fences, so recorder on/off leaves
+jaxprs byte-equal; pinned by tests/test_blackbox.py).  Memory is
+bounded by construction: ``deque(maxlen=capacity)`` with capacity from
+``GOL_BLACKBOX_RING`` (default 512 records); ``GOL_BLACKBOX=0``
+disables the recorder entirely.
+
+``python -m gol_tpu.telemetry postmortem <dir>`` (:func:`render_
+postmortem`) reconstructs the last seconds before death from a dump —
+final chunks, open spans, last guard audit — cross-checks the journal
+fold (open intents vs. the last recorded serve events), and renders a
+one-page verdict.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENV_DISABLE = "GOL_BLACKBOX"       # "0"/"off" -> recorder disabled
+ENV_RING = "GOL_BLACKBOX_RING"     # ring capacity (records)
+DEFAULT_CAPACITY = 512
+DUMP_SUFFIX = ".blackbox.jsonl"
+
+
+def maybe_wrap(name: str, lock):
+    """lockwatch wrap without importing the (jax-heavy) analysis
+    package at telemetry-import time — the recorder must stay cheap to
+    import from the summarize CLI.  Named ``maybe_wrap`` so hostwalk's
+    see-through pattern still classifies the wrapped attr as a lock."""
+    try:
+        from gol_tpu.analysis import lockwatch
+    except Exception:
+        return lock
+    return lockwatch.maybe_wrap(name, lock)
+
+
+class FlightRecorder:
+    """Bounded ring of the last N validated telemetry records.
+
+    Threading: :meth:`record` is called from every emitting thread (the
+    scheduler drive loop, HTTP handler threads, the async snapshot
+    writer via the degrade plane), :meth:`snapshot`/:meth:`dump` from
+    handler threads and signal/crash context — all ring and identity
+    state is guarded by ``FlightRecorder._lock`` (lockcheck's
+    ``lock/serve`` and ``lock/runtime`` cells cover this module).
+    """
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        run_id: Optional[str] = None,
+        process_index: int = 0,
+    ) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get(ENV_RING, DEFAULT_CAPACITY))
+        self.capacity = max(1, int(capacity))
+        self._lock = maybe_wrap("FlightRecorder._lock", threading.Lock())
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity
+        )
+        self._recorded_total = 0
+        self._run_id = run_id or f"p{os.getpid()}"
+        self._process_index = process_index
+        self._dump_dir: Optional[str] = None
+        self._last_dump_path: Optional[str] = None
+
+    # -- hot path -----------------------------------------------------------
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            self._ring.append(rec)
+            self._recorded_total += 1
+
+    # -- identity (install-time) --------------------------------------------
+    def configure(
+        self,
+        dump_dir: Optional[str] = None,
+        run_id: Optional[str] = None,
+        process_index: Optional[int] = None,
+        capacity: Optional[int] = None,
+    ) -> None:
+        """Update dump identity in place (the ring content survives —
+        records emitted before install are exactly the ones a startup
+        crash needs)."""
+        with self._lock:
+            if dump_dir is not None:
+                self._dump_dir = dump_dir
+            if run_id is not None:
+                self._run_id = run_id
+            if process_index is not None:
+                self._process_index = process_index
+            if capacity is not None and int(capacity) != self.capacity:
+                self.capacity = max(1, int(capacity))
+                self._ring = collections.deque(
+                    self._ring, maxlen=self.capacity
+                )
+
+    # -- dump side ----------------------------------------------------------
+    def snapshot(self) -> Tuple[List[dict], int]:
+        """(records oldest-first, recorded_total) — a consistent copy."""
+        with self._lock:
+            return list(self._ring), self._recorded_total
+
+    def dump_lines(self, reason: str) -> List[str]:
+        """The dump as JSONL lines: a schema-v13 ``run_header`` whose
+        ``config`` block carries the black-box accounting (reason,
+        capacity, total recorded, how many fell off the ring), then the
+        ring verbatim.  Every line passes ``validate_record`` — the
+        postmortem CLI and the smoke gate re-validate them."""
+        from gol_tpu import telemetry
+
+        with self._lock:
+            records = list(self._ring)
+            total = self._recorded_total
+            run_id = self._run_id
+            process_index = self._process_index
+            capacity = self.capacity
+        header = {
+            "event": "run_header",
+            "t": time.time(),
+            "schema": telemetry.SCHEMA_VERSION,
+            "run_id": run_id,
+            "process_index": process_index,
+            "process_count": 1,
+            "config": {
+                "driver": "blackbox",
+                "reason": reason,
+                "capacity": capacity,
+                "recorded_total": total,
+                "dropped": max(0, total - len(records)),
+                "pid": os.getpid(),
+            },
+        }
+        return [
+            json.dumps(r, sort_keys=True)
+            for r in [header] + records
+        ]
+
+    def dump(
+        self, reason: str, directory: Optional[str] = None
+    ) -> Optional[str]:
+        """Write ``<dump_dir>/<run_id>.blackbox.jsonl`` and return its
+        path (rotating a pre-existing dump to ``.N``, same policy as
+        the EventLog rank file).  Returns None with no directory
+        configured.  Never raises — this runs inside excepthooks,
+        signal handlers, and the crash.exit window."""
+        with self._lock:
+            directory = directory or self._dump_dir
+            run_id = self._run_id
+        if not directory:
+            return None
+        try:
+            lines = self.dump_lines(reason)
+            os.makedirs(directory, exist_ok=True)
+            path = os.path.join(directory, f"{run_id}{DUMP_SUFFIX}")
+            if os.path.exists(path):
+                n = 1
+                while os.path.exists(f"{path}.{n}"):
+                    n += 1
+                os.replace(path, f"{path}.{n}")
+            with open(path, "w") as f:
+                f.write("\n".join(lines) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            with self._lock:
+                self._last_dump_path = path
+            return path
+        except Exception:
+            return None
+
+
+# -- the process-default recorder -------------------------------------------
+# None = not yet created; False = disabled by GOL_BLACKBOX=0 (checked
+# once); FlightRecorder otherwise.  Creation races are benign (last
+# writer wins before any dump identity is configured), so the hot path
+# stays a single global read.
+_default = None
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_DISABLE, "1").lower() not in (
+        "0", "off", "false", ""
+    )
+
+
+def recorder() -> Optional[FlightRecorder]:
+    """The process-default recorder (created on first use), or None
+    when ``GOL_BLACKBOX=0`` disabled it."""
+    global _default
+    if _default is None:
+        _default = FlightRecorder() if enabled() else False
+    return _default or None
+
+
+def record(rec: dict) -> None:
+    """Ring-record one already-validated telemetry record.  The tap
+    :meth:`EventLog.emit` calls on every record — and the one emission
+    sites without a file sink call directly."""
+    r = _default
+    if r is None:
+        r = recorder()
+    if r:
+        r.record(rec)
+
+
+def record_event(event: str, **fields) -> None:
+    """Build the standard envelope and ring-record it — for emission
+    sites that have no EventLog attached (the bare scheduler's serve/
+    chunk/guard records, docs/SERVING.md)."""
+    record({"event": event, "t": time.time(), **fields})
+
+
+def reset_for_tests() -> None:
+    """Drop the process-default recorder (tests only)."""
+    global _default
+    _default = None
+
+
+# -- dump triggers -----------------------------------------------------------
+_prev_excepthook = None
+_hooks_installed = False
+
+
+def dump_now(reason: str) -> Optional[str]:
+    """Dump the default ring now; never raises.  The crash-forensics
+    entry point — callable from any context."""
+    r = recorder()
+    if r is None:
+        return None
+    return r.dump(reason)
+
+
+def _excepthook(tp, value, tb):
+    if not issubclass(tp, KeyboardInterrupt):
+        dump_now(f"exception:{tp.__name__}")
+    hook = _prev_excepthook or sys.__excepthook__
+    hook(tp, value, tb)
+
+
+def _signal_dump_handler(signum, frame):
+    import signal as signal_mod
+
+    try:
+        name = signal_mod.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    dump_now(f"signal:{name}")
+    # Re-deliver with the default disposition so the exit status still
+    # says "killed by signal" — the recorder observes, never survives.
+    signal_mod.signal(signum, signal_mod.SIG_DFL)
+    os.kill(os.getpid(), signum)
+
+
+def install(
+    dump_dir: str,
+    run_id: Optional[str] = None,
+    process_index: Optional[int] = None,
+    capacity: Optional[int] = None,
+    signals: bool = False,
+) -> Optional[FlightRecorder]:
+    """Arm the black box: configure the default recorder's dump
+    identity and install the death triggers.
+
+    Idempotent.  ``signals=True`` additionally claims SIGTERM/SIGABRT
+    and enables ``faulthandler`` — only the serve entry point asks for
+    this, and it installs its *graceful* SIGTERM handler afterwards, so
+    a drain never dumps.  Returns the recorder (None when disabled).
+    """
+    r = recorder()
+    if r is None:
+        return None
+    r.configure(
+        dump_dir=dump_dir,
+        run_id=run_id,
+        process_index=process_index,
+        capacity=capacity,
+    )
+    global _prev_excepthook, _hooks_installed
+    if not _hooks_installed:
+        _hooks_installed = True
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _excepthook
+        # The fault plane's crash.exit is an os._exit with no flushes
+        # and no atexit — the registered hook is the only forensic
+        # window (gol_tpu/resilience/faults.py).
+        from gol_tpu.resilience import faults as faults_mod
+
+        faults_mod.register_crash_hook(
+            lambda site, generation, code: dump_now(
+                f"{site}:gen{generation}"
+            )
+        )
+    if signals:
+        import faulthandler
+        import signal as signal_mod
+
+        try:
+            faulthandler.enable()
+        except Exception:
+            pass
+        try:
+            signal_mod.signal(signal_mod.SIGTERM, _signal_dump_handler)
+            signal_mod.signal(signal_mod.SIGABRT, _signal_dump_handler)
+        except ValueError:
+            pass  # not the main thread — triggers stay exception/crash
+    return r
+
+
+# -- postmortem --------------------------------------------------------------
+def find_dumps(directory: str) -> List[str]:
+    """``*.blackbox.jsonl`` under ``dir`` and ``dir/telemetry``,
+    newest-first by mtime."""
+    import glob as glob_mod
+
+    out: List[str] = []
+    for d in (directory, os.path.join(directory, "telemetry")):
+        out.extend(glob_mod.glob(os.path.join(d, f"*{DUMP_SUFFIX}")))
+    return sorted(out, key=lambda p: os.path.getmtime(p), reverse=True)
+
+
+def load_dump(path: str) -> List[dict]:
+    """Parse + schema-validate one dump.  A dump from a FUTURE schema
+    refuses here with the standard "newer than this reader supports"
+    SchemaError (exit 2 at the CLI)."""
+    from gol_tpu import telemetry
+
+    records = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            rec = json.loads(ln)
+            telemetry.validate_record(rec)
+            records.append(rec)
+    return records
+
+
+def _journal_path(directory: str) -> Optional[str]:
+    for d in (directory, os.path.dirname(os.path.abspath(directory))):
+        p = os.path.join(d, "journal.jsonl")
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _fmt_t(t: float, t0: float) -> str:
+    return f"t+{t - t0:8.3f}s"
+
+
+def render_postmortem(directory: str, out=None) -> int:
+    """The ``python -m gol_tpu.telemetry postmortem <dir>`` body: one
+    page reconstructing the last seconds before death from the newest
+    dump, cross-checked against the journal fold.  Exit 0 on a rendered
+    verdict, 1 with no dump to read (a clean exit leaves none), 2 on a
+    schema violation (raised as SchemaError, handled by the CLI)."""
+    out = out or sys.stdout
+    dumps = find_dumps(directory)
+    if not dumps:
+        print(
+            f"postmortem: no *{DUMP_SUFFIX} dump under {directory} — "
+            "either the process exited cleanly (a graceful drain leaves "
+            "no dump) or the recorder was disabled (GOL_BLACKBOX=0)",
+            file=out,
+        )
+        return 1
+    path = dumps[0]
+    records = load_dump(path)
+    header = records[0] if records else {}
+    cfg = header.get("config", {}) if header.get(
+        "event"
+    ) == "run_header" else {}
+    body = records[1:] if cfg.get("driver") == "blackbox" else records
+    t0 = body[0]["t"] if body else header.get("t", 0.0)
+    t_end = body[-1]["t"] if body else t0
+
+    print(f"postmortem: {path}", file=out)
+    if len(dumps) > 1:
+        print(
+            f"  ({len(dumps) - 1} older dump(s) present — reading the "
+            "newest)",
+            file=out,
+        )
+    print(
+        f"  reason {cfg.get('reason', '?')}   run {header.get('run_id')}"
+        f"   pid {cfg.get('pid', '?')}   ring {len(body)}/"
+        f"{cfg.get('capacity', '?')} records"
+        f" ({cfg.get('dropped', 0)} older fell off)"
+        f"   window {t_end - t0:.3f}s",
+        file=out,
+    )
+
+    # -- final chunks -------------------------------------------------------
+    chunks = [r for r in body if r["event"] == "chunk"]
+    print("\nfinal chunks:", file=out)
+    if chunks:
+        for r in chunks[-3:]:
+            print(
+                f"  {_fmt_t(r['t'], t0)}  chunk {r['index']:>3} "
+                f"(take {r['take']}) -> generation {r['generation']}, "
+                f"wall {r['wall_s']:.4f}s",
+                file=out,
+            )
+    else:
+        print("  (none in the ring)", file=out)
+
+    # -- open spans ---------------------------------------------------------
+    spans = [r for r in body if r["event"] == "span"]
+    closed = {
+        r["trace_id"] for r in spans if r["span_id"] == "root"
+    }
+    open_traces: Dict[str, str] = {}
+    for r in spans:
+        if r["trace_id"] not in closed:
+            open_traces[r["trace_id"]] = r["request_id"]
+    print("open spans:", file=out)
+    if open_traces:
+        for tid, rid in sorted(open_traces.items()):
+            names = [
+                s["name"] for s in spans if s["trace_id"] == tid
+            ]
+            print(
+                f"  {tid} (request {rid}): {', '.join(names)} — no root "
+                "span committed (the request never finished)",
+                file=out,
+            )
+    elif spans:
+        print("  none — every recorded trace committed its root", file=out)
+    else:
+        print("  (no spans in the ring)", file=out)
+
+    # -- last guard audit ---------------------------------------------------
+    audits = [r for r in body if r["event"] == "guard_audit"]
+    print("last guard audit:", file=out)
+    if audits:
+        a = audits[-1]
+        print(
+            f"  {_fmt_t(a['t'], t0)}  generation {a['generation']}: "
+            f"{'ok' if a['ok'] else 'FAILED'}, population "
+            f"{a['population']}, fingerprint {a['fingerprint']}",
+            file=out,
+        )
+    else:
+        print("  (none in the ring)", file=out)
+
+    # -- journal cross-check ------------------------------------------------
+    serve_recs = [r for r in body if r["event"] == "serve"]
+    jpath = _journal_path(directory)
+    open_ids: List[str] = []
+    print("journal cross-check:", file=out)
+    if jpath is None:
+        print(
+            "  no journal.jsonl next to the dump — skipping (a plain "
+            "runtime dump has no admission intents)",
+            file=out,
+        )
+    else:
+        from gol_tpu.serve import journal as journal_mod
+
+        entries, torn = journal_mod.replay(jpath)
+        open_ids = sorted(
+            rid
+            for rid, e in entries.items()
+            if e["status"] in ("admitted", "started")
+        )
+        print(
+            f"  {jpath}: {len(entries)} request(s), "
+            f"{len(open_ids)} open intent(s)"
+            + (", torn tail healed" if torn else ""),
+            file=out,
+        )
+        for rid in open_ids:
+            last = [
+                r for r in serve_recs if r["request_id"] == rid
+            ]
+            if last:
+                r = last[-1]
+                print(
+                    f"  {rid}: journal {entries[rid]['status']}, last "
+                    f"recorded serve event '{r['action']}' at "
+                    f"{_fmt_t(r['t'], t0)} — consistent",
+                    file=out,
+                )
+            else:
+                print(
+                    f"  {rid}: journal {entries[rid]['status']}, no "
+                    "serve event in the ring (admitted before the "
+                    "window)",
+                    file=out,
+                )
+
+    # -- verdict ------------------------------------------------------------
+    last_chunk = chunks[-1] if chunks else None
+    where = (
+        f"mid-run after chunk {last_chunk['index']} "
+        f"(generation {last_chunk['generation']})"
+        if last_chunk
+        else "before the first recorded chunk"
+    )
+    if open_ids:
+        print(
+            f"\nverdict: died on {cfg.get('reason', '?')} {where}; "
+            f"request(s) {', '.join(open_ids)} left open in the journal "
+            "— a supervised replay will re-admit and complete "
+            f"{'it' if len(open_ids) == 1 else 'them'} exactly once.",
+            file=out,
+        )
+    elif jpath is not None:
+        print(
+            f"\nverdict: died on {cfg.get('reason', '?')} {where}; the "
+            "journal is fully terminal — nothing to recover.",
+            file=out,
+        )
+    else:
+        print(
+            f"\nverdict: died on {cfg.get('reason', '?')} {where}; no "
+            "journal to recover from (re-run from the last checkpoint).",
+            file=out,
+        )
+    return 0

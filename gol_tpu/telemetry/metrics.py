@@ -58,6 +58,27 @@ has been observed — docs/RESILIENCE.md, "Live elasticity")::
     gol_health_hedge_total            hedged chunk replays (counter)
     gol_health_live_reshards_total    in-process live reshards (counter)
 
+Compile-cache metrics (schema v13, emitted only once a ``compile``
+event has been observed — docs/OBSERVABILITY.md, "Compilation as a
+first-class observable")::
+
+    gol_compile_hits_total            compiles served from the
+                                      persistent cache (counter)
+    gol_compile_misses_total          cold compiles that wrote a new
+                                      cache entry (counter)
+    gol_compile_unknown_total         compiles with no cache attached
+                                      (counter)
+    gol_compile_seconds_total         lower+compile wall seconds (counter)
+    gol_compile_storms_total          compile-storm detections (counter)
+
+Telemetry self-observation (schema v13)::
+
+    gol_telemetry_shed_total          records dropped by the EventLog's
+                                      degrade plane, fed by the
+                                      ``on_shed`` tap — the one channel
+                                      that survives when the stream
+                                      itself is shed (counter)
+
 Purity: the registry runs strictly host-side inside the emission path,
 which itself runs after the ``force_ready`` fences — the trace-identity
 pin covers metrics-on vs -off (tests/test_metrics.py).
@@ -151,6 +172,22 @@ class MetricsRegistry:
         self.health_straggler_total = 0
         self.health_hedge_total = 0
         self.health_reshards_total = 0
+        # Compile-cache observability (schema v13): hit/miss is the
+        # compile event's cache_hit stamp (absent = no persistent cache
+        # attached, counted separately so a hit rate of "0/0" is
+        # distinguishable from "cache off").
+        self.compile_seen = False
+        self.compile_hits_total = 0
+        self.compile_misses_total = 0
+        self.compile_unknown_total = 0
+        self.compile_seconds_total = 0.0
+        self.compile_storms_total = 0
+        # Telemetry self-observation: records the EventLog's degrade
+        # plane dropped, fed by the on_shed tap rather than observe()
+        # (a shed record never reaches the observer — that is the
+        # point of shedding).
+        self.shed_total = 0
+        self.shed_by_event: Dict[str, int] = {}
 
     # -- write side (EventLog observer) -------------------------------------
     def observe(self, rec: dict) -> None:
@@ -243,6 +280,21 @@ class MetricsRegistry:
                     self.health_hedge_total += 1
                 if "alive" in rec:
                     self.health_alive_devices = rec["alive"]
+            elif event == "compile":
+                self.compile_seen = True
+                hit = rec.get("cache_hit")
+                if hit is True:
+                    self.compile_hits_total += 1
+                elif hit is False:
+                    self.compile_misses_total += 1
+                else:
+                    self.compile_unknown_total += 1
+                self.compile_seconds_total += (
+                    rec.get("lower_s", 0.0) + rec.get("compile_s", 0.0)
+                )
+            elif event == "storm":
+                self.compile_seen = True
+                self.compile_storms_total += 1
             elif event == "reshard":
                 if self.health_seen:
                     # A reshard on a stream that already carries health
@@ -250,6 +302,18 @@ class MetricsRegistry:
                     # docs/RESILIENCE.md); restart-path reshards happen
                     # in fresh processes with fresh registries.
                     self.health_reshards_total += 1
+
+    def count_shed(self, rec: dict) -> None:
+        """The :attr:`EventLog.on_shed` tap: a record the degrade plane
+        dropped on the floor.  Deliberately NOT part of :meth:`observe`
+        — shed records never reach the observer, so the scrape surface
+        is the only place the loss is visible live."""
+        with self._lock:
+            self.shed_total += 1
+            event = rec.get("event", "?")
+            self.shed_by_event[event] = (
+                self.shed_by_event.get(event, 0) + 1
+            )
 
     # -- read side (HTTP) ----------------------------------------------------
     def render(self) -> str:
@@ -466,6 +530,42 @@ class MetricsRegistry:
                     "In-process mesh reshards taken on health verdicts.",
                     self.health_reshards_total,
                 )
+            if self.compile_seen:
+                metric(
+                    "gol_compile_hits_total", "counter",
+                    "Compiles served from the persistent cache (v13).",
+                    self.compile_hits_total,
+                )
+                metric(
+                    "gol_compile_misses_total", "counter",
+                    "Cold compiles that wrote a new cache entry.",
+                    self.compile_misses_total,
+                )
+                metric(
+                    "gol_compile_unknown_total", "counter",
+                    "Compiles with no persistent cache attached.",
+                    self.compile_unknown_total,
+                )
+                metric(
+                    "gol_compile_seconds_total", "counter",
+                    "Wall seconds spent lowering and compiling.",
+                    self.compile_seconds_total,
+                )
+                metric(
+                    "gol_compile_storms_total", "counter",
+                    "Compile storms detected by the scheduler.",
+                    self.compile_storms_total,
+                )
+            if self.shed_total > 0:
+                lines.append(
+                    "# HELP gol_telemetry_shed_total Records dropped by "
+                    "the telemetry degrade plane (v13)."
+                )
+                lines.append("# TYPE gol_telemetry_shed_total counter")
+                for event, n in sorted(self.shed_by_event.items()):
+                    lines.append(
+                        f'gol_telemetry_shed_total{{event="{event}"}} {n}'
+                    )
             return "\n".join(lines) + "\n"
 
 
@@ -526,6 +626,7 @@ def serve_event_metrics(events, port: int, quiet: bool = False):
     registry = MetricsRegistry()
     server = MetricsServer(registry, port)
     events.observer = registry.observe
+    events.on_shed = registry.count_shed
     events.metrics_server = server
     if not quiet:
         print(

@@ -97,6 +97,13 @@ def load_dir(directory: str) -> Dict[str, Run]:
         raise SchemaError(f"{directory}: not a directory")
     runs: Dict[str, Run] = {}
     paths = sorted(glob.glob(os.path.join(directory, "*.jsonl")))
+    # Black-box crash dumps share the directory and the .jsonl suffix
+    # but are a different artifact with a different reader (`telemetry
+    # postmortem`) — a dir that holds both must still summarize.
+    paths = [
+        p for p in paths
+        if ".blackbox.jsonl" not in os.path.basename(p)
+    ]
     if not paths:
         raise SchemaError(f"{directory}: no .jsonl telemetry files")
     for path in paths:
@@ -234,7 +241,7 @@ def find_anomalies(run: Run) -> List[str]:
     # something was sacrificed to get there — retried checkpoint
     # writes, a shed telemetry stream, shed checkpointing.
     for r in run.records("degraded"):
-        flags.append(
+        flag = (
             f"degraded: {r['resource']} {r['action']}"
             + (
                 f" at generation {r['generation']}"
@@ -243,6 +250,24 @@ def find_anomalies(run: Run) -> List[str]:
             )
             + (f" — {r['detail']}" if r.get("detail") else "")
         )
+        if r.get("dropped"):
+            # Schema v13 shed census: the EventLog's close() stamps how
+            # many records of each type the degrade plane dropped — the
+            # only after-the-fact accounting of what the stream is
+            # missing (live, gol_telemetry_shed_total carries it).
+            census = ", ".join(
+                f"{n} {event}"
+                for event, n in sorted(r["dropped"].items())
+            )
+            total = r.get(
+                "dropped_total", sum(r["dropped"].values())
+            )
+            flag += (
+                f" — shed {total} record(s) after degrading "
+                f"({census}); the tables above undercount by exactly "
+                "this census"
+            )
+        flags.append(flag)
 
     # Per-chunk walls must account for the summary's total phase.
     summ = run.summary_record
@@ -429,9 +454,38 @@ def render_run(run: Run, out) -> None:
     if compiles:
         print("  compile:", file=out)
         for c in compiles:
-            print(
+            line = (
                 f"    chunk {c['chunk']:>8} gens  lower {c['lower_s']:.3f}s"
-                f"  compile {c['compile_s']:.3f}s",
+                f"  compile {c['compile_s']:.3f}s"
+            )
+            # Schema v13 (docs/OBSERVABILITY.md, "Compilation as a
+            # first-class observable"): the persistent-cache verdict.
+            # The key is stamped only on a miss — that is when the
+            # entry is written and XLA names it.
+            hit = c.get("cache_hit")
+            if hit is True:
+                line += "  [cache hit]"
+            elif hit is False:
+                key = c.get("cache_key")
+                line += "  [cache miss" + (
+                    f" -> {key}]" if key else "]"
+                )
+            print(line, file=out)
+        stamped = [c for c in compiles if c.get("cache_hit") is not None]
+        total_s = sum(c["lower_s"] + c["compile_s"] for c in compiles)
+        if stamped:
+            hits = sum(1 for c in stamped if c["cache_hit"])
+            print(
+                f"    cache: {hits}/{len(stamped)} hit(s) "
+                f"({100 * hits / len(stamped):.0f}% hit rate), "
+                f"{total_s:.3f}s total lower+compile",
+                file=out,
+            )
+        else:
+            print(
+                f"    cache: not attached, {total_s:.3f}s total "
+                "lower+compile (set --compile-cache or "
+                "JAX_COMPILATION_CACHE_DIR to stamp hit/miss)",
                 file=out,
             )
         if any(c.get("memory") for c in compiles):
@@ -457,6 +511,17 @@ def render_run(run: Run, out) -> None:
                     f"{cell('peak_bytes'):>10} {cell('alias_bytes'):>10}",
                     file=out,
                 )
+
+    for s in run.records("storm", rank=rank0):
+        # Schema v13: the scheduler's compile-storm detector fired —
+        # K cold compiles inside one admission window; admissions were
+        # throttled until the window drained (docs/SERVING.md).
+        print(
+            f"  storm: {s['kind']} — {s['count']} cold compiles within "
+            f"{s['window_s']:.0f}s (threshold {s['threshold']}); "
+            "admission depth halved for the window",
+            file=out,
+        )
 
     chunks = run.records("chunk", rank=rank0)
     if chunks:
@@ -907,6 +972,17 @@ def main(argv=None) -> int:
         help="declarative objectives JSON (default: the built-in "
         "commit-p99 + queue-fraction objectives)",
     )
+    pp = sub.add_parser(
+        "postmortem",
+        help="reconstruct the last seconds before a crash from the "
+        "black-box dump, cross-checked against the journal "
+        "(docs/OBSERVABILITY.md)",
+    )
+    pp.add_argument(
+        "directory",
+        help="directory holding *.blackbox.jsonl (the state dir or its "
+        "telemetry/ subdirectory)",
+    )
     pw = sub.add_parser(
         "watch", help="live dashboard tailing a run's rank files"
     )
@@ -954,6 +1030,12 @@ def main(argv=None) -> int:
                 request=ns.request,
                 perfetto=ns.perfetto,
                 slo_path=ns.slo,
+            )
+        if ns.command == "postmortem":
+            from gol_tpu.telemetry import blackbox as blackbox_mod
+
+            return blackbox_mod.render_postmortem(
+                ns.directory, sys.stdout
             )
         if ns.command == "watch":
             from gol_tpu.telemetry import watch as watch_mod

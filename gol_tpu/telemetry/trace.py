@@ -83,8 +83,12 @@ class SpanRecorder:
     other serve emission — one stream, never two sources of truth.
     ``epoch`` prefixes the generated span ids so the spans of a
     crash-replayed request (same trace, different process) can never
-    collide.  With neither sink attached the recorder is disabled and
-    every call is a no-op — tracing has zero cost on a bare scheduler.
+    collide.  With neither sink attached the recorder is "disabled":
+    nothing reaches a file or the registry — but the span still rings
+    in the always-on black box (v13, docs/OBSERVABILITY.md), because a
+    postmortem's open-span census must exist for every process.  (With
+    an EventLog attached, its own emit() taps the ring — no double
+    record.)
     """
 
     def __init__(self, events=None, registry=None, epoch: str = "") -> None:
@@ -105,9 +109,7 @@ class SpanRecorder:
         span_id: Optional[str] = None,
         **attrs,
     ) -> Optional[str]:
-        """Emit one span; returns its id (None when disabled)."""
-        if not self.enabled:
-            return None
+        """Emit one span; returns its id."""
         if span_id is None:
             self._seq += 1
             span_id = f"{self._epoch}#{self._seq}"
@@ -125,10 +127,13 @@ class SpanRecorder:
             fields["attrs"] = attrs
         if self._events is not None:
             self._events.span_event(**fields)
-        else:
-            self._registry.observe(
-                {"event": "span", "t": time.time(), **fields}
-            )
+            return span_id
+        from gol_tpu.telemetry import blackbox
+
+        rec = {"event": "span", "t": time.time(), **fields}
+        blackbox.record(rec)
+        if self._registry is not None:
+            self._registry.observe(rec)
         return span_id
 
 

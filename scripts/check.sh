@@ -54,25 +54,32 @@
 #                       validates against the committed JSON schema —
 #                       CI teeth for the export format)
 #  16. tier-1 tests    (the exact ROADMAP.md command)
+#  17. postmortem smoke (black box, docs/OBSERVABILITY.md: crash a
+#                       real server via the fault plane, validate the
+#                       *.blackbox.jsonl dump, run `telemetry
+#                       postmortem` and assert the verdict names the
+#                       open request; a supervised replay then keeps
+#                       the verdict's promise; a graceful drain leaves
+#                       no dump; a v14 dump refuses with exit 2)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/16] lint =="
+echo "== [1/17] lint =="
 bash scripts/lint.sh
 
-echo "== [2/16] static verifier (gol_tpu.analysis) =="
+echo "== [2/17] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/16] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/17] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/16] stats smoke (in-graph simulation statistics) =="
+echo "== [4/17] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -81,43 +88,43 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/16] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/17] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/16] batch smoke (docs/BATCHING.md) =="
+echo "== [6/17] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/16] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/17] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/16] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/17] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/16] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+echo "== [9/17] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
 JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
-echo "== [10/16] halo smoke (pipelined depth-k exchange, PR 9) =="
+echo "== [10/17] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/16] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+echo "== [11/17] chaos smoke (docs/RESILIENCE.md, fault plane) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
-echo "== [12/16] serve smoke (docs/SERVING.md, serving tier) =="
+echo "== [12/17] serve smoke (docs/SERVING.md, serving tier) =="
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
-echo "== [13/16] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
+echo "== [13/17] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
 python scripts/elastic_smoke.py
 
-echo "== [14/16] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
+echo "== [14/17] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
 python -m gol_tpu.analysis --concurrency
 
-echo "== [15/16] trace smoke (docs/OBSERVABILITY.md, request tracing) =="
+echo "== [15/17] trace smoke (docs/OBSERVABILITY.md, request tracing) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry trace \
     tests/data/telemetry_v12 --perfetto /tmp/_trace_export.json
 python scripts/validate_trace_export.py /tmp/_trace_export.json \
     docs/schemas/perfetto_trace.schema.json
 
-echo "== [16/16] tier-1 tests =="
+echo "== [16/17] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -125,4 +132,8 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+echo "== [17/17] postmortem-smoke (docs/OBSERVABILITY.md, black box) =="
+make postmortem-smoke
+
 exit "$rc"

@@ -1,0 +1,291 @@
+"""Postmortem-smoke gate: black-box forensics on a REAL process death.
+
+The check.sh stage for docs/OBSERVABILITY.md "Black box & postmortems".
+Everything in-process is covered by tests/test_blackbox.py; this script
+exercises the full crash-to-verdict story across process boundaries:
+
+**Phase A — crash forensics.**  A real ``python -m gol_tpu.serve`` with
+an armed ``crash.exit`` dies mid-batch (``os._exit``: no flushes, no
+atexit — the black-box crash hook is the only forensic window).
+Assertions: exactly one ``*.blackbox.jsonl`` dump exists, every line
+schema-validates, and ``python -m gol_tpu.telemetry postmortem`` exits
+0 with a verdict naming the request left open in the journal.
+
+**Phase B — the verdict's promise.**  The same state dir relaunched
+under ``python -m gol_tpu.resilience supervise``: the journal replay
+re-admits the open request and completes it exactly once, byte-equal to
+the sequential oracle — the postmortem's "a supervised replay will
+re-admit and complete it" sentence, made true.
+
+**Phase C — a clean death leaves no body.**  A SIGTERM drain exits 0
+with NO dump anywhere (the graceful handler owns SIGTERM), and the
+postmortem CLI says so with exit 1.
+
+**Phase D — future dumps refuse.**  A dump stamped schema v(N+1) makes
+the postmortem CLI exit 2 with the standard "newer than this reader
+supports" message — never a KeyError three consumers deep.
+
+Exits non-zero with a message on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from gol_tpu import telemetry  # noqa: E402
+from gol_tpu.models import patterns  # noqa: E402
+from gol_tpu.serve import journal as journal_mod  # noqa: E402
+from gol_tpu.serve.client import SimClient  # noqa: E402
+from gol_tpu.serve.scheduler import decode_board  # noqa: E402
+from gol_tpu.telemetry import blackbox  # noqa: E402
+from tests import oracle  # noqa: E402
+
+GENS = 12
+CRASH_CODE = 75
+PLAN = {"faults": [{"site": "crash.exit", "at": 4, "value": CRASH_CODE}]}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _fail(msg: str) -> int:
+    print(f"postmortem-smoke: FAIL — {msg}")
+    return 1
+
+
+def _wait_healthy(client: SimClient, timeout_s: float = 120.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        try:
+            client.healthz()
+            return
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError("server never became healthy")
+
+
+def _serve_cmd(state: str) -> list:
+    return [
+        sys.executable, "-m", "gol_tpu.serve",
+        "--state-dir", state, "--run-id", "pm", "--chunk", "4",
+    ]
+
+
+def _postmortem(env: dict, directory: str):
+    """Run the CLI the way an operator would: (rc, stdout, stderr)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "gol_tpu.telemetry", "postmortem",
+         directory],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=120,
+    )
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def phase_a(tmp: str, env: dict) -> int:
+    state = os.path.join(tmp, "state")
+    port = _free_port()
+    proc = subprocess.Popen(
+        _serve_cmd(state) + ["--port", str(port)],
+        env={**env, "GOL_FAULT_PLAN": json.dumps(PLAN)},
+        cwd=str(REPO), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    client = SimClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    try:
+        _wait_healthy(client)
+        try:
+            client.submit(
+                {"id": "p0", "pattern": 4, "size": 64,
+                 "generations": GENS},
+                connect_retries=20, retry_delay_s=0.5,
+            )
+        except Exception:
+            pass  # the crash can race the 202; the journal has the admit
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = proc.stdout.read()
+    if rc != CRASH_CODE:
+        return _fail(f"crash drill exited {rc}, not {CRASH_CODE}:"
+                     f"\n{out[-2000:]}")
+
+    dumps = blackbox.find_dumps(state)
+    if len(dumps) != 1:
+        return _fail(f"expected exactly one dump, found {dumps}")
+    recs = blackbox.load_dump(dumps[0])  # raises on any invalid line
+    head = recs[0]
+    if head["config"]["driver"] != "blackbox":
+        return _fail(f"dump header driver {head['config']['driver']}")
+    if not head["config"]["reason"].startswith("crash.exit:gen"):
+        return _fail(f"dump reason {head['config']['reason']}")
+    if not any(
+        r["event"] == "serve" and r["request_id"] == "p0" for r in recs
+    ):
+        return _fail("dump ring never saw request p0")
+
+    entries, _ = journal_mod.replay(os.path.join(state, "journal.jsonl"))
+    if entries.get("p0", {}).get("status") not in ("admitted", "started"):
+        return _fail(f"journal fold {entries.get('p0')} — p0 not open")
+
+    rc, stdout, stderr = _postmortem(env, state)
+    if rc != 0:
+        return _fail(f"postmortem CLI exited {rc}: {stderr[-500:]}")
+    if "request(s) p0 left open in the journal" not in stdout:
+        return _fail(f"verdict does not name p0:\n{stdout[-1000:]}")
+    print(
+        "postmortem-smoke: phase A ok — crash.exit mid-batch left a "
+        "valid dump; the verdict names p0 as the request a replay "
+        "recovers"
+    )
+    return 0
+
+
+def phase_b(tmp: str, env: dict) -> int:
+    import numpy as np
+
+    state = os.path.join(tmp, "state")  # the SAME crashed state dir
+    port = _free_port()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "gol_tpu.resilience", "supervise",
+            "--max-restarts", "3", "--backoff-base", "0.1",
+            "--backoff-seed", "0", "--",
+        ]
+        + _serve_cmd(state) + ["--port", str(port)],
+        env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    client = SimClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    try:
+        _wait_healthy(client)
+        payload = client.wait_for(
+            "p0", timeout_s=180.0, connect_retries=200
+        )
+        client.shutdown()
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = proc.stdout.read()
+    if rc != 0:
+        return _fail(f"supervised replay exited {rc}:\n{out[-2000:]}")
+    if payload["status"] != "done":
+        return _fail(f"replayed p0 status {payload['status']}")
+    want = oracle.run_torus(patterns.init_global(4, 64, 1), GENS)
+    if not np.array_equal(decode_board(payload["board"]), want):
+        return _fail("replayed p0 differs from the sequential oracle")
+    raw = [
+        json.loads(ln)
+        for ln in open(os.path.join(state, "journal.jsonl"))
+        if ln.strip()
+    ]
+    completes = [r["id"] for r in raw if r.get("rec") == "complete"]
+    if completes != ["p0"]:
+        return _fail(f"journal completes {completes} != exactly one p0")
+    print(
+        "postmortem-smoke: phase B ok — the supervised replay re-"
+        "admitted p0 from the journal and completed it exactly once, "
+        "byte-equal"
+    )
+    return 0
+
+
+def phase_c(tmp: str, env: dict) -> int:
+    state = os.path.join(tmp, "c_state")
+    port = _free_port()
+    proc = subprocess.Popen(
+        _serve_cmd(state) + ["--port", str(port)],
+        env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    client = SimClient(f"http://127.0.0.1:{port}", timeout=10.0)
+    try:
+        _wait_healthy(client)
+        client.submit(
+            {"id": "c0", "pattern": 4, "size": 64, "generations": 40}
+        )
+        proc.send_signal(signal.SIGTERM)  # while c0 is in flight
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = proc.stdout.read()
+    if rc != 0:
+        return _fail(f"SIGTERM drain exited {rc}:\n{out[-2000:]}")
+    stray = [
+        str(p)
+        for p in pathlib.Path(state).rglob(f"*{blackbox.DUMP_SUFFIX}")
+    ]
+    if stray:
+        return _fail(f"graceful drain left dump(s): {stray}")
+    rc, stdout, _ = _postmortem(env, state)
+    if rc != 1 or "no *.blackbox.jsonl dump" not in stdout:
+        return _fail(
+            f"postmortem on a clean state: rc {rc}, not the designed "
+            f"exit 1:\n{stdout[-500:]}"
+        )
+    print(
+        "postmortem-smoke: phase C ok — SIGTERM drain exited 0 with no "
+        "dump; postmortem reports the clean death with exit 1"
+    )
+    return 0
+
+
+def phase_d(tmp: str, env: dict) -> int:
+    d = os.path.join(tmp, "d_future")
+    os.makedirs(d, exist_ok=True)
+    future = telemetry.SCHEMA_VERSION + 1
+    with open(os.path.join(d, f"fut{blackbox.DUMP_SUFFIX}"), "w") as f:
+        f.write(json.dumps({
+            "event": "run_header", "t": 0.0, "schema": future,
+            "run_id": "fut", "process_index": 0, "process_count": 1,
+            "config": {"driver": "blackbox", "reason": "smoke"},
+        }) + "\n")
+    rc, _, stderr = _postmortem(env, d)
+    if rc != 2:
+        return _fail(f"future-schema dump exited {rc}, not 2")
+    if f"schema v{future} is newer than this reader supports" not in stderr:
+        return _fail(f"future-schema message missing:\n{stderr[-500:]}")
+    print(
+        "postmortem-smoke: phase D ok — a v%d dump refuses with exit 2"
+        % future
+    )
+    return 0
+
+
+def main() -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    for k in ("XLA_FLAGS", "GOL_FAULT_PLAN", "GOL_RESTART_ATTEMPT",
+              "GOL_BLACKBOX", "GOL_BLACKBOX_RING"):
+        env.pop(k, None)
+    with tempfile.TemporaryDirectory() as tmp:
+        for phase in (phase_a, phase_b, phase_c, phase_d):
+            rc = phase(tmp, env)
+            if rc != 0:
+                return rc
+    print(
+        "postmortem-smoke: OK — crash dump + verdict, replay kept the "
+        "promise, clean drain left no body, future schemas refuse"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

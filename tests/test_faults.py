@@ -239,14 +239,21 @@ def test_persistent_disk_full_sheds_but_finishes(tmp_path, capsys):
     assert np.array_equal(np.asarray(state.board), clean)
     assert rt._ckpt_shed
     assert ckpt.list_snapshots(str(tmp_path / "ck")) == []
-    # The shed order is telemetry first: the stream's last record is
-    # its own degraded stamp.
+    # The shed order is telemetry first: the stream stamps its own
+    # degradation, drops the remaining chunks, and (v13) closes with
+    # the census of exactly what the shed cost.
     recs = [
         json.loads(ln) for ln in open(tmp_path / "tm" / "r.rank0.jsonl")
     ]
+    assert any(
+        r["event"] == "degraded"
+        and r["resource"] == "telemetry"
+        and r["action"] == "shed"
+        for r in recs
+    )
     assert recs[-1]["event"] == "degraded"
-    assert recs[-1]["resource"] == "telemetry"
-    assert recs[-1]["action"] == "shed"
+    assert recs[-1]["action"] == "shed_summary"
+    assert recs[-1]["dropped_total"] == sum(recs[-1]["dropped"].values()) > 0
     assert "continuing WITHOUT further checkpoints" in (
         capsys.readouterr().err
     )

@@ -1,0 +1,252 @@
+"""Schema v13 (black box, compile cache, shed census) + v1–v12 compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..12}.py.
+Here:
+
+- the v13 additions round-trip: ``storm`` records a compile-storm
+  detection, ``compile`` optionally carries the persistent-cache
+  verdict (``cache_hit``/``cache_key``), and a shedding stream leaves
+  a ``shed_summary`` census on close (docs/OBSERVABILITY.md);
+- the committed v13 fixture is a REAL serve run against a persistent
+  compile cache — two warm buckets (hits), three cold ones (misses
+  with the written entry's key), and the storm the cold burst tripped
+  (hits never count toward the threshold);
+- **back-compat**: all TWELVE committed fixtures — PR 2 (v1) through
+  PR 18 (v13) — still load, merge, and render in one ``summarize``
+  pass (exit 0) with the cache hit-rate line;
+- a stream from a FUTURE schema fails loudly ("newer than this reader
+  supports", exit 2) instead of KeyError'ing deep in a consumer;
+- the ``gol_compile_*`` counters and ``gol_telemetry_shed_total`` are
+  fed from the same records/taps the JSONL carries.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+import pytest
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+from gol_tpu.telemetry.metrics import MetricsRegistry
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+    9: DATA / "telemetry_v9" / "pr12run.rank0.jsonl",
+    11: DATA / "telemetry_v11" / "pr14run.rank0.jsonl",
+    12: DATA / "telemetry_v12" / "pr17run.rank0.jsonl",
+    13: DATA / "telemetry_v13" / "pr18run.rank0.jsonl",
+}
+
+
+def _v13_stream(directory, run_id="v13"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header({"driver": "serve", "engine": "auto", "slots": 4})
+        ev.compile_event(4, 0.2, 0.8, cache_hit=False, cache_key="k-abc")
+        ev.compile_event(4, 0.001, 0.002, cache_hit=True)
+        ev.compile_event(4, 0.1, 0.3)  # no cache attached: no stamp
+        ev.storm_event("compile", count=3, window_s=10.0, threshold=3)
+        return ev.path
+
+
+def test_v13_roundtrip(tmp_path):
+    path = _v13_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 13
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 14))
+    comps = [r for r in recs if r["event"] == "compile"]
+    assert [c.get("cache_hit") for c in comps] == [False, True, None]
+    assert comps[0]["cache_key"] == "k-abc"
+    assert "cache_key" not in comps[2] and "cache_hit" not in comps[2]
+    storm = next(r for r in recs if r["event"] == "storm")
+    assert storm["kind"] == "compile"
+    assert storm["count"] == 3 and storm["threshold"] == 3
+    assert storm["window_s"] == 10.0
+
+
+def test_storm_event_validates_required_fields(tmp_path):
+    with telemetry.EventLog(
+        str(tmp_path), run_id="bad", process_index=0
+    ) as ev:
+        ev.run_header({})
+        with pytest.raises(telemetry.SchemaError, match="storm"):
+            ev.emit("storm", kind="compile")  # no count/window/threshold
+
+
+def test_committed_fixture_schemas():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v13_fixture_is_a_real_cached_serve_run():
+    """The committed stream came from a real scheduler run against a
+    persistent compile cache: warm buckets hit, cold buckets miss with
+    the written entry's key, and the cold burst trips the storm."""
+    recs = [json.loads(ln) for ln in FIXTURES[13].open()]
+    assert recs[0]["config"]["driver"] == "serve"
+    comps = [r for r in recs if r["event"] == "compile"]
+    hits = [c for c in comps if c["cache_hit"] is True]
+    misses = [c for c in comps if c["cache_hit"] is False]
+    assert len(hits) == 2 and len(misses) == 3
+    # The key is stamped when the entry is written — misses only.
+    assert all(
+        isinstance(c["cache_key"], str) and c["cache_key"]
+        for c in misses
+    )
+    assert all(c["cache_key"] is None for c in hits)
+    # A persistent-cache hit skips the XLA compile: orders faster.
+    assert max(c["compile_s"] for c in hits) < min(
+        c["compile_s"] for c in misses
+    )
+    storms = [r for r in recs if r["event"] == "storm"]
+    assert len(storms) == 1
+    assert storms[0]["kind"] == "compile"
+    assert storms[0]["count"] >= storms[0]["threshold"] == 3
+    # Every compile names its bucket (schema v4 batch block).
+    assert all(c["batch"]["bucket"] for c in comps)
+
+
+def test_v13_fixture_summarize_renders_cache_line(capsys):
+    assert summ_mod.main(
+        ["summarize", str(FIXTURES[13].parent)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "cache: 2/5 hit(s) (40% hit rate)" in out
+    assert "[cache hit]" in out and "[cache miss -> " in out
+    assert "storm: compile" in out and "admission depth halved" in out
+
+
+def test_v1_to_v13_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v13_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "pr12run", "pr14run", "pr17run", "pr18run",
+        "v13",
+    ):
+        assert run_id in out
+    assert "hit rate" in out
+
+
+def test_future_schema_fails_loudly_not_keyerror(tmp_path, capsys):
+    future = telemetry.SCHEMA_VERSION + 1
+    (tmp_path / "fut.rank0.jsonl").write_text(
+        json.dumps(
+            {
+                "event": "run_header", "t": 0.0, "schema": future,
+                "run_id": "fut", "process_index": 0, "process_count": 1,
+                "config": {},
+            }
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert f"schema v{future} is newer than this reader supports" in err
+    assert f"max v{telemetry.SCHEMA_VERSION}" in err
+
+
+def test_compile_metrics_from_fixture():
+    """gol_compile_{hits,misses}_total / gol_compile_seconds_total /
+    gol_compile_storms_total are fed from the SAME records the JSONL
+    carries — and stay absent until a compile is observed."""
+    reg = MetricsRegistry()
+    assert "gol_compile" not in reg.render()
+    for ln in FIXTURES[13].open():
+        reg.observe(json.loads(ln))
+    text = reg.render()
+    assert "gol_compile_hits_total 2" in text
+    assert "gol_compile_misses_total 3" in text
+    assert "gol_compile_storms_total 1" in text
+    seconds = next(
+        float(ln.split()[-1])
+        for ln in text.splitlines()
+        if ln.startswith("gol_compile_seconds_total ")
+    )
+    assert seconds > 0.0
+
+
+def test_shed_census_counter_and_summary(tmp_path, capsys):
+    """A shedding stream counts its drops per event type, feeds the
+    live gol_telemetry_shed_total tap, and leaves a shed_summary
+    degraded record on close that summarize renders as the census."""
+    reg = MetricsRegistry()
+    ev = telemetry.EventLog(str(tmp_path), run_id="shed", process_index=0)
+    ev.observer = reg.observe
+    ev.on_shed = reg.count_shed
+    ev.run_header({"driver": "test"})
+    ev.chunk_event(0, 4, 4, 0.1, 1e6, None)
+    ev.request_shed("checkpoint", "disk full: checkpoints win")
+    ev.chunk_event(1, 4, 8, 0.1, 1e6, None)
+    ev.chunk_event(2, 4, 12, 0.1, 1e6, None)
+    ev.stats_event(
+        2, 4, 12,
+        {"population": 5, "births": 1, "deaths": 1, "changed": 2},
+    )
+    assert ev.shed_counts == {"chunk": 2, "stats": 1}
+    ev.close()
+
+    text = reg.render()
+    assert 'gol_telemetry_shed_total{event="chunk"} 2' in text
+    assert 'gol_telemetry_shed_total{event="stats"} 1' in text
+
+    recs = [json.loads(ln) for ln in open(ev.path)]
+    # The file keeps what landed before the shed plus both stamps.
+    assert [r["event"] for r in recs if r["event"] == "chunk"] == ["chunk"]
+    summary = recs[-1]
+    assert summary["event"] == "degraded"
+    assert summary["action"] == "shed_summary"
+    assert summary["dropped"] == {"chunk": 2, "stats": 1}
+    assert summary["dropped_total"] == 3
+
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "shed 3 record(s) after degrading" in out
+    assert "2 chunk" in out and "1 stats" in out
+
+
+def test_shed_metrics_absent_without_drops():
+    assert "gol_telemetry_shed_total" not in MetricsRegistry().render()
+
+def test_compile_storm_halves_admission_depth(tmp_path):
+    """K cold compiles inside one window trip the detector: one storm,
+    counted on the scheduler, and the admission depth halves until the
+    window drains.  A single cold compile is not a storm."""
+    from gol_tpu.serve.scheduler import ServeScheduler
+
+    sched = ServeScheduler(
+        str(tmp_path / "s"), quantum=32, slots=2, queue_depth=8,
+        storm_threshold=2, storm_window_s=60.0,
+    )
+    try:
+        assert sched._effective_queue_depth() == 8
+        sched._note_cold_compile()
+        assert not sched.storm_active()
+        sched._note_cold_compile()
+        assert sched.storm_active()
+        assert sched.storms_total == 1
+        assert sched._effective_queue_depth() == 4
+        # Re-tripping inside the same window does not double-count.
+        sched._note_cold_compile()
+        assert sched.storms_total == 1
+    finally:
+        sched.close()
