@@ -2,14 +2,15 @@
 # gate: lint + static verifier + telemetry smoke + stats smoke +
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
 # smoke + halo smoke + chaos smoke + serve smoke + elastic smoke +
-# lockcheck + trace smoke + tier-1 tests + postmortem smoke (see
+# lockcheck + trace smoke + tier-1 tests + postmortem smoke + fleet
+# smoke (see
 # scripts/check.sh).
 
 .PHONY: lint verify lockcheck test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
 	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke \
-	trace-smoke postmortem-smoke
+	trace-smoke postmortem-smoke fleet-smoke
 
 lint:
 	bash scripts/lint.sh
@@ -147,6 +148,14 @@ trace-smoke:
 # dump refuses with exit 2.
 postmortem-smoke:
 	JAX_PLATFORMS=cpu python scripts/postmortem_smoke.py
+
+# Serving-fleet smoke (docs/SERVING.md "The fleet"): 3 supervised
+# replicas behind the replicated front tier, kill -9 one mid-flight —
+# journaled handoff to survivors, ownership fencing on the restart,
+# every request exactly-once and byte-equal, /readyz degraded and
+# recovered, graceful drain exit 0.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 
 # Open-loop serving load curve -> SERVE_r{N}.json (CPU: admission /
 # queue dynamics; the TPU headline command is pinned in the note).

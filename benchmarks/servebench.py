@@ -30,6 +30,16 @@ lower-is-better), and one ``slo`` burn-rate record per objective — so
 
 CPU rounds pin the curve SHAPE (admission behavior, queue dynamics);
 the TPU headline row is the note's pinned command.
+
+``--fleet N1,N2,...`` switches to the replicated front tier
+(docs/SERVING.md "The fleet"): each row runs a REAL fleet — N
+supervised replica processes behind an in-process
+:class:`~gol_tpu.serve.fleet.FleetFront` — at one fixed offered rate,
+so the rows answer "what does adding a replica buy" in achieved req/s.
+The final row repeats the largest N with a ``kill -9`` of the
+busiest replica mid-run: its p99 prices a journaled handoff (detection
++ migration + replay on a survivor), the fleet's headline robustness
+number.  The artifact (FLEET_rNN.json) ingests as ``tool=fleetbench``.
 """
 
 from __future__ import annotations
@@ -195,6 +205,143 @@ def run_curve(
     return rows
 
 
+def run_fleet_curve(
+    replica_counts: Sequence[int],
+    rate: float,
+    n_requests: int,
+    generations: int,
+    slots: int,
+    queue_depth: int,
+    chunk: int,
+    workdir: str,
+) -> list:
+    """One row per replica count at a fixed offered rate, plus a final
+    row repeating the largest count with a mid-run ``kill -9`` of the
+    busiest replica — the p99 of that row prices a journaled handoff.
+
+    Requests cycle four bucket keys (32/96 x auto/dense) so the ring
+    actually spreads load; every fleet runs REAL supervised replica
+    subprocesses (compile caches and all), which is what makes the
+    scaling honest on CPU too."""
+    import os
+    import signal as signal_mod
+    import types
+
+    from gol_tpu.serve import fleet as fleet_mod
+    from gol_tpu.serve.client import Backpressure, SimClient
+
+    sizes = [(32, "auto"), (96, "auto"), (32, "dense"), (96, "dense")]
+    rows = []
+    runs = [(n, False) for n in replica_counts]
+    runs.append((max(replica_counts), True))
+    for run_i, (n_replicas, kill) in enumerate(runs):
+        state = str(pathlib.Path(workdir) / f"fleet{run_i}")
+        ns = types.SimpleNamespace(
+            replicas=n_replicas, max_restarts=3, slots=slots,
+            queue_depth=queue_depth, chunk=chunk, bucket_quantum=64,
+            engine="auto",
+        )
+        replicas = fleet_mod.spawn_replicas(ns, state)
+        front = server = None
+        poll_stop = threading.Event()
+        poller = None
+        try:
+            fleet_mod.wait_replicas_healthy(replicas, timeout_s=180.0)
+            front = fleet_mod.FleetFront(replicas, state)
+            server = fleet_mod.FleetServer(front, 0)
+
+            def poll_loop():
+                while not poll_stop.is_set():
+                    front.poll()
+                    time.sleep(0.1)
+
+            poller = threading.Thread(target=poll_loop, daemon=True)
+            poller.start()
+            client = SimClient(f"http://127.0.0.1:{server.port}")
+            gap = 1.0 / rate
+            accepted, rejected = [], 0
+            # The victim owns request 0's bucket — guaranteed routed
+            # work when the kill fires at the halfway mark.
+            ring = fleet_mod.HashRing([r.name for r in replicas])
+            victim = ring.lookup(fleet_mod.bucket_key(sizes[0][0], sizes[0][1], 64))
+            t0 = time.perf_counter()
+            for i in range(n_requests):
+                target = t0 + i * gap
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                if kill and i == n_requests // 2:
+                    try:
+                        with open(
+                            os.path.join(state, victim, "manifest.json")
+                        ) as f:
+                            pid = json.load(f)["attempts"][-1]["pid"]
+                        os.kill(pid, signal_mod.SIGKILL)
+                    except (OSError, KeyError, IndexError,
+                            json.JSONDecodeError):
+                        pass
+                size, engine = sizes[i % len(sizes)]
+                rid = f"fl{run_i}-{i}"
+                try:
+                    client.submit(
+                        {"id": rid, "pattern": 4, "size": size,
+                         "generations": generations, "engine": engine}
+                    )
+                    accepted.append(rid)
+                except Backpressure:
+                    rejected += 1
+            results = {
+                rid: client.wait_for(rid, timeout_s=300.0)
+                for rid in accepted
+            }
+            wall = time.perf_counter() - t0
+            lats = sorted(
+                r["latency_s"] for r in results.values()
+                if r.get("latency_s") is not None
+            )
+            rows.append(
+                {
+                    "replicas": n_replicas,
+                    "kill": kill,
+                    "offered_rps": rate,
+                    "submitted": n_requests,
+                    "completed": len(accepted),
+                    "rejected": rejected,
+                    "achieved_rps": (
+                        len(accepted) / wall if wall > 0 else 0.0
+                    ),
+                    "wall_s": round(wall, 4),
+                    "p50_s": _percentile(lats, 0.50),
+                    "p99_s": _percentile(lats, 0.99),
+                    "handoffs": front.handoffs_total,
+                    "routing_epoch": front.epoch,
+                }
+            )
+            print(
+                f"  fleet n={n_replicas}{' +kill' if kill else '     '}"
+                f"  completed {len(accepted):>3} rejected {rejected:>3}"
+                f"  achieved {rows[-1]['achieved_rps']:.1f}/s  "
+                f"p50 {rows[-1]['p50_s']:.3f}s "
+                f"p99 {rows[-1]['p99_s']:.3f}s  "
+                f"handoffs {front.handoffs_total}"
+            )
+        finally:
+            poll_stop.set()
+            if poller is not None:
+                poller.join(timeout=5.0)
+            if front is not None:
+                front.drain(timeout_s=60.0)
+            if server is not None:
+                server.close()
+            if front is not None:
+                front.close()
+            for r in replicas:
+                if r.proc is not None and r.proc.poll() is None:
+                    r.proc.kill()
+                    r.proc.wait(timeout=10.0)
+    return rows
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="servebench", description=__doc__)
     ap.add_argument("--size", type=int, default=32)
@@ -215,11 +362,57 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(p99 over the trace decompositions, 1%% error budget)",
     )
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--fleet", default=None, metavar="N1,N2,...",
+        help="fleet mode: one row per replica count at --fleet-rate, "
+        "plus a mid-run-kill row at the largest count "
+        "(writes FLEET_r{round}.json, tool=fleetbench)",
+    )
+    ap.add_argument(
+        "--fleet-rate", type=float, default=8.0, metavar="RPS",
+        help="offered request rate for every fleet row (default 8)",
+    )
     ns = ap.parse_args(argv)
 
     import tempfile
 
     from gol_tpu.telemetry import ledger as ledger_mod
+
+    if ns.fleet:
+        counts = [int(c) for c in ns.fleet.split(",") if c]
+        workdir = tempfile.mkdtemp(prefix="fleetbench_")
+        rows = run_fleet_curve(
+            counts, ns.fleet_rate, ns.requests, ns.generations,
+            ns.slots, ns.queue_depth, ns.chunk, workdir,
+        )
+        payload = dict(
+            header=ledger_mod.artifact_header("fleetbench"),
+            note=(
+                "open-loop serving-fleet scaling curve (docs/SERVING.md"
+                ' "The fleet"). One row per replica count at a fixed '
+                "offered rate — real supervised replica subprocesses "
+                "behind the replicated front tier, requests cycling "
+                "four bucket keys so the consistent-hash ring spreads "
+                "load — plus a final row repeating the largest count "
+                "with a kill -9 of the busiest replica mid-run: its "
+                "p99 prices a journaled ownership handoff (detection, "
+                "migration, replay on a survivor). CPU rounds pin the "
+                "scaling shape; the TPU headline is: python "
+                "benchmarks/servebench.py --fleet 1,2,4 --fleet-rate 64 "
+                "--requests 96 --size 256 --generations 64"
+            ),
+            generations=ns.generations,
+            slots=ns.slots,
+            queue_depth=ns.queue_depth,
+            chunk=ns.chunk,
+            requests_per_row=ns.requests,
+            offered_rps=ns.fleet_rate,
+            rows=rows,
+        )
+        out = ns.out or str(REPO / f"FLEET_r{ns.round:02d}.json")
+        pathlib.Path(out).write_text(json.dumps(payload, indent=1) + "\n")
+        print(f"wrote {out}")
+        return 0
 
     rates = [float(r) for r in ns.rates.split(",") if r]
     workdir = tempfile.mkdtemp(prefix="servebench_")

@@ -42,6 +42,15 @@ Expectation classes (the ``kind`` field of a scenario):
   byte-identical to the clean run, and the stream must carry the v11
   ``health`` verdicts plus (for device loss) the live ``reshard``
   record (docs/RESILIENCE.md "Live elasticity").
+- ``fleet``      — the replicated front tier (docs/SERVING.md "The
+  fleet"): supervised replica subprocesses behind an in-process
+  :class:`gol_tpu.serve.fleet.FleetFront`; a ``replica.kill`` /
+  ``replica.stall`` fault (or, via the ``drill`` field, a front-tier
+  crash+restart) must lose nothing — every request completes with
+  exactly ONE journal ``complete`` across the whole fleet's folds and
+  a board byte-identical to the single-replica oracle, with the
+  handoff/fencing records proving how.  Restricted to the serve tier,
+  mesh ``none`` (replicas are processes, not devices).
 
 ``crash.exit`` scenarios need a supervisor and real process death; they
 live in the subprocess drills (tests/test_resilience_drill.py,
@@ -56,13 +65,17 @@ import dataclasses
 import json
 import os
 import tempfile
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 TIERS = ("dense", "bitpack", "pallas", "batch", "activity", "3d", "serve")
 MESHES = ("none", "1d", "2d")
-KINDS = ("guard", "resume", "contain", "shed", "telemetry", "elastic")
+KINDS = (
+    "guard", "resume", "contain", "shed", "telemetry", "elastic",
+    "fleet",
+)
 
 #: The committed grid (the acceptance surface of the chaos matrix).
 DEFAULT_PLAN_PATH = os.path.join(
@@ -79,6 +92,7 @@ class Scenario:
     redundant: bool = False  # guard kind: arm the cross-engine audit
     tiers: Optional[tuple] = None  # per-scenario restriction (else grid)
     meshes: Optional[tuple] = None
+    drill: str = ""  # fleet kind: "" (fault-driven) or "front_restart"
 
     def __post_init__(self) -> None:
         if self.kind not in KINDS:
@@ -111,6 +125,7 @@ class ChaosPlan:
                 redundant=bool(s.get("redundant", False)),
                 tiers=tuple(s["tiers"]) if "tiers" in s else None,
                 meshes=tuple(s["meshes"]) if "meshes" in s else None,
+                drill=str(s.get("drill", "")),
             )
             for s in obj["scenarios"]
         )
@@ -381,6 +396,167 @@ def _guard_failures(outcome: _Outcome, telemetry_dir: Optional[str]) -> int:
     return 0
 
 
+def _run_fleet_scenario(
+    scenario: Scenario, plan: ChaosPlan, workdir: str
+) -> None:
+    """One fleet cell: supervised replica subprocesses behind an
+    in-process :class:`FleetFront` (the chaos analogue of
+    scripts/fleet_smoke.py, small enough for the matrix).
+
+    Three requests land in one bucket — so one replica owns them all —
+    with enough generations that the injected fault catches them open.
+    The drill asserts the full contract: every id completes exactly
+    once at the JOURNAL FOLD level across all replicas, boards are
+    byte-equal to the single-replica oracle, and (for fault drills) at
+    least one handoff happened.  ``drill == "front_restart"`` instead
+    crashes the front tier between admission and completion and
+    asserts its journal fold restores the routing epoch and route map.
+    """
+    import types
+
+    from gol_tpu.resilience import faults as faults_mod
+    from gol_tpu.serve import fleet as fleet_mod
+    from gol_tpu.serve import journal as journal_mod
+    from gol_tpu.serve.scheduler import decode_board
+
+    cell = tempfile.mkdtemp(prefix="fleet_", dir=workdir)
+    gens = plan.iterations * 50  # long enough to be mid-flight killable
+    # Single-replica oracle: the in-process serve cell with the same
+    # three requests (Life is deterministic — chunking cannot matter).
+    faults_mod.clear()
+    ref = _run_serve(
+        "none", plan, _RunCfg(iterations=gens), cell
+    )
+    ns = types.SimpleNamespace(
+        replicas=2, max_restarts=2, slots=4, queue_depth=8,
+        chunk=plan.guard_every, bucket_quantum=64, engine="auto",
+    )
+    replicas = fleet_mod.spawn_replicas(ns, os.path.join(cell, "fleet"))
+    front = None
+    try:
+        fleet_mod.wait_replicas_healthy(replicas, timeout_s=120.0)
+        front = fleet_mod.FleetFront(
+            replicas, os.path.join(cell, "fleet"),
+            probe_timeout=1.0,
+        )
+        ids = [f"w{i}" for i in range(3)]
+        for rid in ids:
+            status, payload = front.submit(
+                {
+                    "id": rid, "pattern": _PATTERN,
+                    "size": plan.size, "generations": gens,
+                }
+            )
+            assert status in (200, 202), (
+                f"fleet admission of {rid} failed ({status}): {payload}"
+            )
+        owner = front._routes[ids[0]]["replica"]
+        epoch0 = front.epoch
+        if scenario.drill == "front_restart":
+            # Crash the front tier (close without drain), rebuild it
+            # from the same state dir: the journal fold must restore
+            # the route map, and the epoch must move FORWARD.
+            front.close()
+            front = fleet_mod.FleetFront(
+                replicas, os.path.join(cell, "fleet"),
+                probe_timeout=1.0,
+            )
+            assert front.epoch > epoch0, (
+                "a restarted front tier must bump the routing epoch "
+                f"(got {front.epoch} after {epoch0})"
+            )
+            for rid in ids:
+                route = front._routes.get(rid)
+                assert route is not None and route["replica"] == owner, (
+                    f"route for {rid} not restored from the fleet "
+                    f"journal fold: {route}"
+                )
+        else:
+            # Point the armed replica faults at the owner — the plan
+            # file cannot know which replica the ring picks.
+            names = sorted(front.replicas)
+            fault_plan = faults_mod.FaultPlan.from_obj(
+                list(scenario.faults)
+            )
+            for spec in fault_plan.faults:
+                if spec.site.startswith(("replica.", "fleet.")):
+                    spec.device = names.index(owner)
+            faults_mod.install(fault_plan)
+        results = {}
+        deadline = time.time() + 180.0
+        while len(results) < len(ids) and time.time() < deadline:
+            front.poll()
+            for rid in ids:
+                if rid in results:
+                    continue
+                status, payload = front.result(rid)
+                if status == 200:
+                    results[rid] = payload
+            time.sleep(0.05)
+        assert len(results) == len(ids), (
+            f"only {sorted(results)} of {ids} completed — the fleet "
+            "lost accepted requests"
+        )
+        for i, rid in enumerate(ids):
+            assert np.array_equal(
+                decode_board(results[rid]["board"]), ref.final[i]
+            ), f"{rid}: fleet board != single-replica oracle"
+        if scenario.drill != "front_restart":
+            assert front.handoffs_total >= 1, (
+                "no handoff fired — the fault never caught an open "
+                "intent (drill timing broke)"
+            )
+        # Exactly-once at the fold level, fleet-wide: each id must fold
+        # to completed on EXACTLY one replica (fencing arbitrates any
+        # physically-duplicated writes).
+        completes = {rid: 0 for rid in ids}
+        for r in replicas:
+            entries, _torn = journal_mod.replay(r.journal_path)
+            for rid, e in entries.items():
+                if rid in completes and e["status"] == "completed":
+                    completes[rid] += 1
+        assert all(n == 1 for n in completes.values()), (
+            f"fold-level completes per id: {completes} (want all 1)"
+        )
+        # Let a killed/stalled owner come back and prove the fence:
+        # wait for restore, then assert its fold STILL re-runs nothing.
+        if scenario.drill != "front_restart" and front.handoffs_total:
+            restore_deadline = time.time() + 60.0
+            while (
+                owner not in front.alive
+                and time.time() < restore_deadline
+            ):
+                front.poll()
+                time.sleep(0.05)
+            entries, _torn = journal_mod.replay(
+                front.replicas[owner].journal_path
+            )
+            migrated = [
+                rid for rid, e in entries.items()
+                if rid in completes and e["status"] == "handed_off"
+            ]
+            assert migrated, (
+                "no handed_off entry in the original owner's fold — "
+                "the both-sides handoff record is missing"
+            )
+    finally:
+        faults_mod.clear()
+        if front is not None:
+            front.drain(timeout_s=60.0)
+            front.close()
+        for r in replicas:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.kill()
+        # A SIGKILLed supervisor child can leave a replica orphaned
+        # only if the supervisor itself died; reap defensively.
+        for r in replicas:
+            if r.proc is not None:
+                try:
+                    r.proc.wait(timeout=10.0)
+                except Exception:
+                    pass
+
+
 def _run_scenario(
     scenario: Scenario, tier: str, mesh: str, plan: ChaosPlan,
     clean, workdir: str,
@@ -500,6 +676,10 @@ def _run_scenario(
                     and r.get("verdict") in ("straggler", "hedge")
                     for r in recs
                 ), "no straggler/hedge verdict — the watchdog missed it"
+        elif scenario.kind == "fleet":
+            # Installs its own (owner-targeted) plan and asserts the
+            # full handoff/fencing/exactly-once contract itself.
+            _run_fleet_scenario(scenario, plan, workdir)
         elif scenario.kind in ("contain", "shed", "telemetry"):
             install()
             out = _run_cell(

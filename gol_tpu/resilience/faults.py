@@ -51,6 +51,20 @@ site                    what fires
                         straggler watchdog must flag it (the wall is
                         inflated, not slept, so drills stay fast — on real
                         hardware the measurement needs no injection)
+``replica.kill``        SIGKILL the fleet replica at index ``device``
+                        mid-flight — the front tier's host monitor must see
+                        the missed heartbeats, hand the replica's open
+                        intents off, and fence the supervisor's relaunch
+                        (docs/SERVING.md, "The fleet")
+``replica.stall``       freeze heartbeat responses from replica ``device``
+                        for ``delay_s`` seconds — the dead-then-returns
+                        drill: handoff fires, then the original comes back
+                        and must find its intents owned elsewhere
+``fleet.partition``     the front tier cannot reach replica ``device`` for
+                        ``delay_s`` seconds (the replica itself stays
+                        healthy) — a one-sided network cut; exactly-once
+                        must hold even though the "dead" replica keeps
+                        executing
 ======================  =====================================================
 
 Plans load from JSON — ``--fault-plan PATH`` on both CLIs, or the
@@ -85,6 +99,9 @@ SITES = (
     "rank.stall",
     "device.loss",
     "rank.slowdown",
+    "replica.kill",
+    "replica.stall",
+    "fleet.partition",
 )
 
 #: The documented back-compat alias for a
@@ -115,7 +132,10 @@ class FaultSpec:
       or the wall inflation a ``rank.slowdown`` reports.
     - ``device``: the mesh device a ``device.loss`` takes out;
       ``restore_after`` > 0 schedules its return that many generations
-      after the loss (0 = the device stays gone).
+      after the loss (0 = the device stays gone).  The fleet sites
+      (``replica.kill`` / ``replica.stall`` / ``fleet.partition``)
+      reuse ``device`` as the replica index and ``delay_s`` as the
+      stall / partition window.
     """
 
     site: str

@@ -23,6 +23,16 @@ the ``gol_health_*`` metrics (docs/OBSERVABILITY.md).  The plane is
 host-side by construction: with no monitor installed nothing runs, and
 with one installed the compiled chunk programs are byte-identical (the
 trace-identity pin in tests/test_health.py).
+
+PR 19 lifts the same design one level up: :class:`HostMonitor` watches
+whole *replicas* instead of devices, fed by the fleet front tier's
+``/healthz`` probes (docs/SERVING.md, "The fleet").  Same shape —
+missed-beat verdicts instead of device loss, a median-window latency
+baseline instead of chunk walls, and flap damping (``restore_beats``
+consecutive healthy probes before a dead replica is readmitted) so a
+replica oscillating across the miss threshold cannot thrash the
+routing epoch.  Verdicts land as schema-v14 ``fleet`` events with
+``action="replica"`` and drive the ``gol_fleet_*`` gauges.
 """
 
 from __future__ import annotations
@@ -36,6 +46,9 @@ from gol_tpu.resilience import faults as faults_mod
 
 #: Verdict kinds, in the order a boundary can produce them.
 KINDS = ("device_loss", "device_restore", "straggler")
+
+#: Host-level (replica) verdict kinds, PR 19's fleet plane.
+HOST_KINDS = ("replica_dead", "replica_slow", "replica_restore")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,4 +216,190 @@ class HealthMonitor:
                 self._events.health_event(generation=v.generation, **payload)
             elif self._registry is not None:
                 rec = dict(event="health", generation=v.generation, **payload)
+                self._registry.observe(rec)
+
+
+@dataclasses.dataclass(frozen=True)
+class HostVerdict:
+    """One host-plane decision about a whole replica (schema v14)."""
+
+    kind: str
+    replica: str
+    tick: int
+    alive: int = 0
+    latency_s: float = 0.0
+    baseline_s: float = 0.0
+
+    def to_event(self) -> dict:
+        out = {
+            "verdict": self.kind,
+            "replica": self.replica,
+            "alive": self.alive,
+        }
+        if self.kind == "replica_slow":
+            out["latency_s"] = round(self.latency_s, 6)
+            out["baseline_s"] = round(self.baseline_s, 6)
+        return out
+
+
+class HostMonitor:
+    """Replica-level health from periodic ``/healthz`` probe results.
+
+    The fleet front tier (:mod:`gol_tpu.serve.fleet`) calls
+    :meth:`beat` once per probe round per replica with the probe's
+    outcome; the monitor folds those into verdicts:
+
+    - ``replica_dead`` after ``miss_threshold`` CONSECUTIVE failed
+      probes — one dropped packet is noise, a run of them is a dead
+      host.  The replica leaves the alive set; the front tier reacts
+      by migrating its journaled open intents (the handoff).
+    - ``replica_restore`` after ``restore_beats`` consecutive healthy
+      probes from a replica currently considered dead — the flap
+      damper: a replica oscillating around the miss threshold cannot
+      re-enter (and re-bump the routing epoch) until it holds a
+      streak.
+    - ``replica_slow`` when a healthy probe's latency exceeds
+      ``latency_factor`` × the median of the replica's recent healthy
+      latencies.  Advisory only — it never changes the alive set
+      (a slow host still owns its intents) but it is the early-warning
+      line on the operator's dashboard.
+
+    Same emission pair as :class:`HealthMonitor`: v14 ``fleet`` events
+    when a log is attached, else straight to the metrics registry, and
+    both optional so the monitor works bare in unit tests.
+    """
+
+    def __init__(
+        self,
+        replicas: List[str],
+        miss_threshold: int = 3,
+        restore_beats: int = 2,
+        latency_factor: float = 8.0,
+        window: int = 16,
+        min_samples: int = 3,
+        min_latency_s: float = 0.005,
+        events=None,
+        registry=None,
+    ) -> None:
+        if not replicas:
+            raise ValueError("HostMonitor needs at least one replica")
+        if miss_threshold < 1:
+            raise ValueError(
+                f"miss_threshold must be >= 1, got {miss_threshold}"
+            )
+        if restore_beats < 1:
+            raise ValueError(
+                f"restore_beats must be >= 1, got {restore_beats}"
+            )
+        if latency_factor <= 1.0:
+            raise ValueError(
+                f"latency_factor must exceed 1, got {latency_factor}"
+            )
+        self.replicas = list(replicas)
+        self.miss_threshold = miss_threshold
+        self.restore_beats = restore_beats
+        self.latency_factor = latency_factor
+        self.min_samples = min_samples
+        # Loopback probes jitter by whole multiples of themselves under
+        # scheduler noise; the slow verdict only trusts latencies above
+        # this floor (the min_wall_s idea, one level up).
+        self.min_latency_s = min_latency_s
+        self._alive = set(self.replicas)
+        self._misses = {r: 0 for r in self.replicas}
+        self._oks = {r: 0 for r in self.replicas}
+        self._latencies: dict = {
+            r: deque(maxlen=window) for r in self.replicas
+        }
+        self._events = events
+        self._registry = registry
+
+    # -- state ----------------------------------------------------------------
+
+    @property
+    def alive(self) -> List[str]:
+        return sorted(self._alive)
+
+    def is_alive(self, replica: str) -> bool:
+        return replica in self._alive
+
+    def baseline(self, replica: str) -> Optional[float]:
+        lats = self._latencies[replica]
+        if len(lats) < self.min_samples:
+            return None
+        return statistics.median(lats)
+
+    # -- sampling -------------------------------------------------------------
+
+    def beat(
+        self, replica: str, ok: bool, latency_s: float = 0.0, tick: int = 0
+    ) -> List[HostVerdict]:
+        """Fold one probe result; returns any verdicts it produced."""
+        if replica not in self._misses:
+            raise KeyError(f"unknown replica {replica!r}")
+        verdicts: List[HostVerdict] = []
+        if not ok:
+            self._oks[replica] = 0
+            self._misses[replica] += 1
+            if (
+                replica in self._alive
+                and self._misses[replica] >= self.miss_threshold
+            ):
+                self._alive.discard(replica)
+                verdicts.append(
+                    HostVerdict(
+                        "replica_dead", replica, tick,
+                        alive=len(self._alive),
+                    )
+                )
+        else:
+            self._misses[replica] = 0
+            self._oks[replica] += 1
+            if (
+                replica not in self._alive
+                and self._oks[replica] >= self.restore_beats
+            ):
+                self._alive.add(replica)
+                # A restored replica's latency history is stale (it
+                # just rebooted); start the baseline fresh.
+                self._latencies[replica].clear()
+                verdicts.append(
+                    HostVerdict(
+                        "replica_restore", replica, tick,
+                        alive=len(self._alive),
+                    )
+                )
+            base = self.baseline(replica)
+            if (
+                replica in self._alive
+                and base is not None
+                and latency_s > self.min_latency_s
+                and latency_s > self.latency_factor * max(base, 1e-9)
+            ):
+                verdicts.append(
+                    HostVerdict(
+                        "replica_slow", replica, tick,
+                        alive=len(self._alive),
+                        latency_s=latency_s,
+                        baseline_s=base,
+                    )
+                )
+            else:
+                # Slow probes stay out of the window so a degrading
+                # host cannot drag its own baseline up and mask itself.
+                self._latencies[replica].append(latency_s)
+        self._emit(verdicts)
+        return verdicts
+
+    # -- emission -------------------------------------------------------------
+
+    def _emit(self, verdicts: List[HostVerdict]) -> None:
+        for v in verdicts:
+            payload = v.to_event()
+            if self._events is not None:
+                self._events.fleet_event(
+                    "replica", tick=v.tick, **payload
+                )
+            elif self._registry is not None:
+                rec = dict(event="fleet", action="replica",
+                           tick=v.tick, **payload)
                 self._registry.observe(rec)

@@ -8,6 +8,18 @@ server may be mid-supervised-restart) by resubmitting the same id —
 admission is exactly-once on the id, so a retry can never double-run a
 request.  429/503 rejections surface as :class:`Backpressure` with the
 server's ``retry_after`` hint.
+
+The client is fleet-aware (docs/SERVING.md "The fleet"), and both
+behaviors are inert against a single server:
+
+- a 307 from a front tier in direct-to-replica mode carries the routed
+  replica's base URL plus the ``owner_epoch`` to stamp; ``submit``
+  re-POSTs there itself (one hop, never a loop).
+- a 404 from :meth:`wait_for` that carries a ``routing_epoch`` is a
+  mid-handoff window, not a verdict: the poll retries until the 404
+  survives an epoch CHANGE (the fleet re-resolved membership and still
+  does not know the id) — a plain 404 with no epoch stays immediately
+  fatal, exactly as before.
 """
 
 from __future__ import annotations
@@ -39,8 +51,11 @@ class SimClient:
         data = (
             json.dumps(body).encode() if body is not None else None
         )
+        url = (
+            path if path.startswith("http") else self.base_url + path
+        )
         req = urllib.request.Request(
-            self.base_url + path, data=data, method=method,
+            url, data=data, method=method,
             headers={"Content-Type": "application/json"},
         )
         try:
@@ -85,6 +100,33 @@ class SimClient:
                 continue
             if status in (200, 202):
                 return payload
+            if status == 307 and "replica" in payload:
+                # Fleet direct-to-replica mode: the front tier answered
+                # a routing hint instead of proxying.  Re-POST the body
+                # to the routed replica ourselves, stamped with the id
+                # the front minted and the routing epoch it pinned —
+                # one hop only (a replica never answers 307 itself).
+                routed = {
+                    **request,
+                    "id": payload["id"],
+                    "owner_epoch": payload["owner_epoch"],
+                }
+                try:
+                    status, payload = self._call(
+                        "POST",
+                        payload["replica"].rstrip("/") + "/simulate",
+                        routed,
+                    )
+                except (
+                    urllib.error.URLError, ConnectionError, OSError,
+                ):
+                    if attempt >= connect_retries:
+                        raise
+                    attempt += 1
+                    time.sleep(retry_delay_s)
+                    continue
+                if status in (200, 202):
+                    return payload
             if status in (429, 503):
                 raise Backpressure(
                     status, payload.get("error", "rejected"),
@@ -107,9 +149,17 @@ class SimClient:
     ) -> dict:
         """Poll until the request reaches a terminal payload.  Connection
         drops are tolerated up to ``connect_retries`` times total (the
-        supervised server may be restarting under an armed fault plan)."""
+        supervised server may be restarting under an armed fault plan).
+
+        Against a fleet front tier a 404 carries the ``routing_epoch``
+        it was observed under; a mid-handoff poll (the id is between
+        owners) must not read as lost, so the 404 only becomes fatal
+        once it survives an epoch change — the membership event
+        resolved and the fleet STILL does not know the id.  A 404
+        without an epoch (a single server) stays immediately fatal."""
         deadline = time.time() + timeout_s
         drops = 0
+        first_404_epoch: Optional[int] = None
         while time.time() < deadline:
             try:
                 status, payload = self.result(request_id)
@@ -122,7 +172,19 @@ class SimClient:
             if status == 200:
                 return payload
             if status == 404:
-                raise KeyError(f"server does not know {request_id!r}")
+                epoch = payload.get("routing_epoch")
+                if epoch is None:
+                    raise KeyError(
+                        f"server does not know {request_id!r}"
+                    )
+                if first_404_epoch is None:
+                    first_404_epoch = epoch
+                elif epoch > first_404_epoch:
+                    raise KeyError(
+                        f"fleet does not know {request_id!r} "
+                        f"(held across routing epoch "
+                        f"{first_404_epoch} -> {epoch})"
+                    )
             time.sleep(poll_s)
         raise TimeoutError(
             f"request {request_id!r} not terminal after {timeout_s}s"

@@ -19,6 +19,12 @@ transition:
 - ``complete`` — the result file landed (its fingerprint and
   ``trace_id`` ride along, cross-correlating journal and trace stream).
 - ``cancel``   — a deadline expired at a chunk boundary.
+- ``handoff``  — fleet ownership moved (docs/SERVING.md "The fleet"):
+  the front tier migrated this intent to another replica at routing
+  epoch ``epoch``.  Written on BOTH sides — the (dead or unreachable)
+  owner's journal and the fleet's own — so migration is idempotent and
+  first-wins: a replica returning from supervisor restart folds its
+  journal, finds the intent owned elsewhere, and drops it.
 
 Recovery is a pure fold over the records (:func:`replay`): admitted ids
 without a terminal record are re-admitted, completed ids are never run
@@ -28,6 +34,22 @@ mid-append — is tolerated: an unparseable line was never acknowledged to
 anyone, so it simply does not count; :meth:`Journal.append` self-heals
 an unterminated tail before the next record so one torn write can never
 corrupt its successor.
+
+**Ownership fencing (fleet mode).**  The journal was single-writer by
+assumption until the fleet: the front tier appends ``handoff`` records
+into a replica's journal while that replica is dead (or blind behind a
+partition), so the fold must arbitrate.  Fleet-proxied records carry an
+``owner_epoch`` — the routing epoch the request was admitted under —
+and a ``handoff`` at epoch E fences every later record from an epoch
+< E: a stalled original that wakes and journals ``complete`` under its
+old epoch loses to the handoff, the fold stays ``handed_off``, and the
+replica's replay re-runs nothing.  A later ``admit`` at an epoch >= E
+re-owns the id (an explicit hand-back).  Single-server journals carry
+no ``owner_epoch`` at all and fold byte-for-byte as before.
+
+Fleet-journal record kinds (``epoch``, ``route``) share the append
+discipline but are folded by :func:`gol_tpu.serve.fleet.fleet_replay`;
+a replica's fold ignores them (no admit, unknown id).
 
 Fault plane: appends fire the ``checkpoint.*`` injection sites
 (:mod:`gol_tpu.resilience.faults`) with the record index as the
@@ -58,7 +80,13 @@ from typing import Dict, Tuple
 
 from gol_tpu.resilience import faults as faults_mod
 
-RECORD_KINDS = ("admit", "start", "complete", "cancel")
+RECORD_KINDS = (
+    "admit", "start", "complete", "cancel",
+    # Fleet kinds (docs/SERVING.md "The fleet"): ``handoff`` fences a
+    # replica-journal fold; ``epoch``/``route`` live only in the front
+    # tier's own journal (gol_tpu/serve/fleet.py folds them).
+    "handoff", "epoch", "route",
+)
 _SEGMENT_RE = re.compile(r"\.(\d+)$")
 
 
@@ -193,11 +221,22 @@ def replay(path: str) -> Tuple[Dict[str, dict], int]:
     """Fold a journal into per-request state: ``(entries, torn_lines)``.
 
     ``entries`` maps request id -> ``{"admit": <admit record>,
-    "status": admitted|started|completed|cancelled, "terminal": <record>}``
-    in admission order.  Unparseable lines (torn appends — final OR
-    healed mid-file) were never acknowledged, so they are counted and
-    ignored; duplicate admits are idempotent; records for unknown ids
-    (their admit was torn) are dropped.
+    "status": admitted|started|completed|cancelled|handed_off,
+    "terminal": <record>, "fence_epoch": <int or None>}`` in admission
+    order.  Unparseable lines (torn appends — final OR healed mid-file)
+    were never acknowledged, so they are counted and ignored; duplicate
+    admits are idempotent; records for unknown ids (their admit was
+    torn) are dropped.
+
+    The fold arbitrates multi-writer fleet journals by epoch: a
+    ``handoff`` record at epoch E marks the entry ``handed_off`` (a
+    terminal state for THIS replica — ownership moved) and fences every
+    subsequent record whose ``owner_epoch`` is < E, including legacy
+    records with no epoch at all — the handoff is authoritative, so a
+    fenced replica's late ``complete`` never counts.  An ``admit`` at
+    an epoch >= the fence re-owns the id (hand-back).  A ``complete``
+    already folded before the handoff wins instead (the result is
+    durable; the front tier never migrates a completed intent).
     """
     entries: Dict[str, dict] = {}
     torn = 0
@@ -216,13 +255,38 @@ def replay(path: str) -> Tuple[Dict[str, dict], int]:
             rid = rec.get("id")
             kind = rec.get("rec")
             if kind == "admit":
-                entries.setdefault(
-                    rid, {"admit": rec, "status": "admitted",
-                          "terminal": None}
-                )
+                e = entries.get(rid)
+                if e is None:
+                    entries[rid] = {
+                        "admit": rec, "status": "admitted",
+                        "terminal": None, "fence_epoch": None,
+                    }
+                elif e["status"] == "handed_off" and int(
+                    rec.get("owner_epoch", 0) or 0
+                ) >= (e["fence_epoch"] or 0):
+                    # Hand-back: a NEWER epoch re-owns the id here.
+                    # Records older than the hand-back stay fenced.
+                    entries[rid] = {
+                        "admit": rec, "status": "admitted",
+                        "terminal": None,
+                        "fence_epoch": int(rec.get("owner_epoch", 0) or 0),
+                    }
+                # else: duplicate admit — first wins.
             elif rid in entries:
                 e = entries[rid]
-                if kind == "start" and e["status"] == "admitted":
+                fence = e.get("fence_epoch")
+                if kind == "handoff":
+                    if e["status"] not in ("completed", "cancelled"):
+                        e["status"] = "handed_off"
+                        e["terminal"] = rec
+                        e["fence_epoch"] = int(rec.get("epoch", 0) or 0)
+                elif fence is not None and int(
+                    rec.get("owner_epoch", 0) or 0
+                ) < fence:
+                    # A record from a fenced epoch: the write lost the
+                    # ownership race — it does not count.
+                    continue
+                elif kind == "start" and e["status"] == "admitted":
                     e["status"] = "started"
                 elif kind == "complete":
                     e["status"] = "completed"

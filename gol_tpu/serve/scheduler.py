@@ -168,6 +168,11 @@ class RequestState:
         # maintained whether or not telemetry is attached — one payload
         # shape, one code path.
         self.trace_id = ""
+        # Fleet mode (docs/SERVING.md "The fleet"): the routing epoch
+        # this request was admitted under.  ``None`` outside a fleet —
+        # single-server journals then carry no epoch at all, keeping
+        # their bytes identical to pre-fleet behavior.
+        self.owner_epoch: Optional[int] = None
         self.queued_t = self.submitted_t
         self.chunk_span_id: Optional[str] = None
         self.phase_s = {
@@ -412,9 +417,18 @@ class ServeScheduler:
             # preserves admits verbatim and replay restores the id, so
             # a crash-replayed request reconstructs its pre-crash spans.
             trace_id = trace_mod.new_trace_id(req.id)
+            # Fleet mode stamps the routing epoch the front tier
+            # proxied this request under; the fold arbitrates
+            # multi-writer journals by it.  Absent outside a fleet so
+            # single-server journal bytes stay identical.
+            owner_epoch = obj.get("owner_epoch")
+            epoch_fields = (
+                {} if owner_epoch is None
+                else {"owner_epoch": owner_epoch}
+            )
             rec = journal_mod.record(
                 "admit", req.id, request=req.to_dict(), ordinal=ordinal,
-                trace_id=trace_id,
+                trace_id=trace_id, **epoch_fields,
             )
             if not self._journal_write(rec):
                 # The admit could not be made durable: this request was
@@ -434,6 +448,7 @@ class ServeScheduler:
             self._next_ordinal = ordinal + 1
             state = RequestState(req, ordinal, self._initial_board(req))
             state.trace_id = trace_id
+            state.owner_epoch = owner_epoch
             self._requests[req.id] = state
             grp.queue.append(state)
             self.admitted_total += 1
@@ -458,6 +473,70 @@ class ServeScheduler:
         """Stop admitting; the loop finishes everything committed."""
         with self._lock:
             self._draining = True
+
+    @staticmethod
+    def _epoch_fields(state: RequestState) -> dict:
+        """Journal fields for fleet ownership fencing — empty outside a
+        fleet, so single-server journal bytes never change."""
+        if state.owner_epoch is None:
+            return {}
+        return {"owner_epoch": state.owner_epoch}
+
+    def fence(self, request_ids, epoch: int) -> int:
+        """Drop ownership of open requests migrated away at ``epoch``
+        (docs/SERVING.md "The fleet").
+
+        The front tier calls this (``POST /fence``) after handing a
+        stalled-but-alive replica's intents to a new owner: the request
+        leaves the queue/slots WITHOUT a ``complete``/``cancel`` — a
+        ``handoff`` record lands in our journal instead, so a restart's
+        fold agrees with the live state.  Terminal requests are left
+        alone (their completion won the race; the fold arbitrates).
+        Returns how many requests were actually fenced.
+        """
+        fenced = 0
+        with self._lock:
+            for rid in request_ids:
+                state = self._requests.get(rid)
+                if state is None or state.status in ("done", "expired"):
+                    continue
+                grp = self._group_for(state.request)
+                try:
+                    grp.queue.remove(state)
+                except ValueError:
+                    pass
+                occupied = [
+                    k for k, s in enumerate(grp.slots) if s is state
+                ]
+                if occupied:
+                    # Evicting a RUNNING slot drops the device stack;
+                    # host-sync the co-residents first so the rebuild
+                    # does not rewind them (same move as deadline
+                    # cancellation).
+                    if grp.stack is not None:
+                        host = np.asarray(grp.stack)
+                        for k, s in enumerate(grp.slots):
+                            if s is not None:
+                                n = s.request.size
+                                s.board = host[k, :n, :n].copy()
+                    for k in occupied:
+                        grp.slots[k] = None
+                    grp.stack = None
+                    grp.last_good = None
+                self._journal_write(
+                    journal_mod.record(
+                        "handoff", rid, epoch=epoch, by="fence",
+                    )
+                )
+                del self._requests[rid]
+                fenced += 1
+                self._emit(
+                    "fenced", rid, owner_epoch=state.owner_epoch,
+                    fence_epoch=epoch, trace_id=state.trace_id,
+                )
+                state.status = "fenced"
+                state.done.set()
+        return fenced
 
     @property
     def draining(self) -> bool:
@@ -552,7 +631,7 @@ class ServeScheduler:
             raise ValidationError("request body must be a JSON object")
         known = {
             "id", "pattern", "size", "generations", "engine", "rule",
-            "deadline_s", "stream_stats", "wait",
+            "deadline_s", "stream_stats", "wait", "owner_epoch",
         }
         unknown = set(obj) - known
         if unknown:
@@ -586,6 +665,16 @@ class ServeScheduler:
         if engine not in _ENGINES:
             raise ValidationError(
                 f"unknown engine {engine!r}; expected one of {_ENGINES}"
+            )
+        owner_epoch = obj.get("owner_epoch")
+        if owner_epoch is not None and (
+            not isinstance(owner_epoch, int)
+            or isinstance(owner_epoch, bool)
+            or owner_epoch < 0
+        ):
+            raise ValidationError(
+                f"owner_epoch must be an integer >= 0 (the fleet "
+                f"routing epoch), got {owner_epoch!r}"
             )
         deadline_s = obj.get("deadline_s")
         if deadline_s is not None and (
@@ -753,7 +842,10 @@ class ServeScheduler:
 
     def _replay_journal(self) -> None:
         """Re-admit every admitted-but-unfinished journal entry, load
-        completed results back, and never re-run a completed id."""
+        completed results back, and never re-run a completed id.  A
+        ``handed_off`` entry — the front tier migrated it to another
+        replica while this process was dead — is DROPPED, not re-run:
+        ownership fencing by epoch (docs/SERVING.md "The fleet")."""
         entries, torn = journal_mod.replay(self._journal.path)
         for rid, entry in entries.items():
             admit = entry["admit"]
@@ -767,6 +859,13 @@ class ServeScheduler:
             # fresh one): pre-crash spans in the dead run's rank file
             # join the spans this process emits under one trace.
             trace_id = admit.get("trace_id") or trace_mod.new_trace_id(rid)
+            if entry["status"] == "handed_off":
+                self._emit(
+                    "fenced", rid, trace_id=trace_id,
+                    owner_epoch=admit.get("owner_epoch"),
+                    fence_epoch=entry.get("fence_epoch"),
+                )
+                continue
             if entry["status"] in ("completed", "cancelled"):
                 state = RequestState(req, ordinal, np.zeros((1, 1), np.uint8))
                 state.trace_id = trace_id
@@ -779,6 +878,8 @@ class ServeScheduler:
                 continue
             state = RequestState(req, ordinal, self._initial_board(req))
             state.trace_id = trace_id
+            oe = admit.get("owner_epoch")
+            state.owner_epoch = oe if isinstance(oe, int) else None
             t = admit.get("t")
             if isinstance(t, (int, float)) and not isinstance(t, bool):
                 # Deadlines and latency are measured from the ORIGINAL
@@ -876,6 +977,7 @@ class ServeScheduler:
             journal_mod.record(
                 "cancel", state.request.id, reason="deadline",
                 generation=state.generation, trace_id=state.trace_id,
+                **self._epoch_fields(state),
             )
         )
         self._tracer.span(
@@ -930,7 +1032,8 @@ class ServeScheduler:
                 grp.last_good = None
                 self._journal_write(
                     journal_mod.record(
-                        "start", state.request.id, ordinal=state.ordinal
+                        "start", state.request.id, ordinal=state.ordinal,
+                        **self._epoch_fields(state),
                     )
                 )
                 self._emit(
@@ -1480,6 +1583,7 @@ class ServeScheduler:
             journal_mod.record(
                 "complete", state.request.id, fingerprint=int(fp),
                 generation=state.generation, trace_id=state.trace_id,
+                **self._epoch_fields(state),
             )
         )
         # The commit span covers making the result durable; the root

@@ -25,6 +25,10 @@ Endpoints::
     GET  /debug/blackbox -> ndjson snapshot of the flight-recorder
                         ring (schema v13, same bytes a crash dump
                         would write) | 404 recorder disabled
+    POST /fence      -> 200 {"fenced": n}: the fleet front tier
+                        migrated these ids to another replica at the
+                        given routing epoch — drop them uncompleted
+                        (docs/SERVING.md "The fleet")
     POST /shutdown   -> 200, then graceful drain: stop admitting,
                         finish every committed request, exit 0
 
@@ -150,12 +154,41 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         path = self.path.rstrip("/")
         if path == "/simulate":
             self._simulate()
+        elif path == "/fence":
+            self._fence()
         elif path == "/shutdown":
             self.scheduler.drain()
             self.stop_event.set()
             self._json(200, {"ok": True, "draining": True})
         else:
-            self.send_error(404, "POST routes: /simulate /shutdown")
+            self.send_error(404, "POST routes: /simulate /fence /shutdown")
+
+    def _fence(self) -> None:
+        """Fleet ownership fencing (docs/SERVING.md "The fleet"): the
+        front tier migrated these ids to another replica at ``epoch``;
+        this replica must drop them without completing."""
+        try:
+            body = self._body()
+        except ValidationError as e:
+            self._json(400, {"error": str(e)})
+            return
+        ids = body.get("ids")
+        epoch = body.get("epoch")
+        if (
+            not isinstance(ids, list)
+            or not all(isinstance(i, str) for i in ids)
+            or not isinstance(epoch, int)
+            or isinstance(epoch, bool)
+            or epoch < 0
+        ):
+            self._json(
+                400,
+                {"error": "fence body must be "
+                          '{"ids": [str, ...], "epoch": int >= 0}'},
+            )
+            return
+        fenced = self.scheduler.fence(ids, epoch)
+        self._json(200, {"fenced": fenced, "epoch": epoch})
 
     def _simulate(self) -> None:
         try:

@@ -69,7 +69,21 @@ from typing import Dict, Optional
 # only lazily (inside its dump path), so there is no cycle.
 from gol_tpu.telemetry import blackbox
 
-# Version 13 (this round) makes the process a black box and compilation
+# Version 14 (this round) lifts observability from one server to the
+# fleet (docs/SERVING.md, "The fleet"): a ``fleet`` record marks one
+# decision of the replicated front tier (:mod:`gol_tpu.serve.fleet`) —
+# ``action`` is one of ``route`` (a request was pinned to a replica by
+# consistent hash of its bucket key; carries ``request_id``, ``bucket``,
+# ``replica``, ``epoch``), ``epoch`` (the routing epoch advanced on a
+# membership change; carries ``epoch``, ``members``, ``reason``),
+# ``handoff`` (a dead/unreachable replica's open intent was re-admitted
+# to a surviving replica under the SAME id; carries ``request_id``,
+# ``src``, ``dst``, ``epoch``), or ``replica`` (a HostMonitor verdict —
+# ``verdict`` is ``replica_dead`` / ``replica_slow`` /
+# ``replica_restore``, with ``replica``, ``alive``, and for slow
+# verdicts ``latency_s``/``baseline_s``).  The ``gol_fleet_*`` metrics
+# are fed from the same records.
+# Version 13 made the process a black box and compilation
 # a first-class observable (docs/OBSERVABILITY.md, "Black box &
 # postmortems"): :mod:`gol_tpu.telemetry.blackbox` keeps a bounded
 # in-memory ring of the last N records — every event the v12 stream
@@ -167,15 +181,15 @@ from gol_tpu.telemetry import blackbox
 # resilience events — ``preempt``, ``resume``, ``restart``
 # (docs/RESILIENCE.md); version 2 the ``stats`` event type and optional
 # ``memory``/``cost`` blocks on ``compile`` events.  Older streams stay
-# readable: every v1-v11 event type and field survives unchanged, so
+# readable: every v1-v13 event type and field survives unchanged, so
 # consumers only ever *gain* records (back-compat pinned by the
-# committed v1..v13 fixture tests).
+# committed v1..v14 fixture tests).
 # Streams NEWER than this reader refuse loudly: ``validate_record``
 # raises a "schema vN is newer than this reader supports" SchemaError
 # (exit 2 at the CLI) instead of letting a consumer KeyError on a field
 # it has never heard of.
-SCHEMA_VERSION = 13
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
+SCHEMA_VERSION = 14
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may
@@ -264,6 +278,12 @@ REQUIRED_FIELDS: Dict[str, frozenset] = {
     # K); the admission throttle engages until the window drains
     # (docs/SERVING.md, "Compile storms").
     "storm": frozenset({"kind", "count", "window_s", "threshold"}),
+    # v14: one decision of the replicated front tier
+    # (gol_tpu/serve/fleet.py, docs/SERVING.md "The fleet"): ``action``
+    # is route / epoch / handoff / replica (a HostMonitor verdict) /
+    # drain; extras carry request_id, bucket, replica, epoch, members,
+    # src, dst, verdict, alive, latency_s, baseline_s.
+    "fleet": frozenset({"action"}),
     # One per run, last record: matches RunReport exactly.
     "summary": frozenset(
         {"duration_s", "cell_updates", "updates_per_sec", "phases"}
@@ -704,6 +724,13 @@ class EventLog:
         self.emit(
             "health", verdict=verdict, generation=generation, **extra
         )
+
+    def fleet_event(self, action: str, **extra) -> None:
+        """One front-tier decision (v14): ``action`` is route / epoch /
+        handoff / replica / drain; ``extra`` carries request_id, bucket,
+        replica, epoch, members, src, dst, verdict, alive, latency_s,
+        baseline_s (docs/SERVING.md, "The fleet")."""
+        self.emit("fleet", action=action, **extra)
 
     def span_event(
         self,

@@ -527,6 +527,60 @@ def _serve_records(data: dict, source: str, round_: Optional[int]) -> List[dict]
     return out
 
 
+def _fleet_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    """FLEET_r*.json (servebench --fleet): each replica-count row lands
+    as one throughput record (achieved req/s at the fixed offered rate,
+    higher) plus one p99 latency record (lower).  The mid-run-kill row
+    is fingerprinted separately (``:kill``) — its p99 prices a
+    journaled ownership handoff, and ``ledger check`` gates it like any
+    other latency: a handoff that got slower fails CI."""
+    backend = (data.get("header") or {}).get("backend", "cpu")
+    shape = (
+        f"g{data.get('generations')}:s{data.get('slots')}"
+        f"q{data.get('queue_depth')}:r{data.get('offered_rps'):g}"
+    )
+    out = []
+    for row in data.get("rows") or []:
+        label = f"fleet:{backend}:{shape}:n{row['replicas']}"
+        if row.get("kill"):
+            label += ":kill"
+        extra = {
+            "completed": row.get("completed"),
+            "rejected": row.get("rejected"),
+            "p50_s": row.get("p50_s"),
+            "handoffs": row.get("handoffs"),
+            "kill": bool(row.get("kill")),
+        }
+        out.append(
+            _record(
+                label,
+                row["achieved_rps"],
+                "req/s",
+                source,
+                "fleetbench",
+                backend,
+                round_=round_,
+                extra=extra,
+            )
+        )
+        if row.get("p99_s") is not None:
+            out.append(
+                _record(
+                    label + ":p99",
+                    row["p99_s"],
+                    "s",
+                    source,
+                    "fleetbench",
+                    backend,
+                    kind="latency",
+                    direction="lower",
+                    round_=round_,
+                    extra=extra,
+                )
+            )
+    return out
+
+
 _TOOL_ADAPTERS = {
     "bench": _bench_records,
     "batchbench": _batch_records,
@@ -535,6 +589,7 @@ _TOOL_ADAPTERS = {
     "scalebench": _scale_records,
     "dryrun_multichip": _multichip_records,
     "servebench": _serve_records,
+    "fleetbench": _fleet_records,
 }
 
 

@@ -182,6 +182,17 @@ class MetricsRegistry:
         self.compile_unknown_total = 0
         self.compile_seconds_total = 0.0
         self.compile_storms_total = 0
+        # Fleet front tier (schema v14): routing / handoff counters and
+        # the membership gauges; ``fleet_epoch`` is the pinned routing
+        # epoch, bumped only on membership events (docs/SERVING.md,
+        # "The fleet").
+        self.fleet_seen = False
+        self.fleet_epoch = 0
+        self.fleet_replicas_alive: Optional[int] = None
+        self.fleet_routed_total = 0
+        self.fleet_handoffs_total = 0
+        self.fleet_replica_dead_total = 0
+        self.fleet_replica_restore_total = 0
         # Telemetry self-observation: records the EventLog's degrade
         # plane dropped, fed by the on_shed tap rather than observe()
         # (a shed record never reaches the observer — that is the
@@ -295,6 +306,23 @@ class MetricsRegistry:
             elif event == "storm":
                 self.compile_seen = True
                 self.compile_storms_total += 1
+            elif event == "fleet":
+                self.fleet_seen = True
+                action = rec.get("action")
+                if action == "route":
+                    self.fleet_routed_total += 1
+                elif action == "handoff":
+                    self.fleet_handoffs_total += 1
+                elif action == "replica":
+                    verdict = rec.get("verdict")
+                    if verdict == "replica_dead":
+                        self.fleet_replica_dead_total += 1
+                    elif verdict == "replica_restore":
+                        self.fleet_replica_restore_total += 1
+                if "epoch" in rec:
+                    self.fleet_epoch = max(self.fleet_epoch, rec["epoch"])
+                if "alive" in rec:
+                    self.fleet_replicas_alive = rec["alive"]
             elif event == "reshard":
                 if self.health_seen:
                     # A reshard on a stream that already carries health
@@ -555,6 +583,38 @@ class MetricsRegistry:
                     "gol_compile_storms_total", "counter",
                     "Compile storms detected by the scheduler.",
                     self.compile_storms_total,
+                )
+            if self.fleet_seen:
+                metric(
+                    "gol_fleet_epoch", "gauge",
+                    "Current fleet routing epoch (v14).",
+                    self.fleet_epoch,
+                )
+                if self.fleet_replicas_alive is not None:
+                    metric(
+                        "gol_fleet_replicas_alive", "gauge",
+                        "Replicas the host monitor considers alive.",
+                        self.fleet_replicas_alive,
+                    )
+                metric(
+                    "gol_fleet_routed_total", "counter",
+                    "Requests routed through the front tier.",
+                    self.fleet_routed_total,
+                )
+                metric(
+                    "gol_fleet_handoffs_total", "counter",
+                    "Open intents migrated off a dead replica.",
+                    self.fleet_handoffs_total,
+                )
+                metric(
+                    "gol_fleet_replica_dead_total", "counter",
+                    "replica_dead verdicts from the host monitor.",
+                    self.fleet_replica_dead_total,
+                )
+                metric(
+                    "gol_fleet_replica_restore_total", "counter",
+                    "replica_restore verdicts (flap-damped).",
+                    self.fleet_replica_restore_total,
                 )
             if self.shed_total > 0:
                 lines.append(

@@ -760,6 +760,22 @@ def render_run(run: Run, out) -> None:
         tail = f" (alive devices now {alive[-1]})" if alive else ""
         print(f"  health: {detail}{tail}", file=out)
 
+    fleets = run.records("fleet", rank=rank0)
+    if fleets:
+        # Schema v14 (docs/SERVING.md, "The fleet"): the front tier's
+        # decisions — route/handoff/epoch/replica counts plus the final
+        # routing epoch, so a handoff next to a replica_dead verdict is
+        # the migration signature readable from the stream alone.
+        by_action: Dict[str, int] = {}
+        for r in fleets:
+            by_action[r["action"]] = by_action.get(r["action"], 0) + 1
+        detail = ", ".join(
+            f"{n} {a}" for a, n in sorted(by_action.items())
+        )
+        epochs = [r["epoch"] for r in fleets if "epoch" in r]
+        tail = f" (routing epoch now {max(epochs)})" if epochs else ""
+        print(f"  fleet: {detail}{tail}", file=out)
+
     benches = run.records("bench_row")
     if benches:
         for b in benches:

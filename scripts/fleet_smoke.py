@@ -1,0 +1,307 @@
+"""Fleet-smoke gate: the replicated front tier's process-level drill.
+
+The check.sh stage for docs/SERVING.md "The fleet".  Everything
+in-process is covered by tests/test_fleet.py and the chaos matrix's
+fleet cells; this script exercises what needs REAL process death across
+a REAL process boundary:
+
+``python -m gol_tpu.serve.fleet`` runs a front tier over three
+supervised replicas.  A client submits twelve mixed-bucket requests
+through the front, then the drill ``kill -9``s the replica that owns
+the most routed work, mid-flight.  Assertions:
+
+- all twelve requests complete **exactly once** (fold-level: across the
+  three replica journals, each id folds to ``completed`` on exactly one
+  replica) and every board is **byte-equal** to the sequential
+  single-world oracle — migration preserved results bit-for-bit;
+- the front tier journaled and emitted at least one ``handoff`` (the
+  dead replica's open intents moved to survivors under the same ids);
+- the RESTARTED replica's journal fold shows the migrated intents
+  ``handed_off`` and its event stream carries the ``fenced`` replay
+  markers — it re-ran nothing (ownership fencing);
+- ``GET /readyz`` flips to ``degraded: true`` while the replica is out
+  and back to ``degraded: false`` once the supervisor's relaunch is
+  re-admitted to the ring;
+- a graceful ``POST /shutdown`` drains the whole fleet and the front
+  process exits 0.
+
+Exits non-zero with a message on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from gol_tpu.models import patterns  # noqa: E402
+from gol_tpu.serve import journal as journal_mod  # noqa: E402
+from gol_tpu.serve.client import Backpressure, SimClient  # noqa: E402
+from gol_tpu.serve.fleet import HashRing, bucket_key  # noqa: E402
+from tests import oracle  # noqa: E402
+
+GENS = 400  # long enough that a kill lands mid-flight, even post-compile
+REPLICAS = 3
+#: (id, pattern, size, engine) — four buckets, three requests each:
+#: 64/128 x auto(bitpack)/dense.  Mixed buckets prove the ring spreads
+#: load AND that migration re-resolves each bucket independently.
+REQUESTS = [
+    (f"f{i:02d}", 4 + (i % 3), [64, 128][i % 2],
+     ["auto", "dense"][(i // 2) % 2])
+    for i in range(12)
+]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _fail(msg: str) -> int:
+    print(f"fleet-smoke: FAIL — {msg}")
+    return 1
+
+
+def _oracle_board(pattern: int, size: int, gens: int):
+    return oracle.run_torus(patterns.init_global(pattern, size, 1), gens)
+
+
+def _events(telemetry_dir: str):
+    out = []
+    d = pathlib.Path(telemetry_dir)
+    if d.is_dir():
+        for p in sorted(d.glob("*.jsonl*")):  # incl. rotated attempt-0
+            for ln in open(p):
+                try:
+                    out.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass  # a SIGKILL may tear the victim's last line
+    return out
+
+
+def _victim() -> str:
+    """The replica the ring will route the most requests to — computed
+    with the SAME bucket_key/HashRing the front uses, so the drill
+    always kills a replica that owns in-flight work."""
+    ring = HashRing([f"r{k}" for k in range(REPLICAS)])
+    load: dict = {}
+    for _rid, _pat, size, engine in REQUESTS:
+        owner = ring.lookup(bucket_key(size, engine, 64))
+        load[owner] = load.get(owner, 0) + 1
+    return max(sorted(load), key=lambda n: load[n])
+
+
+def _manifest_pid(state: str, name: str) -> int:
+    path = os.path.join(state, name, "manifest.json")
+    return json.load(open(path))["attempts"][-1]["pid"]
+
+
+def _submit_all(client: SimClient) -> None:
+    for rid, pat, size, engine in REQUESTS:
+        body = {
+            "id": rid, "pattern": pat, "size": size,
+            "generations": GENS, "engine": engine,
+        }
+        deadline = time.time() + 60
+        while True:
+            try:
+                client.submit(body, connect_retries=3)
+                break
+            except Backpressure as e:
+                if time.time() > deadline:
+                    raise
+                time.sleep(e.retry_after or 0.5)
+
+
+def main() -> int:
+    import numpy as np
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    env.pop("XLA_FLAGS", None)
+    env.pop("GOL_FAULT_PLAN", None)
+    env.pop("GOL_RESTART_ATTEMPT", None)
+
+    with tempfile.TemporaryDirectory(prefix="gol-fleet-smoke-") as tmp:
+        state = os.path.join(tmp, "fleet")
+        tm = os.path.join(tmp, "tm")
+        port = _free_port()
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "gol_tpu.serve.fleet",
+                "--state-dir", state, "--port", str(port),
+                "--replicas", str(REPLICAS),
+                "--telemetry", tm, "--run-id", "fleetsmoke",
+                "--probe-interval", "0.1", "--chunk", "4",
+                "--max-restarts", "3",
+            ],
+            env=env, cwd=str(REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            front = SimClient(f"http://127.0.0.1:{port}", timeout=30.0)
+            deadline = time.time() + 180  # 3 replicas cold-import jax
+            while True:
+                try:
+                    front.healthz()
+                    break
+                except Exception:
+                    if proc.poll() is not None:
+                        out = proc.stdout.read() if proc.stdout else ""
+                        return _fail(
+                            f"fleet exited {proc.returncode} before "
+                            f"healthy:\n{out[-2000:]}"
+                        )
+                    if time.time() > deadline:
+                        return _fail("front tier never became healthy")
+                    time.sleep(0.25)
+
+            status, ready = front._call("GET", "/readyz")
+            if status != 200 or ready.get("degraded"):
+                return _fail(f"fleet not clean at start: {ready}")
+
+            _submit_all(front)
+
+            # kill -9 the owner of the heaviest bucket, mid-flight.
+            victim = _victim()
+            os.kill(_manifest_pid(state, victim), signal.SIGKILL)
+
+            saw_degraded = False
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                status, ready = front._call("GET", "/readyz")
+                if ready.get("degraded"):
+                    saw_degraded = True
+                    break
+                time.sleep(0.05)
+            if not saw_degraded:
+                return _fail(
+                    f"/readyz never reported degraded after killing "
+                    f"{victim}"
+                )
+
+            results = {}
+            for rid, _pat, _size, _engine in REQUESTS:
+                results[rid] = front.wait_for(
+                    rid, timeout_s=300.0, connect_retries=5
+                )
+
+            from gol_tpu.serve.scheduler import decode_board
+
+            for i, (rid, pat, size, _engine) in enumerate(REQUESTS):
+                want = _oracle_board(pat, size, GENS)
+                got = decode_board(results[rid]["board"])
+                if not np.array_equal(got, want):
+                    return _fail(
+                        f"{rid} board differs from the oracle after "
+                        f"migration"
+                    )
+
+            # Exactly-once at fold level: each id folds to completed on
+            # exactly one replica, across all three journals.
+            folds = {}
+            for k in range(REPLICAS):
+                jpath = os.path.join(state, f"r{k}", "journal.jsonl")
+                entries, _torn = journal_mod.replay(jpath)
+                folds[f"r{k}"] = entries
+            for rid, _pat, _size, _engine in REQUESTS:
+                done_on = [
+                    n for n, entries in folds.items()
+                    if entries.get(rid, {}).get("status") == "completed"
+                ]
+                if len(done_on) != 1:
+                    return _fail(
+                        f"{rid} folds completed on {done_on!r} "
+                        f"(want exactly one replica)"
+                    )
+
+            # The victim's fold shows its open intents handed off, and
+            # its restart replayed them as fenced (no re-run).
+            handed = [
+                rid for rid, e in folds[victim].items()
+                if e.get("status") == "handed_off"
+            ]
+            if not handed:
+                return _fail(
+                    f"no handed_off entries in {victim}'s journal fold"
+                )
+            victim_events = _events(os.path.join(state, victim, "telemetry"))
+            fenced = [
+                r for r in victim_events
+                if r.get("event") == "serve" and r.get("action") == "fenced"
+            ]
+            if not fenced:
+                return _fail(
+                    f"restarted {victim} emitted no 'fenced' replay "
+                    f"markers"
+                )
+
+            fleet_events = _events(tm)
+            handoffs = [
+                r for r in fleet_events
+                if r.get("event") == "fleet" and r.get("action") == "handoff"
+            ]
+            if not handoffs:
+                return _fail("front tier emitted no fleet handoff events")
+            headers = [r for r in fleet_events if "schema" in r]
+            from gol_tpu import telemetry
+
+            if not headers or headers[0]["schema"] != telemetry.SCHEMA_VERSION:
+                return _fail(
+                    f"front stream header schema != "
+                    f"{telemetry.SCHEMA_VERSION}"
+                )
+
+            # Recovery: the supervisor's relaunch rejoins the ring and
+            # /readyz drops the degraded flag.
+            recovered = False
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                status, ready = front._call("GET", "/readyz")
+                if status == 200 and not ready.get("degraded"):
+                    recovered = True
+                    break
+                time.sleep(0.2)
+            if not recovered:
+                return _fail("/readyz never recovered after the restart")
+
+            status, fstat = front._call("GET", "/fleet/status")
+            if fstat.get("handoffs_total", 0) < 1:
+                return _fail(f"handoffs_total < 1 in {fstat}")
+
+            front.shutdown()
+            try:
+                rc = proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                return _fail("fleet did not drain within 120s")
+            out = proc.stdout.read() if proc.stdout else ""
+            if rc != 0:
+                return _fail(f"fleet exited {rc} after drain:\n{out[-2000:]}")
+            if "fleet: drained" not in out:
+                return _fail("fleet never printed its drain marker")
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    print(
+        f"fleet-smoke: OK — {len(REQUESTS)} requests exactly-once and "
+        f"byte-equal across a replica kill ({len(handoffs)} handoffs, "
+        f"victim {victim} fenced {len(handed)} intents)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

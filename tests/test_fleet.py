@@ -1,0 +1,560 @@
+"""The serving fleet (docs/SERVING.md "The fleet").
+
+In-process contracts for `gol_tpu/serve/fleet.py` and the fencing fold
+in `gol_tpu/serve/journal.py`; the process-level drills (real SIGKILL,
+supervisor restarts) live in scripts/fleet_smoke.py and the chaos
+matrix's fleet cells.  Here:
+
+- the consistent-hash ring pins routes between membership events and
+  spreads distinct buckets across replicas;
+- `bucket_key` (the front tier's jax-free restatement) agrees with the
+  scheduler's own `_group_for` grouping for every engine;
+- **the red/green fencing pin**: a replica restarted after its open
+  intent was migrated away folds the intent `handed_off` and does NOT
+  re-run it — delete the handoff record and the same journal DOES
+  re-admit (the single-writer assumption this PR removes);
+- the fold arbitration table: fenced completes lose, pre-handoff
+  completes win, hand-backs re-own, epoch-less records are fenced;
+- `fleet_replay` + `FleetFront` restore a crashed front tier's epoch
+  and route map, then bump;
+- `HostMonitor` verdict hysteresis (miss streaks, restore beats, slow
+  advisories);
+- the fleet-aware client: one-hop 307 follow, and 404s that survive an
+  epoch change are fatal while mid-handoff 404s are not;
+- the trace-identity pin: fleet mode off leaves single-server journal
+  bytes free of `owner_epoch` entirely.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+
+import jax
+import pytest
+
+from gol_tpu.serve import journal as journal_mod
+from gol_tpu.serve.client import SimClient
+from gol_tpu.serve.fleet import (
+    FleetFront,
+    FleetServer,
+    HashRing,
+    ReplicaHandle,
+    bucket_key,
+    fleet_replay,
+)
+from gol_tpu.resilience.health import HostMonitor
+
+jax.config.update("jax_platforms", "cpu")
+
+
+# -- routing ------------------------------------------------------------------
+
+
+def test_hash_ring_pins_and_spreads():
+    members = ["r0", "r1", "r2"]
+    ring = HashRing(members)
+    keys = [
+        (64, 64, "bitpack"), (64, 64, "dense"),
+        (128, 128, "bitpack"), (128, 128, "dense"),
+        (192, 192, "bitpack"), (256, 256, "dense"),
+    ]
+    first = [ring.lookup(k) for k in keys]
+    # Deterministic: a rebuilt ring over the same members agrees.
+    again = HashRing(members)
+    assert [again.lookup(k) for k in keys] == first
+    # Distinct buckets actually spread (64 vnodes/member).
+    assert len(set(first)) > 1
+    # Losing one member only remaps the dead member's keys.
+    survivor_ring = HashRing(["r0", "r2"])
+    for k, owner in zip(keys, first):
+        if owner != "r1":
+            assert survivor_ring.lookup(k) == owner
+
+
+def test_hash_ring_empty_raises():
+    with pytest.raises(RuntimeError, match="no alive replicas"):
+        HashRing([]).lookup((64, 64, "bitpack"))
+
+
+@pytest.mark.parametrize("size", [32, 64, 96, 128, 130])
+@pytest.mark.parametrize(
+    "engine", ["auto", "dense", "bitpack", "pallas_bitpack"]
+)
+def test_bucket_key_matches_scheduler_grouping(tmp_path, size, engine):
+    """The front tier routes by the SAME (H, W, engine) the scheduler
+    would group the request into — without importing the device stack.
+    (`bitpack` on an unpackable width is the one divergence: the
+    replica rejects it with 400, so it never forms a group.)"""
+    from gol_tpu.serve.scheduler import ServeScheduler, ValidationError
+
+    key = bucket_key(size, engine, 64)
+    sched = ServeScheduler(str(tmp_path / "s"), quantum=64, slots=2)
+    try:
+        try:
+            sched.submit(
+                {"id": "k0", "pattern": 4, "size": size,
+                 "generations": 4, "engine": engine}
+            )
+        except ValidationError:
+            assert engine == "bitpack" and size % 32 != 0
+            return
+        (sched_key,) = sched._groups.keys()
+        assert sched_key == key
+    finally:
+        sched.close()
+
+
+# -- the fencing fold (red/green) ---------------------------------------------
+
+
+def _admit_record(rid, owner_epoch=None, size=32):
+    fields = {
+        "request": {
+            "id": rid, "pattern": 4, "size": size, "generations": 4,
+            "engine": "auto", "deadline_s": None, "stream_stats": False,
+        },
+        "ordinal": 0,
+        "trace_id": f"tr-{rid}-test",
+    }
+    if owner_epoch is not None:
+        fields["owner_epoch"] = owner_epoch
+    return journal_mod.record("admit", rid, **fields)
+
+
+def _write_journal(path, records):
+    j = journal_mod.Journal(str(path))
+    try:
+        for rec in records:
+            j.append(rec)
+    finally:
+        j.close()
+    return str(path)
+
+
+def test_restarted_replica_does_not_rerun_migrated_intent(tmp_path):
+    """The red/green pin this PR exists for: the journal used to assume
+    one writer, so a restart re-admitted every open intent — including
+    one the front tier had already migrated to another replica (a
+    double run).  With the fencing fold, the handoff record makes the
+    restart DROP it; without the handoff (green leg) the same journal
+    still re-admits as before."""
+    from gol_tpu.serve.scheduler import ServeScheduler
+
+    state = tmp_path / "replica"
+    state.mkdir()
+    admit = _admit_record("mig0", owner_epoch=1)
+    handoff = journal_mod.record(
+        "handoff", "mig0", epoch=2, src="r0", dst="r1", by="fleet"
+    )
+    _write_journal(state / "journal.jsonl", [admit, handoff])
+
+    events = []
+    sched = ServeScheduler(
+        str(state), quantum=64, slots=2,
+        registry=type("R", (), {"observe": lambda self, r: events.append(r)})(),
+    )
+    try:
+        # RED: fenced — not requeued, not re-run, not poll-able.
+        assert sched.get_result("mig0") is None
+        assert sched.outstanding() == 0
+        fenced = [
+            r for r in events
+            if r.get("event") == "serve" and r.get("action") == "fenced"
+        ]
+        assert len(fenced) == 1 and fenced[0]["request_id"] == "mig0"
+        assert fenced[0]["fence_epoch"] == 2
+    finally:
+        sched.close()
+
+    # GREEN: the identical journal minus the handoff re-admits.
+    state2 = tmp_path / "replica2"
+    state2.mkdir()
+    _write_journal(state2 / "journal.jsonl", [_admit_record("mig0", 1)])
+    sched2 = ServeScheduler(str(state2), quantum=64, slots=2)
+    try:
+        assert sched2.outstanding() == 1
+        assert sched2.get_result("mig0") is not None
+    finally:
+        sched2.close()
+
+
+def test_fold_rejects_complete_from_fenced_epoch(tmp_path):
+    """A straggler complete written under the old ownership epoch after
+    the handoff landed does not count — exactly-once holds at the fold
+    level even though the bytes are physically in the file."""
+    path = _write_journal(
+        tmp_path / "j.jsonl",
+        [
+            _admit_record("a", owner_epoch=1),
+            journal_mod.record("handoff", "a", epoch=2, by="fleet"),
+            journal_mod.record("start", "a", owner_epoch=1),
+            journal_mod.record("complete", "a", owner_epoch=1),
+        ],
+    )
+    entries, torn = journal_mod.replay(path)
+    assert torn == 0
+    assert entries["a"]["status"] == "handed_off"
+    assert entries["a"]["fence_epoch"] == 2
+
+
+def test_fold_complete_before_handoff_wins(tmp_path):
+    """The result is durable; the front tier never migrates a completed
+    intent — so a complete already folded when the handoff arrives
+    stays completed."""
+    path = _write_journal(
+        tmp_path / "j.jsonl",
+        [
+            _admit_record("a", owner_epoch=1),
+            journal_mod.record("complete", "a", owner_epoch=1),
+            journal_mod.record("handoff", "a", epoch=2, by="fleet"),
+        ],
+    )
+    entries, _ = journal_mod.replay(path)
+    assert entries["a"]["status"] == "completed"
+
+
+def test_fold_handback_reowns_at_newer_epoch(tmp_path):
+    """An admit at an epoch >= the fence re-owns the id (the ring
+    routed it back here after a later membership event); records from
+    epochs older than the hand-back stay fenced."""
+    path = _write_journal(
+        tmp_path / "j.jsonl",
+        [
+            _admit_record("a", owner_epoch=1),
+            journal_mod.record("handoff", "a", epoch=2, by="fleet"),
+            journal_mod.record("complete", "a", owner_epoch=1),  # fenced
+            _admit_record("a", owner_epoch=3),  # hand-back
+            journal_mod.record("complete", "a", owner_epoch=3),
+        ],
+    )
+    entries, _ = journal_mod.replay(path)
+    assert entries["a"]["status"] == "completed"
+    assert entries["a"]["admit"]["owner_epoch"] == 3
+
+
+def test_fold_fences_epochless_records(tmp_path):
+    """Legacy records with no owner_epoch fold as epoch 0: after a
+    handoff they are fenced too — 'I never heard of epochs' is not a
+    way to win an ownership race."""
+    path = _write_journal(
+        tmp_path / "j.jsonl",
+        [
+            _admit_record("a"),  # no owner_epoch (single-server style)
+            journal_mod.record("handoff", "a", epoch=2, by="fleet"),
+            journal_mod.record("complete", "a"),
+        ],
+    )
+    entries, _ = journal_mod.replay(path)
+    assert entries["a"]["status"] == "handed_off"
+
+
+def test_scheduler_fence_drops_open_skips_terminal_and_unknown(tmp_path):
+    from gol_tpu.serve.scheduler import ServeScheduler
+
+    sched = ServeScheduler(str(tmp_path / "s"), quantum=64, slots=2)
+    try:
+        sched.submit(
+            {"id": "f0", "pattern": 4, "size": 32, "generations": 4,
+             "owner_epoch": 1}
+        )
+        sched.submit(
+            {"id": "f1", "pattern": 4, "size": 32, "generations": 4,
+             "owner_epoch": 1}
+        )
+        assert sched.fence(["f0", "nope"], epoch=2) == 1
+        assert sched.outstanding() == 1
+        # The fenced id is forgotten — its new owner answers for it now.
+        assert sched.get_result("f0") is None
+        # The fence journaled a handoff: a restart fold agrees.
+        entries, _ = journal_mod.replay(sched._journal.path)
+        assert entries["f0"]["status"] == "handed_off"
+        assert entries["f0"]["terminal"]["by"] == "fence"
+        assert entries["f1"]["status"] == "admitted"
+        # Re-fencing an already-fenced id is a no-op.
+        assert sched.fence(["f0"], epoch=3) == 0
+    finally:
+        sched.close()
+
+
+def test_single_server_journal_carries_no_owner_epoch(tmp_path):
+    """The trace-identity pin's journal half: without a fleet in front,
+    no record mentions owner_epoch at all — folds (and bytes) are
+    identical to pre-fleet journals."""
+    from gol_tpu.serve.scheduler import ServeScheduler
+
+    sched = ServeScheduler(str(tmp_path / "s"), quantum=64, slots=2)
+    try:
+        sched.submit(
+            {"id": "p0", "pattern": 4, "size": 32, "generations": 4}
+        )
+        with open(sched._journal.path) as f:
+            assert "owner_epoch" not in f.read()
+    finally:
+        sched.close()
+
+
+# -- the front tier's own journal ---------------------------------------------
+
+
+def _handles(tmp_path, names):
+    out = []
+    for n in names:
+        d = tmp_path / n
+        d.mkdir(exist_ok=True)
+        out.append(
+            ReplicaHandle(
+                name=n, base_url=f"http://127.0.0.1:1/{n}",
+                state_dir=str(d),
+            )
+        )
+    return out
+
+
+def test_fleet_replay_restores_epoch_routes_and_handoffs(tmp_path):
+    path = _write_journal(
+        tmp_path / "fleet.journal.jsonl",
+        [
+            journal_mod.record(
+                "epoch", "epoch-1", epoch=1, members=["r0", "r1"],
+                reason="boot",
+            ),
+            journal_mod.record(
+                "route", "x", bucket="64x64:bitpack", replica="r0",
+                epoch=1,
+            ),
+            journal_mod.record(
+                "route", "y", bucket="64x64:dense", replica="r1",
+                epoch=1,
+            ),
+            journal_mod.record(
+                "epoch", "epoch-2", epoch=2, members=["r1"],
+                reason="replica_dead:r0",
+            ),
+            journal_mod.record(
+                "handoff", "x", epoch=2, src="r0", dst="r1", by="fleet",
+            ),
+        ],
+    )
+    epoch, members, routes = fleet_replay(path)
+    assert epoch == 2 and members == ["r1"]
+    assert routes["x"]["replica"] == "r1"  # the handoff re-routed it
+    assert routes["x"]["epoch"] == 2
+    assert routes["y"] == {
+        "replica": "r1", "bucket": "64x64:dense", "epoch": 1,
+    }
+
+
+def test_front_restart_restores_routes_and_bumps_epoch(tmp_path):
+    """A front-tier crash+restart reconstructs its route map from its
+    own journal fold and ALWAYS bumps the epoch — requests proxied
+    before the crash are distinguishable from those proxied after."""
+    replicas = _handles(tmp_path, ["r0", "r1"])
+    front = FleetFront(replicas, str(tmp_path))
+    try:
+        assert front.epoch == 1  # boot bump on a fresh journal
+        status, payload = front.submit(
+            {"pattern": 4, "size": 64, "generations": 4}, direct=True
+        )
+        assert status == 307
+        rid = payload["id"]
+        owner = payload["replica"]
+    finally:
+        front.close()
+
+    reborn = FleetFront(_handles(tmp_path, ["r0", "r1"]), str(tmp_path))
+    try:
+        assert reborn.epoch == 2  # restored 1, bumped on boot
+        # Routes journal replica NAMES; direct payloads carry the URL.
+        assert owner.endswith(reborn._routes[rid]["replica"])
+        status, payload = reborn.submit(
+            {"pattern": 4, "size": 64, "generations": 4}, direct=True
+        )
+        assert payload["owner_epoch"] == 2
+    finally:
+        reborn.close()
+
+
+def test_direct_mode_routes_same_bucket_to_same_replica(tmp_path):
+    front = FleetFront(_handles(tmp_path, ["r0", "r1", "r2"]), str(tmp_path))
+    try:
+        owners = set()
+        for _ in range(3):
+            status, payload = front.submit(
+                {"pattern": 4, "size": 64, "generations": 4},
+                direct=True,
+            )
+            assert status == 307
+            owners.add(payload["replica"])
+        assert len(owners) == 1  # one bucket, one pinned owner
+        status, payload = front.result("not-a-request")
+        assert status == 404 and payload["routing_epoch"] == front.epoch
+    finally:
+        front.close()
+
+
+# -- host monitor -------------------------------------------------------------
+
+
+def test_host_monitor_dead_after_miss_streak_and_flap_damping():
+    mon = HostMonitor(["r0", "r1"], miss_threshold=3, restore_beats=2)
+    assert mon.alive == ["r0", "r1"]
+    assert mon.beat("r0", ok=False) == []
+    assert mon.beat("r0", ok=False) == []
+    (dead,) = mon.beat("r0", ok=False)
+    assert dead.kind == "replica_dead" and dead.alive == 1
+    assert mon.alive == ["r1"]
+    # One OK beat is not a restore (flap damping)...
+    assert mon.beat("r0", ok=True, latency_s=0.01) == []
+    assert not mon.is_alive("r0")
+    # ...and a miss resets the streak.
+    assert mon.beat("r0", ok=False) == []
+    assert mon.beat("r0", ok=True, latency_s=0.01) == []
+    (restore,) = mon.beat("r0", ok=True, latency_s=0.01)
+    assert restore.kind == "replica_restore" and restore.alive == 2
+    assert mon.alive == ["r0", "r1"]
+
+
+def test_host_monitor_slow_advisory_does_not_change_membership():
+    mon = HostMonitor(
+        ["r0"], latency_factor=8.0, min_samples=3, min_latency_s=0.001
+    )
+    for _ in range(4):
+        assert mon.beat("r0", ok=True, latency_s=0.01) == []
+    (slow,) = mon.beat("r0", ok=True, latency_s=0.2)
+    assert slow.kind == "replica_slow"
+    assert slow.latency_s == pytest.approx(0.2)
+    assert slow.baseline_s == pytest.approx(0.01)
+    assert mon.alive == ["r0"]  # advisory only
+    # The slow probe is excluded from its own baseline window.
+    assert mon.baseline("r0") == pytest.approx(0.01)
+
+
+def test_host_monitor_validates():
+    with pytest.raises(ValueError):
+        HostMonitor([])
+    with pytest.raises(ValueError):
+        HostMonitor(["r0"], miss_threshold=0)
+
+
+# -- the fleet-aware client ---------------------------------------------------
+
+
+class _StubReplica(http.server.BaseHTTPRequestHandler):
+    seen: list
+
+    def log_message(self, *args):
+        pass
+
+    def _json(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        body = json.loads(self.rfile.read(length))
+        self.seen.append(body)
+        self._json(202, {"id": body["id"], "status": "queued"})
+
+
+def _stub_server(handler_cls):
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_client_follows_one_307_hop(tmp_path):
+    """Direct mode end to end: the front answers a routing hint, the
+    client re-POSTs to the replica itself, stamped with the id the
+    front minted and the routing epoch it pinned."""
+    seen = []
+    stub = _stub_server(type("H", (_StubReplica,), {"seen": seen}))
+    try:
+        handle = ReplicaHandle(
+            name="r0",
+            base_url=f"http://127.0.0.1:{stub.server_address[1]}",
+            state_dir=str(tmp_path / "r0"),
+        )
+        (tmp_path / "r0").mkdir()
+        front = FleetFront([handle], str(tmp_path))
+        server = FleetServer(front, 0, direct=True)
+        try:
+            client = SimClient(f"http://127.0.0.1:{server.port}")
+            out = client.submit(
+                {"pattern": 4, "size": 64, "generations": 4}
+            )
+            assert out["status"] == "queued"
+            assert len(seen) == 1
+            assert seen[0]["id"] == out["id"]
+            assert seen[0]["owner_epoch"] == front.epoch
+        finally:
+            server.close()
+            front.close()
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+class _Stub404(http.server.BaseHTTPRequestHandler):
+    epochs: list  # routing_epoch per successive GET; None = no field
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        epoch = self.epochs.pop(0) if self.epochs else self.epochs_last
+        body = {"error": "unknown request"}
+        if epoch is not None:
+            body["routing_epoch"] = epoch
+        payload = json.dumps(body).encode()
+        self.send_response(404)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def _client_against_404s(epochs, last):
+    stub = _stub_server(
+        type("H", (_Stub404,), {"epochs": list(epochs), "epochs_last": last})
+    )
+    return stub, SimClient(f"http://127.0.0.1:{stub.server_address[1]}")
+
+
+def test_wait_for_plain_404_stays_immediately_fatal():
+    stub, client = _client_against_404s([], None)
+    try:
+        with pytest.raises(KeyError, match="does not know"):
+            client.wait_for("ghost", timeout_s=5.0, poll_s=0.01)
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_wait_for_retries_404_through_one_epoch_then_fails():
+    """A fleet 404 is a mid-handoff window, not a verdict: the poll
+    holds while the epoch stands, and only a 404 observed under a LATER
+    epoch — membership resolved, the fleet still has no route — is
+    fatal."""
+    stub, client = _client_against_404s([3, 3, 3], 4)
+    try:
+        with pytest.raises(KeyError, match="epoch 3 -> 4"):
+            client.wait_for("mig", timeout_s=10.0, poll_s=0.01)
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_wait_for_same_epoch_404_times_out_not_keyerror():
+    stub, client = _client_against_404s([], 7)
+    try:
+        with pytest.raises(TimeoutError):
+            client.wait_for("mig", timeout_s=0.3, poll_s=0.01)
+    finally:
+        stub.shutdown()
+        stub.server_close()

@@ -1,0 +1,201 @@
+"""Schema v14 (serving-fleet events) + v1–v13 compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..13}.py.
+Here:
+
+- the v14 additions round-trip: a ``fleet`` record per front-tier
+  decision — ``route`` / ``epoch`` / ``handoff`` / ``replica`` /
+  ``drain`` (docs/OBSERVABILITY.md, docs/SERVING.md "The fleet");
+- the committed v14 fixture is a REAL fleet session: two supervised
+  replicas, three routed requests, a ``kill -9`` of the owner, the
+  journaled handoff of all three intents to the survivor, the restore
+  verdict, and the graceful drain;
+- **back-compat**: all THIRTEEN committed fixtures — PR 2 (v1) through
+  PR 19 (v14) — still load, merge, and render in one ``summarize``
+  pass (exit 0) with the fleet line;
+- a stream from a FUTURE schema fails loudly ("newer than this reader
+  supports", exit 2) instead of KeyError'ing deep in a consumer;
+- the ``gol_fleet_*`` metrics are fed from the same records the JSONL
+  carries, and stay absent until a fleet event is observed.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+import pytest
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+from gol_tpu.telemetry.metrics import MetricsRegistry
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+    9: DATA / "telemetry_v9" / "pr12run.rank0.jsonl",
+    11: DATA / "telemetry_v11" / "pr14run.rank0.jsonl",
+    12: DATA / "telemetry_v12" / "pr17run.rank0.jsonl",
+    13: DATA / "telemetry_v13" / "pr18run.rank0.jsonl",
+    14: DATA / "telemetry_v14" / "pr19run.rank0.jsonl",
+}
+
+
+def _v14_stream(directory, run_id="v14"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header({"driver": "fleet", "replicas": 2})
+        ev.fleet_event(
+            "epoch", epoch=1, members=["r0", "r1"], reason="boot"
+        )
+        ev.fleet_event(
+            "route", request_id="x0", bucket="64x64:bitpack",
+            replica="r0", epoch=1,
+        )
+        ev.fleet_event(
+            "replica", verdict="replica_dead", replica="r0", alive=1,
+            tick=7,
+        )
+        ev.fleet_event(
+            "handoff", request_id="x0", src="r0", dst="r1", epoch=2,
+        )
+        ev.fleet_event("drain", epoch=2)
+        return ev.path
+
+
+def test_v14_roundtrip(tmp_path):
+    path = _v14_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 14
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 15))
+    fleets = [r for r in recs if r["event"] == "fleet"]
+    assert [f["action"] for f in fleets] == [
+        "epoch", "route", "replica", "handoff", "drain",
+    ]
+    assert fleets[1]["bucket"] == "64x64:bitpack"
+    assert fleets[2]["verdict"] == "replica_dead"
+    assert fleets[3]["src"] == "r0" and fleets[3]["dst"] == "r1"
+
+
+def test_fleet_event_validates_required_fields(tmp_path):
+    with telemetry.EventLog(
+        str(tmp_path), run_id="bad", process_index=0
+    ) as ev:
+        ev.run_header({})
+        with pytest.raises(telemetry.SchemaError, match="fleet"):
+            ev.emit("fleet", epoch=1)  # no action
+
+
+def test_committed_fixture_schemas():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v14_fixture_is_a_real_fleet_session():
+    """The committed stream came from a real 2-replica fleet: three
+    requests routed to one replica, the replica SIGKILLed, every open
+    intent handed to the survivor under a bumped epoch, the restore
+    verdict once the supervisor relaunched it, then a drain."""
+    recs = [json.loads(ln) for ln in FIXTURES[14].open()]
+    assert recs[0]["config"]["driver"] == "fleet"
+    fleets = [r for r in recs if r["event"] == "fleet"]
+    by = {}
+    for f in fleets:
+        by.setdefault(f["action"], []).append(f)
+    # Boot, dead, restore: three epoch bumps, strictly increasing.
+    epochs = [e["epoch"] for e in by["epoch"]]
+    assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs)
+    assert by["epoch"][0]["reason"] == "boot"
+    reasons = [e["reason"] for e in by["epoch"]]
+    assert any(r.startswith("replica_dead:") for r in reasons)
+    assert any(r.startswith("replica_restore:") for r in reasons)
+    # Every route names its bucket, replica, and the epoch it was
+    # pinned under; every routed id was handed off (the kill caught
+    # all three open).
+    routed = {r["request_id"] for r in by["route"]}
+    assert all(r["bucket"] and r["replica"] for r in by["route"])
+    handed = {h["request_id"] for h in by["handoff"]}
+    assert routed == handed and len(routed) == 3
+    victim = by["route"][0]["replica"]
+    assert all(h["src"] == victim for h in by["handoff"])
+    assert all(h["dst"] != victim for h in by["handoff"])
+    # The handoff epoch is the dead-bump epoch — later than the route's.
+    assert all(
+        h["epoch"] > by["route"][0]["epoch"] for h in by["handoff"]
+    )
+    verdicts = [v["verdict"] for v in by["replica"]]
+    assert verdicts == ["replica_dead", "replica_restore"]
+    assert by["replica"][0]["alive"] < by["replica"][1]["alive"]
+    assert by["drain"][-1] is fleets[-1]
+
+
+def test_v14_fixture_summarize_renders_fleet_line(capsys):
+    assert summ_mod.main(
+        ["summarize", str(FIXTURES[14].parent)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "fleet:" in out
+    assert "3 handoff" in out and "3 route" in out
+    assert "routing epoch now 3" in out
+
+
+def test_v1_to_v14_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v14_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "pr12run", "pr14run", "pr17run",
+        "pr18run", "pr19run", "v14",
+    ):
+        assert run_id in out
+    assert "fleet:" in out
+
+
+def test_future_schema_fails_loudly_not_keyerror(tmp_path, capsys):
+    future = telemetry.SCHEMA_VERSION + 1
+    (tmp_path / "fut.rank0.jsonl").write_text(
+        json.dumps(
+            {
+                "event": "run_header", "t": 0.0, "schema": future,
+                "run_id": "fut", "process_index": 0, "process_count": 1,
+                "config": {},
+            }
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert f"schema v{future} is newer than this reader supports" in err
+    assert f"max v{telemetry.SCHEMA_VERSION}" in err
+
+
+def test_fleet_metrics_from_fixture():
+    """The gol_fleet_* family is fed from the SAME records the JSONL
+    carries — and stays absent until a fleet event is observed."""
+    reg = MetricsRegistry()
+    assert "gol_fleet" not in reg.render()
+    for ln in FIXTURES[14].open():
+        reg.observe(json.loads(ln))
+    text = reg.render()
+    assert "gol_fleet_epoch 3" in text
+    assert "gol_fleet_replicas_alive 2" in text
+    assert "gol_fleet_routed_total 3" in text
+    assert "gol_fleet_handoffs_total 3" in text
+    assert "gol_fleet_replica_dead_total 1" in text
+    assert "gol_fleet_replica_restore_total 1" in text
