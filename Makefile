@@ -3,14 +3,14 @@
 # resilience drill + batch smoke + sparse smoke + obs smoke + reshard
 # smoke + halo smoke + chaos smoke + serve smoke + elastic smoke +
 # lockcheck + trace smoke + tier-1 tests + postmortem smoke + fleet
-# smoke (see
+# smoke + ooc smoke (see
 # scripts/check.sh).
 
 .PHONY: lint verify lockcheck test check telemetry-smoke stats-smoke \
 	resilience-drill batch-smoke batchbench sparse-smoke sparsebench \
 	obs-smoke ledger-check reshard-smoke halo-smoke halobench-sweep \
 	chaos-smoke chaos-matrix serve-smoke servebench elastic-smoke \
-	trace-smoke postmortem-smoke fleet-smoke
+	trace-smoke postmortem-smoke fleet-smoke ooc-smoke oocbench
 
 lint:
 	bash scripts/lint.sh
@@ -162,6 +162,19 @@ fleet-smoke:
 servebench:
 	JAX_PLATFORMS=cpu python benchmarks/servebench.py \
 	    --rates 4,16,64,400,2000 --requests 48 --generations 24 --round 1
+
+# Out-of-core streaming smoke (docs/STREAMING.md): a Gosper gun on a
+# board >=4x the rotation's device footprint, streamed through
+# --engine ooc — bit-equal to the in-core bitpack tier, dead bands
+# skipped, v15 ooc blocks with measured overlap_fraction on every chunk.
+ooc-smoke:
+	JAX_PLATFORMS=cpu python scripts/ooc_smoke.py
+
+# Streaming-efficiency curve over board/budget ratios -> OOC_r{N}.json
+# (CPU: curve shape; the TPU headline is --height 1048576
+# --width 1048576 --budget-mb 4096 --iters 64).
+oocbench:
+	python benchmarks/oocbench.py --round 1
 
 check:
 	bash scripts/check.sh

@@ -136,6 +136,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print("redist-worlds-stack")
             for hcfg in default_halo_matrix():
                 print(hcfg.name)
+            from gol_tpu.analysis.ooccheck import default_ooc_matrix
+
+            for ocfg in default_ooc_matrix():
+                print(ocfg.name)
             for gcfg in default_guard_matrix():
                 print(gcfg.name)
             from gol_tpu.analysis.lockcheck import default_lock_matrix
@@ -167,6 +171,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report.engines.extend(run_reshard_checks())
         report.engines.extend(run_redist_checks())
         report.engines.extend(run_halo_checks())
+        from gol_tpu.analysis.ooccheck import run_ooc_checks
+
+        report.engines.extend(run_ooc_checks())
         report.engines.extend(run_guard_checks())
         from gol_tpu.analysis.lockcheck import run_lock_checks
         from gol_tpu.analysis.spmdcheck import run_spmd_checks

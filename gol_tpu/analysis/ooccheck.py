@@ -1,0 +1,351 @@
+"""Static + executed checks over the out-of-core streaming tier.
+
+The ooc matrix — one report per (geometry, depth, banding) cell — proves
+the invariants the streaming sweep lives or dies by (docs/STREAMING.md),
+the way the engine/halo/activity matrices do (docs/ANALYSIS.md):
+
+- **band-schedule soundness** — the plan's bands partition the board's
+  row range exactly once, in order, with no band shorter than the visit
+  depth (the one-band light-cone premise every ghost read relies on),
+  and the rotation footprint respects the device budget when one is
+  configured.
+- **ghost depth ≥ k and band locality** — the traced visit program
+  consumes exactly ``band + 2k`` rows and produces exactly ``band``
+  rows (a program that wanted deeper ghosts than the sweep assembles
+  could not typecheck against the real extended band), and contains no
+  collective: the meshless reuse of the depth-k halo machinery must not
+  drag a ring ``ppermute`` into a single-device program.
+- **executed equivalence** — the full scheduler (alternating sweeps,
+  deferred drains, wrap buffer, dead-band skip on AND off) is bit-equal
+  to the in-core dense oracle over a multi-chunk schedule with a
+  remainder sweep.
+- **shallow-ghost teeth** — the reason the bit-equality pins can be
+  trusted: a deliberately-broken scheduler whose assembled ghost is one
+  row too shallow (outermost ghost layer zeroed — depth k-1 data
+  dressed as depth k) must visibly diverge from the oracle on the same
+  soup, while the real sweep matches it.  If the broken fixture ever
+  agrees, the staleness invariant has lost its witness.
+
+Run as part of ``python -m gol_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from gol_tpu.analysis import walker
+from gol_tpu.analysis.report import (
+    ERROR,
+    INFO,
+    CheckResult,
+    EngineReport,
+    Finding,
+)
+
+#: Collectives that must never appear in a meshless visit program.
+_COLLECTIVES = ["ppermute", "psum", "all_gather", "all_to_all"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OocConfig:
+    """One cell of the ooc verification matrix."""
+
+    name: str
+    height: int
+    width: int
+    depth: int
+    band_rows: int = 0
+    budget_bytes: int = 0
+    schedule: Tuple[int, ...] = (7, 5)
+    teeth: bool = False  # carry the shallow-ghost teeth run
+
+
+def default_ooc_matrix() -> List[OocConfig]:
+    return [
+        # Remainder-absorbing last band (50 % 7) at depth 1.
+        OocConfig("ooc/k1/remainder", 50, 64, 1, band_rows=7),
+        # Deep visits; the teeth carrier (one run witnesses the matrix —
+        # the broken fixture is geometry-independent).
+        OocConfig("ooc/k3/deep", 128, 64, 3, band_rows=13, teeth=True),
+        # Degenerate single-band plan: both ghosts are the wrap seam.
+        OocConfig("ooc/k4/single-band", 21, 32, 4, band_rows=21),
+        # Budget-derived banding: the planner inverts the footprint.
+        OocConfig("ooc/k2/budget", 256, 128, 2, budget_bytes=6528),
+    ]
+
+
+def check_band_schedule(cfg: OocConfig, plan) -> CheckResult:
+    """Bands partition [0, H) exactly once; none shorter than depth."""
+    findings: List[Finding] = []
+    covered = 0
+    sound = True
+    for r0, r1 in plan.bands:
+        if r0 != covered or r1 <= r0:
+            sound = False
+            findings.append(
+                Finding(
+                    ERROR,
+                    "band-schedule",
+                    f"band [{r0}, {r1}) breaks the partition at row "
+                    f"{covered}: a row stepped twice or never is a "
+                    "silently wrong board",
+                )
+            )
+            break
+        covered = r1
+    if sound and covered != plan.height:
+        sound = False
+        findings.append(
+            Finding(
+                ERROR,
+                "band-schedule",
+                f"bands cover rows [0, {covered}) of {plan.height}: the "
+                "tail would never be stepped",
+            )
+        )
+    short = [b for b in plan.band_heights() if b < plan.depth]
+    if short:
+        sound = False
+        findings.append(
+            Finding(
+                ERROR,
+                "band-schedule",
+                f"band height(s) {short} < depth {plan.depth}: a ghost "
+                "shell would span past the immediate neighbor band, "
+                "voiding the one-band light-cone the skip logic and the "
+                "deferred drain both rely on",
+            )
+        )
+    if cfg.budget_bytes and plan.device_bytes() > cfg.budget_bytes:
+        sound = False
+        findings.append(
+            Finding(
+                ERROR,
+                "band-schedule",
+                f"rotation footprint {plan.device_bytes()}B exceeds the "
+                f"configured budget {cfg.budget_bytes}B",
+            )
+        )
+    if sound:
+        findings.append(
+            Finding(
+                INFO,
+                "band-schedule",
+                f"{plan.num_bands} band(s) partition {plan.height} rows "
+                f"exactly once (min height {min(plan.band_heights())} >= "
+                f"depth {plan.depth}; footprint {plan.device_bytes()}B)",
+            )
+        )
+    return CheckResult.from_findings("band-schedule", findings)
+
+
+def check_ghost_depth(cfg: OocConfig, plan, sched) -> CheckResult:
+    """Every visit program consumes band + 2k rows, emits band rows, and
+    contains no collective (band locality of the meshless reuse)."""
+    import jax
+    import jax.numpy as jnp
+
+    findings: List[Finding] = []
+    depths = {plan.depth} | {
+        t % plan.depth for t in cfg.schedule if t % plan.depth
+    }
+    for bh in sorted(set(plan.band_heights())):
+        for kk in sorted(depths):
+            spec = jax.ShapeDtypeStruct(
+                (bh + 2 * kk, plan.words), jnp.uint32
+            )
+            jaxpr = walker.trace_jaxpr(sched.visit_callable(bh, kk), spec)
+            (out_aval,) = [v.aval for v in jaxpr.jaxpr.outvars]
+            if out_aval.shape != (bh, plan.words):
+                findings.append(
+                    Finding(
+                        ERROR,
+                        "ghost-depth",
+                        f"visit (bh={bh}, k={kk}) emits {out_aval.shape}, "
+                        f"expected ({bh}, {plan.words}) — the write-back "
+                        "would corrupt neighboring bands",
+                    )
+                )
+            colls = list(walker.find_eqns(jaxpr, _COLLECTIVES))
+            if colls:
+                findings.append(
+                    Finding(
+                        ERROR,
+                        "ghost-depth",
+                        f"visit (bh={bh}, k={kk}) contains collectives "
+                        f"{sorted({i.eqn.primitive.name for i in colls})}: "
+                        "the meshless halo reuse dragged ring code into a "
+                        "single-device program",
+                    )
+                )
+    if not findings:
+        findings.append(
+            Finding(
+                INFO,
+                "ghost-depth",
+                f"every (band, k) visit consumes band + 2k rows and "
+                f"emits the band, collective-free (k in {sorted(depths)})",
+            )
+        )
+    return CheckResult.from_findings("ghost-depth", findings)
+
+
+def _soup(h: int, w: int) -> np.ndarray:
+    rng = np.random.default_rng(1511)
+    return (rng.random((h, w)) < 0.33).astype(np.uint8)
+
+
+def _oracle(board: np.ndarray, steps: int) -> np.ndarray:
+    import jax.numpy as jnp
+
+    from gol_tpu.ops import bitlife
+
+    return np.asarray(bitlife.evolve_dense_io(jnp.asarray(board), steps))
+
+
+def check_executed_equivalence(cfg: OocConfig, plan) -> CheckResult:
+    """Streamed == in-core oracle, with dead-band skip on and off."""
+    from gol_tpu.ooc import OocScheduler
+
+    findings: List[Finding] = []
+    steps = sum(cfg.schedule)
+    board = _soup(cfg.height, cfg.width)
+    ref = _oracle(board, steps)
+    for skip in (True, False):
+        sched = OocScheduler(plan, skip_dead=skip)
+        sched.load_dense(board)
+        gen = 0
+        for take in cfg.schedule:
+            sched.run_chunk(take, gen)
+            gen += take
+        if np.array_equal(sched.dense(), ref):
+            findings.append(
+                Finding(
+                    INFO,
+                    "ooc-equivalence",
+                    f"skip_dead={skip}: bit-equal to the in-core oracle "
+                    f"over {steps} generations ({len(cfg.schedule)} "
+                    "chunks incl. a remainder sweep)",
+                )
+            )
+        else:
+            findings.append(
+                Finding(
+                    ERROR,
+                    "ooc-equivalence",
+                    f"skip_dead={skip}: diverges from the in-core oracle "
+                    f"after {steps} generations",
+                )
+            )
+    return CheckResult.from_findings("ooc-equivalence", findings)
+
+
+def check_shallow_ghost_teeth(cfg: OocConfig, plan) -> CheckResult:
+    """Ghost one row too shallow ⇒ must diverge; the real sweep ⇒ must not.
+
+    The broken fixture zeroes the outermost ghost layer of every
+    assembled extended band — depth k-1 data dressed in a depth-k shape,
+    exactly the bug a mis-sliced neighbor read or an off-by-one band
+    boundary would produce.  Its outermost generation per visit reads
+    zeros instead of the neighbor's pre-sweep cells, so it must diverge
+    from the oracle; if it doesn't, the staleness invariant has no
+    witness on this geometry and the check fails.
+    """
+    from gol_tpu.ooc import OocScheduler
+
+    class _ShallowGhost(OocScheduler):
+        def _build_ext(self, idx, kk, down, wrap):
+            ext = super()._build_ext(idx, kk, down, wrap)
+            ext[0, :] = 0
+            ext[-1, :] = 0
+            return ext
+
+    findings: List[Finding] = []
+    steps = sum(cfg.schedule)
+    board = _soup(cfg.height, cfg.width)
+    ref = _oracle(board, steps)
+
+    def run(cls):
+        sched = cls(plan, skip_dead=False)
+        sched.load_dense(board)
+        gen = 0
+        for take in cfg.schedule:
+            sched.run_chunk(take, gen)
+            gen += take
+        return sched.dense()
+
+    real = run(OocScheduler)
+    broken = run(_ShallowGhost)
+    if not np.array_equal(real, ref):
+        findings.append(
+            Finding(
+                ERROR,
+                "shallow-ghost",
+                f"the REAL sweep at k={plan.depth} diverges from the "
+                "oracle — the teeth check has nothing to witness against",
+            )
+        )
+    elif np.array_equal(broken, ref):
+        findings.append(
+            Finding(
+                ERROR,
+                "shallow-ghost",
+                "the one-row-too-shallow broken fixture matched the "
+                f"oracle over {steps} generations — the ghost-staleness "
+                "invariant has no witness on this board; the bit-equality "
+                "pins cannot be trusted to catch a shallow ghost",
+            )
+        )
+    else:
+        findings.append(
+            Finding(
+                INFO,
+                "shallow-ghost",
+                f"ghost depth k-1 dressed as k={plan.depth} diverges "
+                "from the oracle while the real sweep matches it — the "
+                "staleness invariant has teeth",
+            )
+        )
+    return CheckResult.from_findings("shallow-ghost", findings)
+
+
+def run_ooc_config(cfg: OocConfig) -> EngineReport:
+    from gol_tpu.ooc import OocScheduler, plan_bands
+
+    report = EngineReport(config_name=cfg.name)
+    try:
+        plan = plan_bands(
+            cfg.height,
+            cfg.width,
+            cfg.depth,
+            band_rows=cfg.band_rows,
+            budget_bytes=cfg.budget_bytes,
+        )
+        sched = OocScheduler(plan)
+    except Exception as e:
+        from gol_tpu.analysis.report import FAIL
+
+        report.checks.append(
+            CheckResult("config", FAIL, [
+                Finding(
+                    ERROR, "config",
+                    f"ooc plan failed to build: {e}",
+                )
+            ])
+        )
+        return report
+    report.checks.append(check_band_schedule(cfg, plan))
+    report.checks.append(check_ghost_depth(cfg, plan, sched))
+    report.checks.append(check_executed_equivalence(cfg, plan))
+    if cfg.teeth:
+        report.checks.append(check_shallow_ghost_teeth(cfg, plan))
+    return report
+
+
+def run_ooc_checks(
+    matrix: Optional[List[OocConfig]] = None,
+) -> List[EngineReport]:
+    return [run_ooc_config(c) for c in (matrix or default_ooc_matrix())]

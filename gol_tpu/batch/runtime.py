@@ -186,6 +186,12 @@ class GolBatchRuntime:
     on_world_complete: Optional[Callable[[int, np.ndarray, int], None]] = None
 
     def __post_init__(self) -> None:
+        if self.engine == "ooc":
+            raise ValueError(
+                "engine 'ooc' streams one bigger-than-device board and "
+                "has no batched tier; supported batch engines: "
+                f"{batch_engines.BATCH_ENGINES}"
+            )
         if self.engine not in batch_engines.BATCH_ENGINES:
             raise ValueError(
                 f"unknown engine {self.engine!r}; expected "

@@ -17,8 +17,10 @@ the five positionals:
 - ``--halo {fresh,stale_t0}``: correct torus semantics (default) or the
   reference's as-implemented stale-halo semantics (bug B1) for bit-exact
   output parity.
-- ``--engine {auto,dense,bitpack,pallas,pallas_bitpack}``: stencil
-  implementation tier (pallas_bitpack: fused carry-save kernel, fastest).
+- ``--engine {auto,dense,bitpack,pallas,pallas_bitpack,activity,ooc}``:
+  stencil implementation tier (pallas_bitpack: fused carry-save kernel,
+  fastest in-core; ooc: host-resident board streamed through a fixed
+  device footprint — docs/STREAMING.md).
 - ``--outdir DIR``, ``--profile DIR``, ``--compat-banner``,
   ``--checkpoint-every K`` / ``--resume PATH`` (capability additions).
 
@@ -72,7 +74,7 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
         "--engine",
         choices=[
             "auto", "dense", "bitpack", "pallas", "pallas_bitpack",
-            "activity",
+            "activity", "ooc",
         ],
         default="auto",
     )
@@ -82,6 +84,15 @@ def parse_args(argv: Sequence[str]) -> Optional[argparse.Namespace]:
     ext.add_argument("--activity-tile", type=int, default=0, metavar="T")
     ext.add_argument(
         "--activity-capacity", type=float, default=0.25, metavar="FRAC"
+    )
+    # Out-of-core streaming tier knobs (docs/STREAMING.md): device
+    # footprint budget the band planner inverts (MiB; the board itself
+    # lives in host RAM), an explicit band height override (rows; 0 =
+    # derive from the budget), and the dead-band H2D/D2H skip switch.
+    ext.add_argument("--ooc-budget-mb", type=int, default=256, metavar="MB")
+    ext.add_argument("--ooc-band-rows", type=int, default=0, metavar="R")
+    ext.add_argument(
+        "--no-ooc-skip-dead", dest="ooc_skip_dead", action="store_false"
     )
     ext.add_argument("--mesh", choices=["none", "1d", "2d"], default="none")
     # Shard-mode matrix (gol_tpu/parallel/modes.py): hand-placed
@@ -398,6 +409,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--activity-tile/--activity-capacity configure the "
                 "activity tier; pass --engine activity"
             )
+        if (
+            ns.ooc_budget_mb != 256
+            or ns.ooc_band_rows
+            or not ns.ooc_skip_dead
+        ) and ns.engine != "ooc":
+            raise ValueError(
+                "--ooc-budget-mb/--ooc-band-rows/--no-ooc-skip-dead "
+                "configure the out-of-core streaming tier; pass "
+                "--engine ooc"
+            )
+        if ns.engine == "ooc" and ns.guard_every > 0:
+            raise ValueError(
+                "the checkpoint-restore guard re-executes chunks through "
+                "the compiled in-core evolvers; engine 'ooc' streams a "
+                "host-resident board, so drop --guard-every (its band "
+                "write-backs already run under the retry/containment "
+                "plane), or guard an in-core engine ('dense', 'bitpack', "
+                "'pallas_bitpack', 'activity')"
+            )
         if ns.auto_resume and ns.resume:
             raise ValueError(
                 "--auto-resume selects the snapshot itself; pass one of "
@@ -488,6 +518,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 raise ValueError(
                     "--batch shards the world axis (a 1-D ring); use "
                     "--mesh 1d or --mesh none"
+                )
+            if ns.engine == "ooc":
+                raise ValueError(
+                    "--batch evolves many in-core worlds in one compiled "
+                    "program; engine 'ooc' streams one bigger-than-device "
+                    "world through the chip — run it unbatched, or pick a "
+                    "batched engine ('auto', 'dense', 'bitpack', "
+                    "'pallas_bitpack')"
                 )
             if ns.engine in ("pallas", "activity"):
                 raise ValueError(
@@ -596,6 +634,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             resume_info=rt_resume_info,
             activity_tile=ns.activity_tile,
             activity_capacity=ns.activity_capacity,
+            ooc_budget_mb=ns.ooc_budget_mb,
+            ooc_band_rows=ns.ooc_band_rows,
+            ooc_skip_dead=ns.ooc_skip_dead,
             metrics_port=ns.metrics_port,
             reshard_at=reshard_at,
             sharded_snapshots=ns.sharded_snapshots,

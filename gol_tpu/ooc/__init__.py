@@ -1,0 +1,28 @@
+"""Out-of-core streaming tier: boards bigger than HBM (docs/STREAMING.md).
+
+Every other engine tier requires the (bit-packed) board resident on
+device, capping world size at HBM.  This tier keeps the packed board
+(1 bit/cell, the :mod:`gol_tpu.ops.bitlife` layout) in host RAM and
+streams horizontal row-bands through the device in a three-deep
+rotation — band N+1's H2D copy and band N-1's D2H fetch overlap band
+N's compute, the same carried-buffer discipline as the pipelined halo
+(PR 9) with host<->device transfers taking the role of the ring
+ppermutes.  Each band visit steps k generations from a 2k-row ghost
+shell of its neighbors' pre-sweep state, via the depth-k
+interior/boundary machinery of :mod:`gol_tpu.parallel.halo`
+(``split_chunk``/``_consume_chunk`` reused, so exactness falls out of
+the existing slab proof); dead bands (band and both neighbors all-zero)
+are neither fetched nor stepped.
+
+- :mod:`gol_tpu.ooc.hostboard` — host-side packed layout (numpy twin of
+  ``bitlife.pack``/``unpack``) and the staging-buffer pool.
+- :mod:`gol_tpu.ooc.planner` — :class:`BandPlan`: board rows into bands
+  under a device-memory budget.
+- :mod:`gol_tpu.ooc.scheduler` — :class:`OocScheduler`: the streaming
+  sweep loop, overlap accounting, dead-band skipping, per-band stats
+  partials, and the ``hostcopy.error``-contained write-back.
+"""
+
+from gol_tpu.ooc.hostboard import BufferPool, pack_np, unpack_np  # noqa: F401
+from gol_tpu.ooc.planner import BandPlan, plan_bands  # noqa: F401
+from gol_tpu.ooc.scheduler import OocScheduler  # noqa: F401

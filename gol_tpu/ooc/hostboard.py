@@ -1,0 +1,99 @@
+"""Host-side packed board: the numpy twin of ``ops/bitlife``.
+
+The OOC tier keeps the whole board in host RAM in exactly the
+``ops/bitlife.py`` wire layout — uint32 words, bit ``j`` of word ``k``
+on a row is column ``32*k + j`` — so a band sliced out of the host
+array IS a valid input to ``bitlife.step_packed_vext`` with no
+translation, and a checkpoint written from the host board is
+bit-identical to one written by the in-core bitpack tier.
+
+``bitlife.pack``/``unpack`` are jnp functions: calling them on a
+128 GiB board would materialize it on device, which is the one thing
+this tier exists to avoid.  ``pack_np``/``unpack_np`` below are the
+pure-numpy equivalents, pinned bit-identical to the jnp pair in
+tests/test_ooc.py.  Both go through ``np.packbits``/``unpackbits``
+with ``bitorder="little"`` and an explicit little-endian uint32 view,
+which matches the byte-staged combine in ``bitlife.pack`` (byte b of a
+word holds columns ``8*b .. 8*b+7``).
+
+:class:`BufferPool` is the staging pool: reusable page-aligned-ish host
+buffers for extended-band assembly so the steady-state sweep allocates
+nothing per band.  (jax on CPU/TPU McJIT does not expose true pinned
+allocations through the public API; the pool gives the allocation-reuse
+half of "pinned buffers", and ``jax.device_put`` does the rest.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gol_tpu.ops import bitlife
+
+WORD_BYTES = 4
+
+
+def packed_words(width: int) -> int:
+    """Words per packed row; width must be a multiple of 32 (bitlife)."""
+    return bitlife.packed_width(width)
+
+
+def pack_np(board: np.ndarray) -> np.ndarray:
+    """Dense uint8 [h, w] (0/1) -> packed uint32 [h, w//32], host-side.
+
+    Bit-identical to ``np.asarray(bitlife.pack(board))``.
+    """
+    board = np.ascontiguousarray(board, dtype=np.uint8)
+    h, w = board.shape
+    nw = packed_words(w)
+    by = np.packbits(board, axis=-1, bitorder="little")  # [h, 4*nw]
+    return np.ascontiguousarray(by).view("<u4").reshape(h, nw)
+
+
+def unpack_np(packed: np.ndarray, width: int) -> np.ndarray:
+    """Packed uint32 [h, w//32] -> dense uint8 [h, w], host-side."""
+    packed = np.ascontiguousarray(packed, dtype=np.uint32)
+    h, nw = packed.shape
+    if nw != packed_words(width):
+        raise ValueError(
+            f"packed row has {nw} words, width {width} needs"
+            f" {packed_words(width)}"
+        )
+    by = packed.astype("<u4").view(np.uint8).reshape(h, 4 * nw)
+    return np.unpackbits(by, axis=-1, bitorder="little")[:, :width]
+
+
+def popcount_np(words: np.ndarray) -> int:
+    """Total set bits in a packed array, pure numpy (byte LUT)."""
+    from gol_tpu.ops import stats
+
+    return stats.popcount_words_np(words)
+
+
+class BufferPool:
+    """Reusable host staging buffers, keyed by (shape, dtype).
+
+    The sweep assembles one extended band per visit (band + 2k ghost
+    rows); without reuse that is a fresh multi-MB allocation per band
+    per sweep.  ``take`` hands back the previously-returned buffer for
+    the same shape when free, so steady state runs allocation-free.
+    Buffers handed to ``jax.device_put`` are considered busy until
+    ``give``n back (after the transfer is known complete).
+    """
+
+    def __init__(self) -> None:
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self.allocated = 0  # lifetime allocations, for tests/telemetry
+        self.reused = 0
+
+    def take(self, shape: tuple, dtype=np.uint32) -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        stack = self._free.get(key)
+        if stack:
+            self.reused += 1
+            return stack.pop()
+        self.allocated += 1
+        return np.empty(shape, dtype=dtype)
+
+    def give(self, buf: np.ndarray) -> None:
+        key = (tuple(buf.shape), buf.dtype.str)
+        self._free.setdefault(key, []).append(buf)

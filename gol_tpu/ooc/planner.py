@@ -1,0 +1,137 @@
+"""Band planner: split board rows into streaming bands under a budget.
+
+The device never holds more than a fixed number of row-bands at once.
+A visit to a band of ``bh`` rows at depth ``k`` moves an extended
+input of ``bh + 2k`` rows up and ``bh`` rows back; with the three-deep
+rotation (next band's input staged while the current computes and the
+previous drains) the device-resident footprint is bounded by three
+in-flight (input, output) pairs:
+
+    footprint(bh) <= 3 * ((bh + 2k) + bh) * nw * 4 bytes
+                   = (6*bh + 6*k) * row_bytes
+
+Given ``budget_bytes`` the planner inverts that bound for the band
+height; an explicit ``band_rows`` overrides the derivation but is
+still validated against the budget.  The last band absorbs the
+remainder (height in ``[B, 2B)``), so every row belongs to exactly one
+band and no band is shorter than ``B`` — which keeps the dead-band
+skip rule sound (ghost depth ``k <= B`` never spans past an immediate
+neighbor band).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from gol_tpu.ooc import hostboard
+
+# In-flight (ext input + output) pairs the rotation keeps live at once.
+ROTATION_DEPTH = 3
+
+
+def _footprint_bytes(band_rows: int, depth: int, row_bytes: int) -> int:
+    return ROTATION_DEPTH * (2 * band_rows + 2 * depth) * row_bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class BandPlan:
+    """Immutable row-band decomposition of an ``height x width`` board."""
+
+    height: int
+    width: int
+    depth: int          # generations per band visit (k)
+    band_rows: int      # nominal band height B; last band is in [B, 2B)
+    budget_bytes: int   # 0 = unbounded (no footprint check)
+    bands: tuple[tuple[int, int], ...]  # (row_start, row_end) per band
+
+    @property
+    def num_bands(self) -> int:
+        return len(self.bands)
+
+    @property
+    def words(self) -> int:
+        return hostboard.packed_words(self.width)
+
+    @property
+    def row_bytes(self) -> int:
+        return self.words * hostboard.WORD_BYTES
+
+    @property
+    def board_bytes(self) -> int:
+        """Host-resident packed board size."""
+        return self.height * self.row_bytes
+
+    def device_bytes(self) -> int:
+        """Worst-case device footprint under the rotation bound."""
+        tallest = max(r1 - r0 for r0, r1 in self.bands)
+        return _footprint_bytes(tallest, self.depth, self.row_bytes)
+
+    def band_heights(self) -> tuple[int, ...]:
+        return tuple(r1 - r0 for r0, r1 in self.bands)
+
+
+def plan_bands(
+    height: int,
+    width: int,
+    depth: int,
+    *,
+    band_rows: int = 0,
+    budget_bytes: int = 0,
+) -> BandPlan:
+    """Build a :class:`BandPlan`; raises ValueError on impossible asks."""
+    if depth < 1:
+        raise ValueError(f"ooc depth must be >= 1, got {depth}")
+    if height < 2 * depth + 1:
+        # parallel/halo's split/ext machinery needs strictly more rows
+        # than the two ghost shells it carries.
+        raise ValueError(
+            f"board height {height} too small for ooc depth {depth}"
+            f" (need > {2 * depth} rows)"
+        )
+    row_bytes = hostboard.packed_words(width) * hostboard.WORD_BYTES
+    if band_rows:
+        if band_rows < depth:
+            raise ValueError(
+                f"ooc band height {band_rows} < depth {depth}: a band"
+                " visit's ghost shell may not span past its immediate"
+                " neighbor band (raise --ooc-band-rows or lower"
+                " --halo-depth)"
+            )
+    else:
+        if not budget_bytes:
+            raise ValueError(
+                "ooc needs a device budget (--ooc-budget-mb) or an"
+                " explicit band height (--ooc-band-rows)"
+            )
+        # Invert footprint(bh) <= budget for bh; remainder absorption
+        # can make the last band up to 2B-1 rows, so size B such that
+        # even the absorbed band fits: footprint(2B) <= budget.
+        rows = budget_bytes // (ROTATION_DEPTH * row_bytes)
+        band_rows = max(depth, (rows - 2 * depth) // 4)
+    band_rows = min(band_rows, height)
+    num = max(1, height // band_rows)
+    bands = tuple(
+        (i * band_rows, (i + 1) * band_rows if i < num - 1 else height)
+        for i in range(num)
+    )
+    plan = BandPlan(
+        height=height,
+        width=width,
+        depth=depth,
+        band_rows=band_rows,
+        budget_bytes=budget_bytes,
+        bands=bands,
+    )
+    if num > 1 and min(plan.band_heights()) < depth:
+        raise ValueError(
+            f"ooc band height {min(plan.band_heights())} < depth"
+            f" {depth}; the planner should never produce this"
+        )
+    if budget_bytes and plan.device_bytes() > budget_bytes:
+        raise ValueError(
+            f"ooc footprint {plan.device_bytes()} B exceeds device"
+            f" budget {budget_bytes} B even at band height"
+            f" {plan.band_rows}; raise --ooc-budget-mb or lower"
+            " --halo-depth"
+        )
+    return plan

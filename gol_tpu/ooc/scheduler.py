@@ -1,0 +1,314 @@
+"""The streaming sweep loop: bands through a fixed device footprint.
+
+One sweep advances the whole board ``kk`` generations by visiting each
+band once.  A visit assembles the extended band (band + ``kk`` ghost
+rows per side) into a pooled host buffer, ships it with
+``jax.device_put``, and steps it with the depth-``kk``
+interior/boundary machinery of :func:`gol_tpu.parallel.halo.split_chunk`
+(via ``_consume_chunk`` — the exact program the mesh tiers run per
+shard, with a size-1 phase ring, so exactness falls out of the existing
+slab proof).  The compiled visit donates its input, so the device never
+holds more than the rotation's in-flight buffers.
+
+**Ghost staleness.** Every ghost row must carry the neighbor's
+*pre-sweep* state.  The rotation guarantees it by ordering, not by
+copying: band N's write-back is deferred until after band N+1's
+extended input has been assembled (the one-visit-delayed drain), the
+first-visited band's far seam is saved in a ``kk``-row wrap buffer
+before the sweep starts, and every other ghost read targets a band the
+sweep has not reached yet.  Because no band is shorter than the plan's
+depth, a ghost shell never spans past the immediate neighbor band.
+Sweep direction alternates per sweep so the deferred-drain reuse
+distance does not systematically favor one seam.
+
+**Three-deep rotation.** In steady state three visits are in flight:
+band N+1's H2D put and band N-1's D2H fetch + write-back bracket band
+N's dispatched compute, so with jax's async dispatch the transfers run
+while the device steps band N.  ``overlap_fraction`` is the measured
+fraction of host-side transfer wall that elapsed while a compute was
+known to be in flight — an honest lower bound on hiding, not a model.
+
+**Dead bands.** With skipping enabled, a band is skipped when it and
+both torus neighbors held no live cells at sweep start (one-band light
+cone: at depth ``kk`` <= band height, liveness cannot cross a dead
+band in one visit).  Skipped bands move zero bytes in either direction,
+so a sparse pattern's transfer cost scales with its active bands, not
+the board area.  Zero flags update from each write-back and are
+snapshotted per sweep (post-visit emptiness of a neighbor says nothing
+about its pre-sweep seam).
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from gol_tpu.ooc import hostboard
+from gol_tpu.ooc.planner import BandPlan
+from gol_tpu.ops import bitlife
+from gol_tpu.parallel import halo
+from gol_tpu.resilience import degrade as degrade_mod
+from gol_tpu.resilience import faults as faults_mod
+
+# Row axis only; the size-1 ring is never exercised — the reused halo
+# split paths (_consume_chunk/split_chunk/assemble_ext) contain no
+# collectives, only slicing and stepping.
+_PHASES = ((0, "rows", 1),)
+
+_COUNTER_KEYS = (
+    "sweeps",
+    "visits",
+    "skipped",
+    "bytes_h2d",
+    "bytes_d2h",
+    "h2d_s",
+    "d2h_s",
+    "hidden_s",
+)
+
+
+def _zero_counters() -> dict:
+    return {k: 0 if not k.endswith("_s") else 0.0 for k in _COUNTER_KEYS}
+
+
+class OocScheduler:
+    """Drives a :class:`~gol_tpu.ooc.planner.BandPlan` over a host board.
+
+    The board lives in ``self.board`` as a packed uint32 array in the
+    ``ops/bitlife`` layout, mutated in place; nothing here materializes
+    the full board on device.  ``on_compile(info)`` (if given) is called
+    once per distinct compiled visit program — the runtime binds it to
+    telemetry ``compile`` events.
+    """
+
+    def __init__(
+        self,
+        plan: BandPlan,
+        *,
+        skip_dead: bool = True,
+        on_compile: Optional[Callable[[dict], None]] = None,
+    ) -> None:
+        self.plan = plan
+        self.skip_dead = skip_dead
+        self.on_compile = on_compile
+        self.board: Optional[np.ndarray] = None
+        self.pool = hostboard.BufferPool()
+        self._zero: Optional[np.ndarray] = None
+        self._compiled: dict = {}
+        self._sweep_parity = 0
+
+    # -- board residency -----------------------------------------------------
+
+    def load_board(self, packed: np.ndarray) -> None:
+        """Adopt a packed host board (copied to own, mutable storage)."""
+        plan = self.plan
+        if packed.shape != (plan.height, plan.words):
+            raise ValueError(
+                f"packed board shape {packed.shape} does not match plan"
+                f" ({plan.height}, {plan.words})"
+            )
+        self.board = np.ascontiguousarray(packed, dtype=np.uint32).copy()
+        self._zero = np.array(
+            [not self.board[r0:r1].any() for r0, r1 in plan.bands],
+            dtype=bool,
+        )
+
+    def load_dense(self, board: np.ndarray) -> None:
+        self.load_board(hostboard.pack_np(board))
+
+    def dense(self) -> np.ndarray:
+        """Unpack the host board (host-side; for checkpoints and dumps)."""
+        return hostboard.unpack_np(self.board, self.plan.width)
+
+    def population(self) -> int:
+        return hostboard.popcount_np(self.board)
+
+    # -- compiled visit programs ---------------------------------------------
+
+    def visit_callable(self, bh: int, kk: int):
+        """The pure function a ``(bh, kk)`` visit program compiles:
+        ``ext[bh + 2*kk, words] -> stepped band [bh, words]``.  Exposed
+        so the analysis suite (ooccheck) traces the EXACT program the
+        sweep dispatches, not a reconstruction of it."""
+
+        def visit(ext):
+            block = ext[kk:kk + bh]
+            bands = ((ext[:kk], ext[-kk:]),)
+            return halo._consume_chunk(
+                bitlife.step_packed_vext, _PHASES, block, bands, kk
+            )
+
+        return visit
+
+    def _program(self, bh: int, kk: int):
+        """AOT-compiled visit for a band of ``bh`` rows at depth ``kk``.
+
+        At most a handful of shapes exist per run: the nominal band
+        height plus the remainder-absorbing last band, times full-depth
+        and remainder-sweep ``kk`` — each compiled once, donating its
+        extended input.
+        """
+        key = (bh, kk)
+        prog = self._compiled.get(key)
+        if prog is not None:
+            return prog
+        nw = self.plan.words
+        visit = self.visit_callable(bh, kk)
+        spec = jax.ShapeDtypeStruct((bh + 2 * kk, nw), bitlife.WORD)
+        t0 = time.perf_counter()
+        with warnings.catch_warnings():
+            # The CPU backend declines the donation (no aliasing there);
+            # on TPU the extended input is donated as intended.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            lowered = jax.jit(visit, donate_argnums=0).lower(spec)
+            t1 = time.perf_counter()
+            prog = lowered.compile()
+        t2 = time.perf_counter()
+        self._compiled[key] = prog
+        if self.on_compile is not None:
+            self.on_compile(
+                dict(
+                    band_rows=bh,
+                    depth=kk,
+                    lower_s=t1 - t0,
+                    compile_s=t2 - t1,
+                    executable=prog,
+                )
+            )
+        return prog
+
+    # -- the sweep -----------------------------------------------------------
+
+    def _skippable(self, idx: int, zmask: Optional[np.ndarray]) -> bool:
+        if zmask is None:
+            return False
+        nb = self.plan.num_bands
+        return bool(
+            zmask[idx] and zmask[(idx - 1) % nb] and zmask[(idx + 1) % nb]
+        )
+
+    def _build_ext(self, idx: int, kk: int, down: bool, wrap: np.ndarray):
+        """Assemble band ``idx``'s extended input from pre-sweep rows."""
+        plan = self.plan
+        board = self.board
+        r0, r1 = plan.bands[idx]
+        bh = r1 - r0
+        nb, H = plan.num_bands, plan.height
+        ext = self.pool.take((bh + 2 * kk, plan.words))
+        # Top ghost: rows [r0-kk, r0) mod H.  Overwritten-by-now only
+        # for the upward sweep's last visit (band 0) — the wrap buffer.
+        if idx == 0:
+            ext[:kk] = wrap if not down else board[H - kk:]
+        else:
+            ext[:kk] = board[r0 - kk:r0]
+        ext[kk:kk + bh] = board[r0:r1]
+        # Bottom ghost: rows [r1, r1+kk) mod H — wrap for the downward
+        # sweep's last visit, a not-yet-drained band otherwise.
+        if idx == nb - 1:
+            ext[bh + kk:] = wrap if down else board[:kk]
+        else:
+            ext[bh + kk:] = board[r1:r1 + kk]
+        return ext
+
+    def _drain(self, pending, c: dict, generation: int, hidden: bool):
+        """Fetch a visit's output and write it back to the host board."""
+        idx, out_dev, ext_buf = pending
+        t0 = time.perf_counter()
+        out_np = np.asarray(out_dev)  # blocks on the compute, then copies
+        d2h = time.perf_counter() - t0
+        c["d2h_s"] += d2h
+        c["bytes_d2h"] += out_np.nbytes
+        if hidden:
+            c["hidden_s"] += d2h
+        r0, r1 = self.plan.bands[idx]
+
+        def write():
+            faults_mod.hostcopy_fault(generation)
+            self.board[r0:r1] = out_np
+
+        # Same containment as snapshot writes — but a host-board copy
+        # that stays failed is state loss, so a shed verdict (False)
+        # must surface instead of silently dropping the band.
+        if not degrade_mod.write_with_retry(
+            write, what="hostcopy", generation=generation
+        ):
+            raise OSError(
+                f"ooc band {idx} write-back failed permanently at"
+                f" generation {generation}"
+            )
+        if self._zero is not None:
+            self._zero[idx] = not out_np.any()
+        self.pool.give(ext_buf)
+
+    def _sweep(self, kk: int, c: dict, generation: int) -> None:
+        """Advance the whole board ``kk`` generations (one band pass)."""
+        plan = self.plan
+        board = self.board
+        nb, H = plan.num_bands, plan.height
+        down = self._sweep_parity % 2 == 0
+        self._sweep_parity += 1
+        c["sweeps"] += 1
+        order = range(nb) if down else range(nb - 1, -1, -1)
+        zmask = self._zero.copy() if self.skip_dead else None
+        # The first-visited band's far seam, read by the last visit
+        # after the first's write-back has already landed.
+        wrap = (board[:kk] if down else board[H - kk:]).copy()
+        pending = None  # (band idx, device output, host ext buffer)
+        for idx in order:
+            if self._skippable(idx, zmask):
+                c["skipped"] += 1
+                continue
+            ext = self._build_ext(idx, kk, down, wrap)
+            t0 = time.perf_counter()
+            ext_dev = jax.device_put(ext)
+            put_s = time.perf_counter() - t0
+            c["h2d_s"] += put_s
+            c["bytes_h2d"] += ext.nbytes
+            if pending is not None:
+                c["hidden_s"] += put_s  # a compute was in flight
+            out_dev = self._program(ext.shape[0] - 2 * kk, kk)(ext_dev)
+            c["visits"] += 1
+            if pending is not None:
+                # Drain N-1 only now — after band N's input was built
+                # from pre-sweep rows and its compute dispatched.
+                self._drain(pending, c, generation, hidden=True)
+            pending = (idx, out_dev, ext)
+        if pending is not None:
+            self._drain(pending, c, generation, hidden=False)
+
+    # -- the chunk -----------------------------------------------------------
+
+    def run_chunk(self, take: int, generation: int) -> dict:
+        """Advance ``take`` generations from ``generation``; returns the
+        chunk's streaming report (the telemetry v15 ``ooc`` block plus
+        timing internals)."""
+        if self.board is None:
+            raise RuntimeError("ooc scheduler has no board loaded")
+        k = self.plan.depth
+        c = _zero_counters()
+        done = 0
+        while done < take:
+            kk = min(k, take - done)
+            self._sweep(kk, c, generation + done)
+            done += kk
+        transfer_s = c["h2d_s"] + c["d2h_s"]
+        return dict(
+            bands=self.plan.num_bands,
+            visits=c["visits"],
+            skipped_bands=c["skipped"],
+            bytes_h2d=c["bytes_h2d"],
+            bytes_d2h=c["bytes_d2h"],
+            overlap_fraction=(
+                c["hidden_s"] / transfer_s if transfer_s > 0 else 0.0
+            ),
+            sweeps=c["sweeps"],
+            h2d_s=c["h2d_s"],
+            d2h_s=c["d2h_s"],
+            hidden_s=c["hidden_s"],
+        )

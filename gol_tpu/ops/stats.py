@@ -205,6 +205,57 @@ def packed_chunk_stats(prev: jax.Array, new: jax.Array, band: int) -> dict:
     }
 
 
+_POPCOUNT8 = np.unpackbits(
+    np.arange(256, dtype=np.uint8)[:, None], axis=1
+).sum(axis=1).astype(np.uint32)
+
+
+def popcount_words_np(words: np.ndarray) -> int:
+    """Host-side popcount of a packed uint32 array (byte LUT) — the
+    numpy twin of the ``lax.population_count`` reductions above, for
+    boards that must never materialize on device (the OOC tier)."""
+    return int(_POPCOUNT8[np.asarray(words).view(np.uint8)].sum(dtype=np.uint64))
+
+
+def ooc_chunk_stats_np(
+    prev: np.ndarray, new: np.ndarray, bands, width: int, band: int
+) -> dict:
+    """Fold per-band host-side partials into one chunk-stats dict.
+
+    The OOC tier's ``--stats`` path: ``prev``/``new`` are the chunk-start
+    and chunk-end *host* boards in the packed :func:`bitlife.pack`
+    layout, ``bands`` the plan's ``(row_start, row_end)`` list.  Each
+    band contributes an exact partial per field (flip planes are the
+    same single bitwise ops as :func:`flip_planes_packed`; face bands
+    intersect the band's row range); partials fold by integer addition,
+    so the result is bit-identical to :func:`packed_chunk_stats` on the
+    whole board (pinned by tests/test_ooc.py) without any device
+    round-trip or split-accumulator bound — host ints are exact.
+    Returns plain Python ints keyed by :data:`STATS_FIELDS`.
+    """
+    h = prev.shape[0]
+    band = _clamp_band(band, h, width)
+    left_mask, right_mask = _col_band_masks(prev.shape[1], band)
+    totals = {f: 0 for f in STATS_FIELDS}
+    for r0, r1 in bands:
+        p, n = prev[r0:r1], new[r0:r1]
+        born = n & ~p
+        died = p & ~n
+        totals["population"] += popcount_words_np(n)
+        totals["births"] += popcount_words_np(born)
+        totals["deaths"] += popcount_words_np(died)
+        totals["changed"] += popcount_words_np(born | died)
+        top_take = max(0, min(r1, band) - r0)
+        if top_take:
+            totals["face_top"] += popcount_words_np(n[:top_take])
+        bot_lo = max(r0, h - band)
+        if bot_lo < r1:
+            totals["face_bottom"] += popcount_words_np(n[bot_lo - r0:])
+        totals["face_left"] += popcount_words_np(n & left_mask[None, :])
+        totals["face_right"] += popcount_words_np(n & right_mask[None, :])
+    return totals
+
+
 def dense_chunk_stats3d(prev: jax.Array, new: jax.Array) -> dict:
     """3-D volume counterpart (population/births/deaths/changed only —
     a volume has six faces and no driver consumes per-face flux yet).

@@ -20,7 +20,15 @@ dense                 any k     any k    k=1   any k
 bitpack               any k     any k*   --    any k
 pallas_bitpack        k%8       k%8      --    k%8
 activity              k=1       --       --    --
+ooc                   --        --       --    --
 ====================  ========  =======  ====  ========
+
+``ooc`` (the out-of-core streaming tier, docs/STREAMING.md) is
+host-driven and single-process by construction — the board lives in
+host RAM and bands stream through one device, so there is no sharded
+ring program to pick a mode for; every (ooc, mode) cell rejects with a
+message naming the legal alternatives (mesh-none ooc, or a sharded
+engine).
 
 (*) the packed depth-1 overlap keeps its hand-written 1-D program;
 depth-1 2-D and every deeper form run the generic interior/boundary
@@ -57,6 +65,14 @@ def mode_rejection(engine: str, shard_mode: str) -> Optional[str]:
         return (
             f"unknown shard_mode {shard_mode!r}; expected one of "
             f"{SHARD_MODES}"
+        )
+    if engine == "ooc":
+        return (
+            "the out-of-core streaming engine is host-driven and has no "
+            f"sharded ring program (got shard_mode {shard_mode!r}); run "
+            "--engine ooc without a mesh (it streams bands through one "
+            "device), or pick a sharded engine ('dense', 'bitpack', "
+            "'pallas_bitpack', 'activity') for mesh runs"
         )
     allowed = ENGINE_MODES.get(engine)
     if allowed is None or shard_mode in allowed:

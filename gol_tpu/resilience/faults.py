@@ -35,6 +35,12 @@ site                    what fires
 ``telemetry.write_error``  ``OSError`` on the next rank-file write — the
                         stream must degrade (warn once, drop, stamp
                         ``degraded``), never kill the run
+``hostcopy.error``      transient ``OSError(EIO)`` on an OOC band
+                        write-back (device→host board copy, ``count``
+                        times) — exercises the same bounded
+                        retry+backoff containment as checkpoint writes;
+                        a persistent failure surfaces (the host board IS
+                        the state, there is nothing to shed)
 ``crash.exit``          ``os._exit`` at the first chunk boundary reaching
                         ``at`` — the supervisor-child crash; armed only on
                         restart attempt < ``attempts``, so the relaunch
@@ -93,6 +99,7 @@ SITES = (
     "checkpoint.torn_tmp",
     "checkpoint.disk_full",
     "checkpoint.rename_delay",
+    "hostcopy.error",
     "snapshot.bitflip",
     "telemetry.write_error",
     "crash.exit",
@@ -402,6 +409,19 @@ def checkpoint_write_fault(tmp_path: str, generation: Optional[int]) -> None:
     if spec is not None:
         raise OSError(
             errno_mod.ENOSPC, f"injected disk-full checkpoint write: {tmp_path}"
+        )
+
+
+def hostcopy_fault(generation: Optional[int]) -> None:
+    """``hostcopy.error``: fire any armed fault on an OOC band
+    write-back.  Called by the streaming scheduler immediately before a
+    fetched band is copied into the host board; raises ``OSError(EIO)``
+    and lets :func:`gol_tpu.resilience.degrade.write_with_retry` decide
+    retry vs surface (never shed — the host board is the state)."""
+    spec = fire("hostcopy.error", generation)
+    if spec is not None:
+        raise OSError(
+            errno_mod.EIO, "injected host copy-back error"
         )
 
 

@@ -34,7 +34,9 @@ from gol_tpu.parallel import sharded as sharded_mod
 from gol_tpu.utils import checkpoint as ckpt_mod
 from gol_tpu.utils.timing import RunReport, Stopwatch, force_ready, maybe_profile
 
-ENGINES = ("auto", "dense", "bitpack", "pallas", "pallas_bitpack", "activity")
+ENGINES = (
+    "auto", "dense", "bitpack", "pallas", "pallas_bitpack", "activity", "ooc"
+)
 MESH_CHOICES = ("none", "1d", "2d")
 
 
@@ -185,6 +187,20 @@ class GolRuntime:
     # format cross-topology resume repartitions, exercisable without a
     # pod.
     sharded_snapshots: bool = False
+    # Out-of-core streaming tier knobs (--engine ooc; gol_tpu/ooc/,
+    # docs/STREAMING.md).  The packed board lives in host RAM and
+    # row-bands stream through the device under a fixed footprint:
+    # ooc_budget_mb bounds the device-resident bytes (the band planner
+    # inverts the three-deep-rotation footprint for the band height);
+    # ooc_band_rows overrides the derived height (still validated
+    # against the budget); ooc_skip_dead gates the dead-band skip
+    # (a band whose one-band light cone held no live cells at sweep
+    # start is neither fetched nor stepped).  All host-side: with the
+    # engine unselected nothing here is consulted and every other
+    # tier's programs are byte-identical (trace-identity pin).
+    ooc_budget_mb: int = 256
+    ooc_band_rows: int = 0
+    ooc_skip_dead: bool = True
     # Live metrics endpoint (--metrics-port; docs/OBSERVABILITY.md):
     # rank 0 serves Prometheus text on 127.0.0.1:<port> (0 = ephemeral),
     # fed by the same in-process event stream the rank files get — so
@@ -249,24 +265,34 @@ class GolRuntime:
                 )
         if self._resolved == "activity":
             self._init_activity()
+        if self._resolved == "ooc":
+            self._init_ooc()
         # (engine, mode, depth) legality — ONE authority
         # (gol_tpu.parallel.modes; the per-combo messages are pinned by
         # tests/test_mode_plan.py).  Geometry limits follow.
         from gol_tpu.parallel import modes as modes_mod
 
         if self.mesh is None:
-            if self.halo_depth > 1:
+            # The ooc tier is meshless by construction and reuses
+            # halo_depth as its per-visit generation depth k, so both
+            # mesh-coupled rejections exempt it (_init_ooc validated
+            # shard_mode already).
+            if self.halo_depth > 1 and self._resolved != "ooc":
                 raise ValueError(
                     "halo_depth > 1 (temporal blocking) only applies to "
                     "sharded runs; pass a mesh"
                 )
-            if self.shard_mode == "pipeline":
+            if self.shard_mode == "pipeline" and self._resolved != "ooc":
                 raise ValueError(
                     "shard_mode 'pipeline' double-buffers ring exchanges "
                     "across chunks, which only exist on sharded runs; "
                     "pass a mesh"
                 )
-        elif self._resolved in modes_mod.ENGINE_MODES:
+        elif self._resolved in modes_mod.ENGINE_MODES or (
+            self._resolved == "ooc"
+        ):
+            # For 'ooc' every cell rejects with the canonical
+            # mesh-none-only message (modes.mode_rejection).
             modes_mod.check_combo(
                 self._resolved, self.shard_mode, self.halo_depth
             )
@@ -418,6 +444,11 @@ class GolRuntime:
         # "active_tile_gens", "computed_tile_gens", "fallback_gens",
         # "skipped_tile_gens", ...}, ...].
         self.last_activity: list = []
+        # Host-int streaming counters of the last run()'s chunks
+        # (--engine ooc): [{"index", "take", "generation", "bands",
+        # "visits", "skipped_bands", "bytes_h2d", "bytes_d2h",
+        # "overlap_fraction", ...}, ...].
+        self.last_ooc: list = []
         if self.metrics_port is not None and not self.telemetry_dir:
             raise ValueError(
                 "metrics_port serves the in-process event stream, so it "
@@ -496,6 +527,59 @@ class GolRuntime:
             shard_th, shard_tw = self._act_grid
         self._act_capacity_n = sparse_engine.default_capacity(
             shard_th, shard_tw, self.activity_capacity
+        )
+
+    def _init_ooc(self) -> None:
+        """Validate + resolve the out-of-core tier's plan (docs/STREAMING.md).
+
+        Sets ``_ooc_plan`` (the :class:`gol_tpu.ooc.planner.BandPlan`).
+        ``halo_depth`` is reused as the per-visit generation depth k —
+        the same temporal-blocking quantum the sharded tiers ship over
+        the ring, here amortizing one H2D/D2H round-trip per band.
+        """
+        from gol_tpu.ooc import planner as ooc_planner
+        from gol_tpu.ops import bitlife
+        from gol_tpu.parallel import modes as modes_mod
+
+        if self.halo_mode != "fresh":
+            raise ValueError(
+                "engine 'ooc' implements fresh halos only (the stale_t0 "
+                "compat mode reproduces a reference bug the streaming "
+                "tier has no analog for)"
+            )
+        if self.rule is not None and self._rule is not None:
+            raise ValueError(
+                "engine 'ooc' streams the B3/S23 bit-packed band step; "
+                "use 'dense'/'bitpack' with a custom rule"
+            )
+        if self.shard_mode != "explicit":
+            # The canonical per-combo message — pinned like the rest of
+            # the matrix by tests/test_mode_plan.py.
+            raise ValueError(modes_mod.mode_rejection("ooc", self.shard_mode))
+        w = self.geometry.global_width
+        if w % bitlife.BITS != 0:
+            raise ValueError(
+                "engine 'ooc' streams the packed-board layout, which "
+                f"needs the board width ({w}) to be a multiple of "
+                f"{bitlife.BITS}; use 'dense' for unpacked widths"
+            )
+        if self.ooc_budget_mb < 0 or self.ooc_band_rows < 0:
+            raise ValueError(
+                "ooc_budget_mb and ooc_band_rows must be >= 0, got "
+                f"{self.ooc_budget_mb} / {self.ooc_band_rows}"
+            )
+        if self.reshard_at > 0:
+            raise ValueError(
+                "reshard_at replans onto a different mesh; the ooc tier "
+                "is meshless (its board already lives host-side — "
+                "checkpoint and resume instead)"
+            )
+        self._ooc_plan = ooc_planner.plan_bands(
+            self.geometry.global_height,
+            w,
+            self.halo_depth,
+            band_rows=self.ooc_band_rows,
+            budget_bytes=self.ooc_budget_mb << 20,
         )
 
     def _resolve_auto(self) -> str:
@@ -1318,10 +1402,11 @@ class GolRuntime:
         """Roofline fraction of one executed chunk (see telemetry module)."""
         from gol_tpu import telemetry as telemetry_mod
 
-        if self._resolved == "activity":
-            # The flop model predicts dense work; a program that skips
-            # an activity-dependent fraction of it has no honest static
-            # roofline — report none rather than a wrong number.
+        if self._resolved in ("activity", "ooc"):
+            # The flop model predicts dense device work; a program that
+            # skips an activity-dependent fraction of it — or streams
+            # bands with skip + transfer overlap (ooc) — has no honest
+            # static roofline.  Report none rather than a wrong number.
             return None
         num_devices = 1 if self.mesh is None else self.mesh.devices.size
         cells = self.geometry.global_height * self.geometry.global_width
@@ -1334,6 +1419,275 @@ class GolRuntime:
             wall_s=wall_s,
         )
 
+    # -- the out-of-core streaming tier (--engine ooc) ----------------------
+    def _initial_board_host(
+        self, pattern: int, resume: Optional[str] = None
+    ) -> Tuple[np.ndarray, int]:
+        """Host-resident board init for the ooc tier: same pattern and
+        resume validation as :meth:`initial_state`, but the dense board
+        never touches a device (``jax.device_put`` of a bigger-than-HBM
+        board is the one thing this tier exists to avoid)."""
+        self._resume_source = resume or None
+        self.last_reshard = None
+        if resume and ckpt_mod.is_sharded(resume):
+            raise ValueError(
+                "engine 'ooc' resumes from whole-board snapshots (its "
+                "board is host-resident and meshless); a sharded "
+                "checkpoint directory reshards through a mesh tier first"
+            )
+        if resume:
+            snap = ckpt_mod.load(resume)
+            if snap.num_ranks != self.geometry.num_ranks:
+                raise ValueError(
+                    f"checkpoint has {snap.num_ranks} ranks, run configured "
+                    f"for {self.geometry.num_ranks}"
+                )
+            expected = (self.geometry.global_height, self.geometry.global_width)
+            if snap.board.shape != expected:
+                raise ValueError(
+                    f"checkpoint board {snap.board.shape} != configured "
+                    f"{expected}"
+                )
+            if snap.rule is not None:
+                raise ValueError(
+                    f"checkpoint was written by a {snap.rule} run; engine "
+                    "'ooc' streams B3/S23 only — resume it on "
+                    "'dense'/'bitpack' with the matching --rule"
+                )
+            return np.asarray(snap.board), int(snap.generation)
+        board_np = patterns.init_global(
+            pattern, self.geometry.size, self.geometry.num_ranks
+        )
+        return board_np, 0
+
+    def _run_ooc(
+        self,
+        pattern: int,
+        iterations: int,
+        resume: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+    ) -> Tuple[RunReport, GolState]:
+        """The host-driven run loop behind ``--engine ooc``.
+
+        Mirrors :meth:`run`'s chunk contract — schedule, telemetry,
+        stats, checkpoint cadence, preemption, fault-plane drain — but
+        the board stays in host RAM as a packed numpy array and each
+        chunk streams row-bands through the device via
+        :class:`gol_tpu.ooc.OocScheduler` (docs/STREAMING.md).  Chunk
+        events carry the schema-v15 ``ooc`` block.
+        """
+        import time as time_mod
+        import types
+
+        from gol_tpu import telemetry as telemetry_mod
+        from gol_tpu.ooc import OocScheduler
+        from gol_tpu.resilience import degrade as degrade_mod
+        from gol_tpu.resilience import faults as faults_mod
+        from gol_tpu.telemetry import stats as tstats_mod
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "engine 'ooc' is single-process: the board lives in one "
+                "host's RAM and streams through one device"
+            )
+        plan_on = faults_mod.active() is not None
+        plan = self._ooc_plan
+        sw = Stopwatch()
+        self.last_stats = []
+        self.last_activity = []
+        self.last_ooc = []
+        self._ckpt_shed = False
+        with sw.phase("init"):
+            board_np, generation = self._initial_board_host(pattern, resume)
+            sched = OocScheduler(plan, skip_dead=self.ooc_skip_dead)
+            sched.load_dense(board_np)
+            del board_np  # the packed host board is the state now
+
+        schedule = self.chunk_schedule(
+            iterations,
+            self.checkpoint_every if self.checkpoint_every > 0 else iterations,
+        )
+        events = self.open_event_log()
+        self._live_events = events
+        sc = telemetry_mod.SpanClock() if events is not None else None
+
+        def _drain_plane():
+            if events is None:
+                return
+            for f in faults_mod.drain_fired():
+                events.fault_event(**f)
+            for d in degrade_mod.drain_reports():
+                events.degraded_event(**d)
+
+        def _host_state():
+            # Dense unpack (host-side) for snapshot parity: an ooc
+            # checkpoint is bit-identical to an in-core one, so resume
+            # works across tiers in both directions.
+            return types.SimpleNamespace(
+                board=sched.dense(), generation=generation
+            )
+
+        try:
+            with sw.phase("compile"):
+                # Every (band height, visit depth) shape the schedule
+                # needs, compiled before the timed loop (the same
+                # steady-state contract as compile_evolvers).
+                if events is not None:
+                    def on_compile(info):
+                        events.compile_event(
+                            info["depth"],
+                            info["lower_s"],
+                            info["compile_s"],
+                            memory=tstats_mod.compiled_memory(
+                                info["executable"]
+                            ),
+                        )
+
+                    sched.on_compile = on_compile
+                depths = set()
+                for take in set(schedule):
+                    if take >= plan.depth:
+                        depths.add(plan.depth)
+                    if take % plan.depth:
+                        depths.add(take % plan.depth)
+                for bh in sorted(set(plan.band_heights())):
+                    for kk in sorted(depths):
+                        sched._program(bh, kk)
+
+            writer = None
+            if self.checkpoint_every > 0:
+                writer = ckpt_mod.AsyncSnapshotWriter()
+            self._ckpt_writer = writer
+            try:
+                with maybe_profile(profile_dir), telemetry_mod.trace_annotation(
+                    "gol.run.evolve"
+                ):
+                    for i, take in enumerate(schedule):
+                        # --stats forfeits in-place thrift the same way
+                        # in-core stats forfeits donation: one extra
+                        # packed board for the chunk-start diff.
+                        prev_packed = (
+                            sched.board.copy() if self.stats else None
+                        )
+                        with telemetry_mod.step_annotation("gol.chunk", i):
+                            with sw.phase("total"):
+                                t0 = time_mod.perf_counter()
+                                rep = sched.run_chunk(take, generation)
+                                dt = time_mod.perf_counter() - t0
+                        generation += take
+                        self.last_ooc.append(
+                            dict(
+                                index=i,
+                                take=take,
+                                generation=generation,
+                                **rep,
+                            )
+                        )
+                        if events is not None:
+                            spans = sc.take()
+                            extra = {"ooc": rep}
+                            if spans:
+                                extra["spans"] = spans
+                            with sc.span("telemetry"):
+                                events.chunk_event(
+                                    i,
+                                    take,
+                                    generation,
+                                    dt,
+                                    self.geometry.cell_updates(take),
+                                    self.chunk_utilization(take, dt),
+                                    **extra,
+                                )
+                        if self.stats:
+                            from gol_tpu.ops import stats as ops_stats
+
+                            vals = ops_stats.ooc_chunk_stats_np(
+                                prev_packed,
+                                sched.board,
+                                plan.bands,
+                                plan.width,
+                                max(1, self.halo_depth),
+                            )
+                            self.last_stats.append(
+                                dict(
+                                    index=i,
+                                    take=take,
+                                    generation=generation,
+                                    **vals,
+                                )
+                            )
+                            if events is not None:
+                                with sc.span("telemetry"):
+                                    events.stats_event(
+                                        i, take, generation, vals
+                                    )
+                        if self.checkpoint_every > 0 and not self._ckpt_shed:
+                            state = _host_state()
+                            with telemetry_mod.trace_annotation(
+                                "gol.checkpoint.save"
+                            ):
+                                with sw.phase("checkpoint"):
+                                    t0 = time_mod.perf_counter()
+                                    self._save_snapshot(state)
+                                    ck = time_mod.perf_counter() - t0
+                            if sc is not None:
+                                sc.add("checkpoint", ck)
+                            if events is not None:
+                                with sc.span("telemetry"):
+                                    events.checkpoint_event(
+                                        generation,
+                                        ck,
+                                        int(state.board.size),
+                                        overlapped=writer is not None,
+                                    )
+                        if plan_on:
+                            faults_mod.crash_or_stall(generation)
+                        _drain_plane()
+                        if i < len(schedule) - 1:
+                            from gol_tpu import resilience
+
+                            if sc is None:
+                                preempt_now = (
+                                    resilience.agreed_preempt_requested()
+                                )
+                            else:
+                                with sc.span("preempt_poll"):
+                                    preempt_now = (
+                                        resilience.agreed_preempt_requested()
+                                    )
+                            if preempt_now:
+                                self._preempt(
+                                    _host_state(),
+                                    sw,
+                                    writer,
+                                    events,
+                                    already_saved=self.checkpoint_every > 0,
+                                )
+                if writer is not None:
+                    with sw.phase("checkpoint"):
+                        writer.flush()
+            finally:
+                self._ckpt_writer = None
+                if writer is not None:
+                    writer.close()
+
+            _drain_plane()
+            report = sw.report(self.geometry.cell_updates(iterations))
+            if events is not None:
+                events.summary(report)
+        finally:
+            self._live_events = None
+            if events is not None:
+                events.close()
+        # The returned state keeps the board HOST-resident on purpose —
+        # GolState.create would device_put a board this tier exists to
+        # keep off the device.  Consumers (dump paths, tests) treat it
+        # as an array; np.asarray is a no-op.
+        state = GolState(
+            board=sched.dense(), generation=np.uint32(generation)
+        )
+        return report, state
+
     # -- main entry ---------------------------------------------------------
     def run(
         self,
@@ -1342,6 +1696,8 @@ class GolRuntime:
         resume: Optional[str] = None,
         profile_dir: Optional[str] = None,
     ) -> Tuple[RunReport, GolState]:
+        if self._resolved == "ooc":
+            return self._run_ooc(pattern, iterations, resume, profile_dir)
         import time as time_mod
 
         from gol_tpu import telemetry as telemetry_mod

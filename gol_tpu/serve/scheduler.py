@@ -662,6 +662,12 @@ class ServeScheduler:
                 f"{_RULE} (Conway) only"
             )
         engine = obj.get("engine", self.default_engine)
+        if engine == "ooc":
+            raise ValidationError(
+                "engine 'ooc' streams one bigger-than-device board and "
+                "is not served (a serving tier batches many small "
+                f"in-core worlds); supported engines: {_ENGINES}"
+            )
         if engine not in _ENGINES:
             raise ValidationError(
                 f"unknown engine {engine!r}; expected one of {_ENGINES}"

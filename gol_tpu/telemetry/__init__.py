@@ -69,7 +69,19 @@ from typing import Dict, Optional
 # only lazily (inside its dump path), so there is no cycle.
 from gol_tpu.telemetry import blackbox
 
-# Version 14 (this round) lifts observability from one server to the
+# Version 15 (this round) gives the out-of-core streaming tier
+# (``--engine ooc``, docs/STREAMING.md) its observability block: each
+# ``chunk`` event of an ooc run carries an ``ooc`` block — ``bands``
+# (the plan's band count), ``visits`` (band visits actually computed),
+# ``skipped_bands`` (dead bands that moved zero bytes), ``bytes_h2d`` /
+# ``bytes_d2h`` (the chunk's transfer volume), ``overlap_fraction``
+# (measured fraction of host-side transfer wall hidden behind an
+# in-flight compute — the number the streaming tier's whole design
+# optimizes), plus the timing internals ``sweeps`` / ``h2d_s`` /
+# ``d2h_s`` / ``hidden_s``.  Additive like every block before it:
+# readers that don't know ``ooc`` ignore it, and ``summarize`` renders
+# an ooc column only when some run carries the block.
+# Version 14 lifts observability from one server to the
 # fleet (docs/SERVING.md, "The fleet"): a ``fleet`` record marks one
 # decision of the replicated front tier (:mod:`gol_tpu.serve.fleet`) —
 # ``action`` is one of ``route`` (a request was pinned to a replica by
@@ -188,8 +200,8 @@ from gol_tpu.telemetry import blackbox
 # raises a "schema vN is newer than this reader supports" SchemaError
 # (exit 2 at the CLI) instead of letting a consumer KeyError on a field
 # it has never heard of.
-SCHEMA_VERSION = 14
-SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14)
+SCHEMA_VERSION = 15
+SUPPORTED_SCHEMAS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15)
 
 # Required fields per event type (beyond the envelope's "event" and "t").
 # Extra fields are always allowed — the schema pins what consumers may

@@ -581,6 +581,61 @@ def _fleet_records(data: dict, source: str, round_: Optional[int]) -> List[dict]
     return out
 
 
+def _ooc_records(data: dict, source: str, round_: Optional[int]) -> List[dict]:
+    """OOC_r*.json (oocbench): each scenario×budget row lands as one
+    throughput record (streamed cell-updates/s, higher) plus one
+    streaming-efficiency record (fraction of in-core throughput retained
+    under that budget, higher).  Efficiency is the tier's headline — a
+    board that no longer fits simply cannot run in-core, so the gate
+    prices how much of the chip the rotation keeps busy, and a
+    regression here means the overlap stopped hiding the transfers."""
+    backend = data.get("backend", "cpu")
+    shape = f"{data.get('height')}x{data.get('width')}"
+    depth, iters = data.get("depth"), data.get("iters")
+    out = []
+    for row in data.get("rows") or []:
+        ratio = row.get("board_over_budget")
+        label = (
+            f"ooc:{backend}:{shape}:k{depth}x{iters}:{row['scenario']}:"
+            + (f"r{ratio:g}" if ratio else f"b{row.get('budget_bytes')}")
+        )
+        extra = {
+            "bands": row.get("bands"),
+            "skipped_bands": row.get("skipped_bands"),
+            "overlap_fraction": row.get("overlap_fraction"),
+            "bytes_h2d": row.get("bytes_h2d"),
+            "bytes_d2h": row.get("bytes_d2h"),
+            "bit_equal": row.get("bit_equal"),
+        }
+        out.append(
+            _record(
+                label,
+                row["updates_per_sec"],
+                "cell-updates/s",
+                source,
+                "oocbench",
+                backend,
+                round_=round_,
+                extra=extra,
+            )
+        )
+        if row.get("efficiency") is not None:
+            out.append(
+                _record(
+                    label + ":efficiency",
+                    row["efficiency"],
+                    "fraction-of-incore",
+                    source,
+                    "oocbench",
+                    backend,
+                    kind="streaming-efficiency",
+                    round_=round_,
+                    extra=extra,
+                )
+            )
+    return out
+
+
 _TOOL_ADAPTERS = {
     "bench": _bench_records,
     "batchbench": _batch_records,
@@ -590,6 +645,7 @@ _TOOL_ADAPTERS = {
     "dryrun_multichip": _multichip_records,
     "servebench": _serve_records,
     "fleetbench": _fleet_records,
+    "oocbench": _ooc_records,
 }
 
 

@@ -529,12 +529,14 @@ def render_run(run: Run, out) -> None:
         spans_any = any(c.get("spans") for c in chunks)
         gated = any(c.get("activity") for c in chunks)
         ringed = any(c.get("halo") for c in chunks)
+        streamed = any(c.get("ooc") for c in chunks)
         print(
             "  chunk     gens       gen      wall_s     updates/s  "
             "roofline"
             + ("  batch (bucket B eng per-world/s)" if batched else "")
             + ("  activity (active% skipped fallbacks)" if gated else "")
-            + ("  halo (mode k exch band)" if ringed else ""),
+            + ("  halo (mode k exch band)" if ringed else "")
+            + ("  ooc (bands skip h2d/d2h ovl%)" if streamed else ""),
             file=out,
         )
         for c in chunks:
@@ -565,6 +567,19 @@ def render_run(run: Run, out) -> None:
                     f" x{hb.get('exchanges', '?')}"
                     f" {hb.get('band_bytes', 0)}B"
                     f" ({100 * hb.get('exchange_share', 0.0):.1f}%)"
+                )
+            o = c.get("ooc")
+            if o:
+                # Schema v15 (docs/STREAMING.md): the out-of-core tier's
+                # streaming accounting — band count, dead bands that
+                # moved zero bytes, the chunk's transfer volume, and the
+                # measured fraction of transfer wall hidden behind
+                # in-flight compute.
+                line += (
+                    f"  {o.get('bands', '?')}b"
+                    f" skip {o.get('skipped_bands', 0)}"
+                    f" {o.get('bytes_h2d', 0)}/{o.get('bytes_d2h', 0)}B"
+                    f" ovl {100 * o.get('overlap_fraction', 0.0):.0f}%"
                 )
             b = c.get("batch")
             if b:

@@ -68,25 +68,31 @@
 #                       ownership fencing on the restart, exactly-once
 #                       byte-equal completion, degraded→recovered
 #                       /readyz, graceful drain exit 0)
+#  19. ooc smoke       (out-of-core tier, docs/STREAMING.md: a Gosper
+#                       gun streamed through a device footprint the
+#                       board is >=4x of — bit-equal to the in-core
+#                       bitpack tier, dead bands skipped, v15 ooc
+#                       blocks with measured overlap_fraction on
+#                       every chunk)
 #
 # Any stage failing fails the gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== [1/18] lint =="
+echo "== [1/19] lint =="
 bash scripts/lint.sh
 
-echo "== [2/18] static verifier (gol_tpu.analysis) =="
+echo "== [2/19] static verifier (gol_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.analysis
 
-echo "== [3/18] telemetry smoke (docs/OBSERVABILITY.md) =="
+echo "== [3/19] telemetry smoke (docs/OBSERVABILITY.md) =="
 tdir="$(mktemp -d)"
 trap 'rm -rf "$tdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 0 64 8 512 0 \
     --telemetry "$tdir" --run-id smoke > /dev/null
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$tdir"
 
-echo "== [4/18] stats smoke (in-graph simulation statistics) =="
+echo "== [4/19] stats smoke (in-graph simulation statistics) =="
 sdir="$(mktemp -d)"
 trap 'rm -rf "$tdir" "$sdir"' EXIT
 JAX_PLATFORMS=cpu python -m gol_tpu 6 64 8 512 0 \
@@ -95,43 +101,43 @@ JAX_PLATFORMS=cpu python -m gol_tpu.telemetry summarize "$sdir" \
     | tee /tmp/_stats_smoke.log
 grep -q "stats     gen" /tmp/_stats_smoke.log
 
-echo "== [5/18] resilience drill (docs/RESILIENCE.md) =="
+echo "== [5/19] resilience drill (docs/RESILIENCE.md) =="
 JAX_PLATFORMS=cpu python scripts/resilience_drill.py
 
-echo "== [6/18] batch smoke (docs/BATCHING.md) =="
+echo "== [6/19] batch smoke (docs/BATCHING.md) =="
 JAX_PLATFORMS=cpu python scripts/batch_smoke.py
 
-echo "== [7/18] sparse smoke (docs/SPARSE.md) =="
+echo "== [7/19] sparse smoke (docs/SPARSE.md) =="
 JAX_PLATFORMS=cpu python scripts/sparse_smoke.py
 
-echo "== [8/18] obs smoke (docs/OBSERVABILITY.md) =="
+echo "== [8/19] obs smoke (docs/OBSERVABILITY.md) =="
 JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
-echo "== [9/18] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
+echo "== [9/19] reshard smoke (docs/RESILIENCE.md, elastic meshes) =="
 JAX_PLATFORMS=cpu python scripts/reshard_smoke.py
 
-echo "== [10/18] halo smoke (pipelined depth-k exchange, PR 9) =="
+echo "== [10/19] halo smoke (pipelined depth-k exchange, PR 9) =="
 JAX_PLATFORMS=cpu python scripts/halo_smoke.py
 
-echo "== [11/18] chaos smoke (docs/RESILIENCE.md, fault plane) =="
+echo "== [11/19] chaos smoke (docs/RESILIENCE.md, fault plane) =="
 JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
-echo "== [12/18] serve smoke (docs/SERVING.md, serving tier) =="
+echo "== [12/19] serve smoke (docs/SERVING.md, serving tier) =="
 JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
-echo "== [13/18] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
+echo "== [13/19] elastic smoke (docs/RESILIENCE.md, live elasticity) =="
 python scripts/elastic_smoke.py
 
-echo "== [14/18] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
+echo "== [14/19] lockcheck (host-plane concurrency, docs/ANALYSIS.md) =="
 python -m gol_tpu.analysis --concurrency
 
-echo "== [15/18] trace smoke (docs/OBSERVABILITY.md, request tracing) =="
+echo "== [15/19] trace smoke (docs/OBSERVABILITY.md, request tracing) =="
 JAX_PLATFORMS=cpu python -m gol_tpu.telemetry trace \
     tests/data/telemetry_v12 --perfetto /tmp/_trace_export.json
 python scripts/validate_trace_export.py /tmp/_trace_export.json \
     docs/schemas/perfetto_trace.schema.json
 
-echo "== [16/18] tier-1 tests =="
+echo "== [16/19] tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -140,10 +146,13 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 
-echo "== [17/18] postmortem-smoke (docs/OBSERVABILITY.md, black box) =="
+echo "== [17/19] postmortem-smoke (docs/OBSERVABILITY.md, black box) =="
 make postmortem-smoke
 
-echo "== [18/18] fleet smoke (docs/SERVING.md, the fleet) =="
+echo "== [18/19] fleet smoke (docs/SERVING.md, the fleet) =="
 JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
+echo "== [19/19] ooc smoke (docs/STREAMING.md, out-of-core tier) =="
+JAX_PLATFORMS=cpu python scripts/ooc_smoke.py
 
 exit "$rc"

@@ -1,0 +1,100 @@
+"""OOC smoke: the streaming tier's three contracts, end to end.
+
+check.sh stage [19/19] (docs/STREAMING.md).  A Gosper-gun run pushed
+through the real runtime dispatch (``--engine ooc``) must be:
+
+1. **out-of-core for real** — the packed board is at least 4x the
+   rotation's device footprint (the simulated budget the plan commits
+   to), so the device never saw the whole board at once;
+2. **bit-identical** to the in-core bitpack tier on the same pattern —
+   streaming through bands, alternating sweeps, deferred drains and the
+   wrap buffer may never change the program, only its residency;
+3. **actually streaming-aware** — dead bands were skipped (the gun is
+   band-local; transfer must scale with active bands, not board area),
+   and the telemetry stream carries the schema-v15 ``ooc`` block with a
+   measured ``overlap_fraction`` on every chunk.
+
+A smoke that only checked equality would pass for a tier that streams
+nothing; one that only checked the footprint would pass for a tier that
+streams wrongly.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from gol_tpu.models.state import Geometry
+    from gol_tpu.runtime import GolRuntime
+
+    kw = dict(geometry=Geometry(size=64, num_ranks=16))  # 1024 x 64 board
+    _, ref = GolRuntime(**kw, engine="bitpack").run(pattern=7, iterations=48)
+
+    with tempfile.TemporaryDirectory() as tdir:
+        rt = GolRuntime(
+            **kw,
+            engine="ooc",
+            halo_depth=3,  # k: generations amortized per band round-trip
+            ooc_band_rows=13,
+            ooc_budget_mb=0,
+            telemetry_dir=tdir,
+            run_id="oocsmoke",
+        )
+        plan = rt._ooc_plan
+        ratio = plan.board_bytes / plan.device_bytes()
+        if ratio < 4.0:
+            print(
+                f"FAIL: board {plan.board_bytes}B is only {ratio:.1f}x the "
+                f"device footprint {plan.device_bytes()}B — not out-of-core"
+            )
+            return 1
+
+        _, got = rt.run(pattern=7, iterations=48)
+
+        if not np.array_equal(np.asarray(ref.board), np.asarray(got.board)):
+            print("FAIL: streamed run diverges from the in-core bitpack tier")
+            return 1
+
+        skipped = sum(o["skipped_bands"] for o in rt.last_ooc)
+        if skipped <= 0:
+            print("FAIL: gun run skipped zero dead bands")
+            return 1
+
+        recs = [
+            json.loads(ln)
+            for ln in open(pathlib.Path(tdir) / "oocsmoke.rank0.jsonl")
+        ]
+        chunks = [r for r in recs if r["event"] == "chunk"]
+        if not chunks or any("ooc" not in c for c in chunks):
+            print("FAIL: chunk events missing the v15 ooc block")
+            return 1
+        if any("overlap_fraction" not in c["ooc"] for c in chunks):
+            print("FAIL: ooc blocks missing the measured overlap_fraction")
+            return 1
+
+    visits = sum(o["visits"] for o in rt.last_ooc)
+    ovl = max(c["ooc"]["overlap_fraction"] for c in chunks)
+    print(
+        f"ooc smoke OK: {plan.num_bands}-band plan, board {ratio:.1f}x the "
+        f"{plan.device_bytes()}B device footprint, gun bit-equal to "
+        f"bitpack, {skipped} dead-band skips vs {visits} visits, "
+        f"peak overlap {100 * ovl:.0f}%"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

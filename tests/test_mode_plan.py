@@ -137,3 +137,92 @@ def test_runtime_accepts_every_dense_cell():
             engine="dense", mesh=mesh_mod.make_mesh_1d(4), shard_mode=mode
         )
         assert rt.shard_mode == mode
+
+
+# -- the out-of-core row: meshless by construction ---------------------------
+#
+# Engine 'ooc' (docs/STREAMING.md) streams host-resident bands through
+# ONE device; there is no sharded ring program to pick a mode for, so
+# every (ooc, mode) cell rejects with one canonical message naming the
+# legal alternatives, and the serve/batch tiers refuse it by name.
+
+
+@pytest.mark.parametrize("mode", sorted(modes.SHARD_MODES))
+def test_every_ooc_cell_pins_the_canonical_message(mode):
+    msg = modes.mode_rejection("ooc", mode)
+    assert "no sharded ring program" in msg
+    assert "--engine ooc without a mesh" in msg
+    # The rejection must name the engines that DO shard, or the message
+    # is a dead end for the user it fires on.
+    for alt in ("'dense'", "'bitpack'", "'pallas_bitpack'", "'activity'"):
+        assert alt in msg
+
+
+def test_runtime_surfaces_ooc_mesh_rejection():
+    with pytest.raises(ValueError, match="no sharded ring program"):
+        _rt(
+            engine="ooc",
+            mesh=mesh_mod.make_mesh_1d(4),
+            shard_mode="explicit",
+        )
+
+
+def test_runtime_surfaces_ooc_mode_rejection_without_mesh():
+    # shard_mode is a ring knob; a meshless ooc run still rejects a
+    # non-default mode through the same canonical message.
+    with pytest.raises(ValueError, match="no sharded ring program"):
+        _rt(engine="ooc", shard_mode="overlap", halo_depth=2)
+
+
+def test_runtime_accepts_meshless_ooc_with_deep_visits():
+    # halo_depth doubles as the per-visit generation depth k, so the
+    # "temporal blocking needs a mesh" rejection must exempt ooc.
+    rt = _rt(engine="ooc", halo_depth=4)
+    assert rt._resolved == "ooc" and rt._ooc_plan.depth == 4
+
+
+def test_serve_rejects_ooc_naming_supported_engines(tmp_path):
+    from gol_tpu.serve.scheduler import ServeScheduler, ValidationError
+
+    sched = ServeScheduler(str(tmp_path / "state"), quantum=32)
+    try:
+        with pytest.raises(ValidationError, match="is not served") as ei:
+            sched.submit(
+                {"pattern": 4, "size": 32, "generations": 1, "engine": "ooc"}
+            )
+        assert "supported engines" in str(ei.value)
+    finally:
+        sched.close()
+
+
+def test_batch_rejects_ooc_naming_batched_engines():
+    import numpy as np
+
+    from gol_tpu.batch import GolBatchRuntime
+
+    with pytest.raises(ValueError, match="streams one bigger-than-device"):
+        GolBatchRuntime(
+            worlds=[np.zeros((8, 8), dtype=np.uint8)], engine="ooc"
+        )
+
+
+def test_cli_rejects_batch_times_ooc(capsys, tmp_path):
+    from gol_tpu import cli
+
+    rc = cli.main(
+        ["7", "64", "8", "32", "0", "--engine", "ooc", "--batch", "2",
+         "--outdir", str(tmp_path)]
+    )
+    assert rc == 255
+    assert "run it unbatched" in capsys.readouterr().out
+
+
+def test_cli_rejects_guard_times_ooc(capsys, tmp_path):
+    from gol_tpu import cli
+
+    rc = cli.main(
+        ["7", "64", "8", "32", "0", "--engine", "ooc", "--guard-every", "2",
+         "--outdir", str(tmp_path)]
+    )
+    assert rc == 255
+    assert "guard an in-core engine" in capsys.readouterr().out

@@ -1,0 +1,275 @@
+"""The out-of-core streaming tier (docs/STREAMING.md) — acceptance pins.
+
+The tier's whole claim is "a board bigger than device memory, stepped
+bit-exactly through a fixed device footprint".  Pinned here:
+
+- **out-of-core equality** — a board >= 4x a small simulated device
+  budget, streamed through the full runtime dispatch, is bit-equal to
+  the in-core bitpack oracle (the budget is enforced by the planner's
+  footprint bound, so the device provably never held the board);
+- **layout round-trip** — the host-side pack/unpack is the exact
+  ``ops/bitlife`` device layout (the checkpoint and cross-tier resume
+  story depends on the two never drifting);
+- **transfer scales with activity** — dead bands move zero bytes, so a
+  sparse pattern's ``bytes_h2d`` collapses relative to a soup on the
+  same plan;
+- **checkpoint/resume** — an interrupted streamed run resumes bit-equal,
+  in BOTH cross-tier directions (ooc snapshot -> bitpack resume and
+  back): a snapshot is a board, not a tier;
+- **write-back containment** — a transient ``hostcopy.error`` retries
+  and recovers (reported as degraded events), a persistent one
+  surfaces: the host board is the state, shedding it is state loss;
+- **observability** — ``--stats`` folds are bit-identical to the
+  in-core stats programs, and the telemetry stream carries the v15
+  ``ooc`` block (tests/test_telemetry_v15.py pins the schema itself).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gol_tpu.models.state import Geometry
+from gol_tpu.ooc import (
+    OocScheduler,
+    hostboard,
+    pack_np,
+    plan_bands,
+    unpack_np,
+)
+from gol_tpu.ops import bitlife
+from gol_tpu.ops import stats as stats_mod
+from gol_tpu.resilience import degrade as degrade_mod
+from gol_tpu.resilience import faults as faults_mod
+from gol_tpu.runtime import GolRuntime
+
+from tests import oracle
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _soup(h, w, seed=33, density=0.33):
+    return oracle.random_board(h, w, seed=seed, density=density)
+
+
+# -- the headline: bigger than the budget, still bit-exact -------------------
+
+
+def test_board_4x_budget_bit_equal_to_bitpack_oracle():
+    """512x64 board under a 1 KiB simulated budget (the packed board is
+    4 KiB, >= 4x the rotation footprint the planner fits under the
+    budget): streamed == in-core bitpack over a multi-chunk schedule."""
+    h, w, k = 512, 64, 3
+    budget = 1024
+    plan = plan_bands(h, w, k, budget_bytes=budget)
+    assert plan.device_bytes() <= budget
+    assert plan.board_bytes >= 4 * plan.device_bytes()
+    assert plan.num_bands >= 4  # genuinely banded, not one tall slab
+
+    board = _soup(h, w)
+    sched = OocScheduler(plan)
+    sched.load_dense(board)
+    gen = 0
+    for take in (7, 5, 4):  # remainder sweeps included (k=3)
+        sched.run_chunk(take, gen)
+        gen += take
+    ref = np.asarray(bitlife.evolve_dense_io(jnp.asarray(board), gen))
+    np.testing.assert_array_equal(sched.dense(), ref)
+
+
+def test_runtime_dispatch_matches_bitpack_engine():
+    kw = dict(geometry=Geometry(size=64, num_ranks=2))
+    _, ref = GolRuntime(**kw, engine="bitpack").run(pattern=7, iterations=24)
+    rt = GolRuntime(**kw, engine="ooc", halo_depth=3, ooc_band_rows=13,
+                    ooc_budget_mb=0)
+    _, got = rt.run(pattern=7, iterations=24)
+    np.testing.assert_array_equal(np.asarray(got.board), np.asarray(ref.board))
+    assert rt.last_ooc and all("overlap_fraction" in o for o in rt.last_ooc)
+
+
+# -- layout: the host pack IS the device pack --------------------------------
+
+
+def test_host_pack_unpack_matches_device_layout():
+    board = _soup(37, 96, seed=5)
+    packed = pack_np(board)
+    dev = np.asarray(bitlife.pack(jnp.asarray(board)))
+    np.testing.assert_array_equal(packed, dev)
+    np.testing.assert_array_equal(unpack_np(packed, 96), board)
+    np.testing.assert_array_equal(
+        unpack_np(packed, 96),
+        np.asarray(bitlife.unpack(jnp.asarray(packed))),
+    )
+    assert hostboard.popcount_np(packed) == int(board.sum())
+
+
+# -- transfer scales with activity, not area ---------------------------------
+
+
+def test_dead_bands_move_zero_bytes():
+    h, w, k = 320, 64, 2
+    plan = plan_bands(h, w, k, band_rows=10)
+
+    def h2d(board, skip=True):
+        sched = OocScheduler(plan, skip_dead=skip)
+        sched.load_dense(board)
+        rep = sched.run_chunk(4, 0)
+        return rep, sched
+
+    soup_rep, _ = h2d(_soup(h, w))
+    gun = np.zeros((h, w), dtype=np.uint8)
+    gun[4:13, 4:40] = _soup(9, 36, seed=1, density=0.4)  # one active corner
+    gun_rep, gun_sched = h2d(gun)
+    assert soup_rep["skipped_bands"] == 0
+    assert gun_rep["skipped_bands"] > 0
+    # The sparse run's transfer is a small fraction of the soup's.
+    assert gun_rep["bytes_h2d"] < soup_rep["bytes_h2d"] / 4
+    assert gun_rep["bytes_d2h"] < soup_rep["bytes_d2h"] / 4
+    # And skipping never changed the answer.
+    ref, _ = h2d(gun, skip=False)
+    np.testing.assert_array_equal(
+        gun_sched.dense(),
+        np.asarray(bitlife.evolve_dense_io(jnp.asarray(gun), 4)),
+    )
+
+
+# -- checkpoint/resume: a snapshot is a board, not a tier --------------------
+
+
+def test_checkpoint_resume_cross_tier_both_directions(tmp_path):
+    kw = dict(geometry=Geometry(size=64, num_ranks=2))
+    _, ref = GolRuntime(**kw, engine="bitpack").run(pattern=7, iterations=12)
+
+    from gol_tpu import resilience
+
+    # ooc writes the snapshot; bitpack resumes it.
+    d1 = tmp_path / "ooc_ck"
+    GolRuntime(
+        **kw, engine="ooc", halo_depth=3, ooc_band_rows=13, ooc_budget_mb=0,
+        checkpoint_every=6, checkpoint_dir=str(d1),
+    ).run(pattern=7, iterations=6)
+    path, info = resilience.resolve_auto_resume(str(d1))
+    assert info["generation"] == 6
+    _, got = GolRuntime(**kw, engine="bitpack").run(
+        pattern=7, iterations=6, resume=path
+    )
+    np.testing.assert_array_equal(np.asarray(got.board), np.asarray(ref.board))
+
+    # bitpack writes the snapshot; ooc resumes it.
+    d2 = tmp_path / "bp_ck"
+    GolRuntime(
+        **kw, engine="bitpack", checkpoint_every=6, checkpoint_dir=str(d2),
+    ).run(pattern=7, iterations=6)
+    path2, info2 = resilience.resolve_auto_resume(str(d2))
+    assert info2["generation"] == 6
+    _, got2 = GolRuntime(
+        **kw, engine="ooc", halo_depth=3, ooc_band_rows=13, ooc_budget_mb=0,
+    ).run(pattern=7, iterations=6, resume=path2)
+    np.testing.assert_array_equal(
+        np.asarray(got2.board), np.asarray(ref.board)
+    )
+
+
+# -- write-back containment --------------------------------------------------
+
+
+def _armed(count):
+    return faults_mod.FaultPlan(
+        [faults_mod.FaultSpec(site="hostcopy.error", count=count)]
+    )
+
+
+def test_transient_hostcopy_error_retries_and_recovers():
+    h, w = 64, 32
+    plan = plan_bands(h, w, 1, band_rows=8)
+    board = _soup(h, w, seed=9)
+    sched = OocScheduler(plan, skip_dead=False)
+    sched.load_dense(board)
+    degrade_mod.drain_reports()
+    faults_mod.install(_armed(count=2))
+    try:
+        sched.run_chunk(3, 0)
+    finally:
+        faults_mod.clear()
+    np.testing.assert_array_equal(
+        sched.dense(),
+        np.asarray(bitlife.evolve_dense_io(jnp.asarray(board), 3)),
+    )
+    reports = degrade_mod.drain_reports()
+    retried = [r for r in reports if r["resource"] == "hostcopy"
+               and r["action"] == "retried"]
+    assert len(retried) == 2  # one per injected EIO, then recovery
+
+
+def test_persistent_hostcopy_error_surfaces():
+    h, w = 64, 32
+    plan = plan_bands(h, w, 1, band_rows=8)
+    sched = OocScheduler(plan, skip_dead=False)
+    sched.load_dense(_soup(h, w, seed=9))
+    faults_mod.install(_armed(count=-1))
+    try:
+        with pytest.raises(OSError, match="injected host copy-back"):
+            sched.run_chunk(1, 0)
+    finally:
+        faults_mod.clear()
+        degrade_mod.drain_reports()
+
+
+# -- observability: stats folds and the planner's refusals -------------------
+
+
+def test_ooc_stats_fold_matches_packed_chunk_stats():
+    h, w, band = 96, 64, 3
+    prev_d, new_d = _soup(h, w, seed=2), _soup(h, w, seed=3)
+    plan = plan_bands(h, w, 3, band_rows=12)
+    got = stats_mod.ooc_chunk_stats_np(
+        pack_np(prev_d), pack_np(new_d), plan.bands, w, band
+    )
+    want = stats_mod.stats_values(
+        stats_mod.packed_chunk_stats(
+            jnp.asarray(prev_d), jnp.asarray(new_d), band
+        )
+    )
+    assert got == want
+
+
+def test_runtime_stats_match_bitpack_engine():
+    kw = dict(geometry=Geometry(size=64, num_ranks=2), stats=True)
+    rt_bp = GolRuntime(**kw, engine="bitpack")
+    rt_bp.run(pattern=7, iterations=12)
+    rt = GolRuntime(**kw, engine="ooc", ooc_band_rows=13, ooc_budget_mb=0)
+    rt.run(pattern=7, iterations=12)
+    assert rt.last_stats == rt_bp.last_stats
+
+
+@pytest.mark.parametrize("kwargs,match", [
+    (dict(height=64, width=64, depth=0), "depth must be >= 1"),
+    (dict(height=5, width=64, depth=3), "too small for ooc depth"),
+    (dict(height=64, width=64, depth=4, band_rows=2), "band height 2 < depth"),
+    (dict(height=64, width=64, depth=1), "needs a device budget"),
+    (dict(height=4096, width=4096, depth=1, budget_bytes=64),
+     "exceeds device budget"),
+])
+def test_planner_refusals_pin_their_message(kwargs, match):
+    with pytest.raises(ValueError, match=match):
+        plan_bands(**kwargs)
+
+
+def test_telemetry_stream_carries_ooc_blocks(tmp_path):
+    import json
+
+    rt = GolRuntime(
+        geometry=Geometry(size=64, num_ranks=2), engine="ooc",
+        ooc_band_rows=13, ooc_budget_mb=0,
+        telemetry_dir=str(tmp_path), run_id="oocpin",
+    )
+    rt.run(pattern=7, iterations=10)
+    recs = [json.loads(ln) for ln in open(tmp_path / "oocpin.rank0.jsonl")]
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert chunks and all("ooc" in c for c in chunks)
+    assert all(
+        c["ooc"]["bands"] == rt._ooc_plan.num_bands for c in chunks
+    )

@@ -728,3 +728,80 @@ def test_pipelined_depth_k_matches_explicit_and_oracle(cfg):
     np.testing.assert_array_equal(ref, oracle.run_torus(board, n))
     got = np.asarray(build(mode, k)(place()))
     np.testing.assert_array_equal(got, ref)
+
+
+# -- out-of-core streaming (docs/STREAMING.md) -------------------------------
+#
+# The ooc tier re-expresses the board as host-resident row bands pushed
+# through a fixed device footprint: alternating sweep direction,
+# one-visit-delayed drains, a wrap buffer for the first seam, dead-band
+# skipping, and a remainder-absorbing last band.  Each of those is a
+# seam a pointwise test samples once; the family drives random
+# (geometry, band height, visit depth, chunk schedule, sweep parity,
+# skipping) through the full scheduler against the independent oracle.
+
+
+def _ooc_run(board, depth, band_rows, schedule, parity, skip):
+    from gol_tpu.ooc import OocScheduler, plan_bands
+
+    h, w = board.shape
+    plan = plan_bands(h, w, depth, band_rows=band_rows)
+    sched = OocScheduler(plan, skip_dead=skip)
+    sched.load_dense(board)
+    sched._sweep_parity = parity  # random starting sweep direction
+    gen = 0
+    for take in schedule:
+        sched.run_chunk(take, gen)
+        gen += take
+    return sched.dense()
+
+
+@given(
+    h=st.integers(min_value=8, max_value=72),
+    words=st.integers(min_value=1, max_value=2),
+    seed=seeds,
+    depth=st.integers(min_value=1, max_value=4),
+    band=st.integers(min_value=1, max_value=24),
+    schedule=st.lists(
+        st.integers(min_value=1, max_value=9), min_size=1, max_size=3
+    ),
+    parity=st.integers(min_value=0, max_value=1),
+    skip=st.booleans(),
+)
+@settings(**_SETTINGS)
+def test_ooc_streamed_matches_oracle_any_banding(
+    h, words, seed, depth, band, schedule, parity, skip
+):
+    """Streamed == oracle over random banding, depth, chunking, sweep
+    parity and dead-band skipping — remainder bands included (any h not
+    a multiple of the band height exercises the absorbing last band)."""
+    w = 32 * words
+    band = max(depth, min(band, h))  # planner floor: band height >= k
+    board = _board(h, w, seed)
+    got = _ooc_run(board, depth, band, schedule, parity, skip)
+    np.testing.assert_array_equal(got, oracle.run_torus(board, sum(schedule)))
+
+
+@given(
+    seam=st.integers(min_value=1, max_value=4),
+    dx=st.integers(min_value=0, max_value=24),
+    seed=seeds,
+    depth=st.integers(min_value=1, max_value=3),
+    parity=st.integers(min_value=0, max_value=1),
+    n=st.integers(min_value=1, max_value=8),
+)
+@settings(**_SETTINGS)
+def test_ooc_seam_straddling_pattern_with_skipping(seam, dx, seed, depth, parity, n):
+    """A lone glider straddling a random band seam on an otherwise-dead
+    board: most bands are skippable, and the pattern's light cone
+    crosses the seam every sweep — exactly the read the wrap buffer and
+    deferred drain protect.  Skip-on must equal skip-off equal oracle."""
+    h, w, band = 60, 32, 10
+    board = np.zeros((h, w), dtype=np.uint8)
+    glider = np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]], dtype=np.uint8)
+    r = seam * band - 1 - (seed % 2)  # straddle: rows seam*B-2..seam*B+1
+    board[r:r + 3, dx:dx + 3] = glider
+    ref = oracle.run_torus(board, n)
+    for skip in (True, False):
+        got = _ooc_run(board, depth, band, (n,), parity, skip)
+        np.testing.assert_array_equal(got, ref)

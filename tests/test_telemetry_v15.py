@@ -1,0 +1,172 @@
+"""Schema v15 (out-of-core streaming blocks) + v1–v14 compat.
+
+Companion to tests/test_telemetry.py (v1) and test_telemetry_v{2..14}.py.
+Here:
+
+- the v15 addition round-trips: ``chunk`` events of an ``--engine ooc``
+  run carry an ``ooc`` block — band count, visits, dead-band skips, the
+  chunk's H2D/D2H byte volume, and the measured ``overlap_fraction``
+  (docs/STREAMING.md, docs/OBSERVABILITY.md);
+- the committed v15 fixture is a REAL streamed session: a Gosper gun on
+  a 128×64 board pushed through a 9-band plan at depth 3 — every chunk
+  carries the block, dead bands were skipped, and overlap was measured
+  (> 0) on every chunk;
+- ``summarize`` renders the conditional ``ooc (bands skip h2d/d2h
+  ovl%)`` column for streamed runs and omits it otherwise;
+- **back-compat**: all FOURTEEN committed fixtures — PR 2 (v1) through
+  PR 20 (v15) — still load, merge, and render in one ``summarize``
+  pass (exit 0);
+- a stream from a FUTURE schema (99) fails loudly ("newer than this
+  reader supports", exit 2) instead of KeyError'ing deep in a consumer.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+
+import jax
+import pytest
+
+from gol_tpu import telemetry
+from gol_tpu.telemetry import summarize as summ_mod
+
+jax.config.update("jax_platforms", "cpu")
+
+DATA = pathlib.Path(__file__).parent / "data"
+FIXTURES = {
+    1: DATA / "telemetry_v1" / "pr2run.rank0.jsonl",
+    2: DATA / "telemetry_v2" / "pr3run.rank0.jsonl",
+    3: DATA / "telemetry_v3" / "pr5run.rank0.jsonl",
+    4: DATA / "telemetry_v4" / "pr6run.rank0.jsonl",
+    5: DATA / "telemetry_v5" / "pr7run.rank0.jsonl",
+    6: DATA / "telemetry_v6" / "pr8run.rank0.jsonl",
+    7: DATA / "telemetry_v7" / "pr9run.rank0.jsonl",
+    8: DATA / "telemetry_v8" / "pr10run.rank0.jsonl",
+    9: DATA / "telemetry_v9" / "pr12run.rank0.jsonl",
+    11: DATA / "telemetry_v11" / "pr14run.rank0.jsonl",
+    12: DATA / "telemetry_v12" / "pr17run.rank0.jsonl",
+    13: DATA / "telemetry_v13" / "pr18run.rank0.jsonl",
+    14: DATA / "telemetry_v14" / "pr19run.rank0.jsonl",
+    15: DATA / "telemetry_v15" / "pr20run.rank0.jsonl",
+}
+
+OOC_KEYS = {
+    "bands", "visits", "skipped_bands", "bytes_h2d", "bytes_d2h",
+    "overlap_fraction",
+}
+
+
+def _v15_stream(directory, run_id="v15"):
+    with telemetry.EventLog(
+        str(directory), run_id=run_id, process_index=0
+    ) as ev:
+        ev.run_header({"engine": "ooc", "height": 256, "width": 64})
+        ev.chunk_event(
+            0, 4, 4, 0.01, 65536, None,
+            ooc=dict(
+                bands=8, visits=12, skipped_bands=4, bytes_h2d=4096,
+                bytes_d2h=3072, overlap_fraction=0.62, sweeps=4,
+                h2d_s=0.001, d2h_s=0.002, hidden_s=0.0019,
+            ),
+        )
+        return ev.path
+
+
+def test_v15_roundtrip(tmp_path):
+    path = _v15_stream(tmp_path)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs[0]["schema"] == telemetry.SCHEMA_VERSION >= 15
+    assert set(telemetry.SUPPORTED_SCHEMAS) >= set(range(1, 16))
+    (chunk,) = [r for r in recs if r["event"] == "chunk"]
+    assert OOC_KEYS <= set(chunk["ooc"])
+    assert chunk["ooc"]["skipped_bands"] == 4
+    assert chunk["ooc"]["overlap_fraction"] == pytest.approx(0.62)
+
+
+def test_committed_fixture_schemas():
+    for want, fixture in FIXTURES.items():
+        head = json.loads(fixture.open().readline())
+        assert head["schema"] == want, fixture
+
+
+def test_v15_fixture_is_a_real_streamed_session():
+    """The committed stream came from a real ooc run: a Gosper gun on
+    128×64 streamed through a 9-band depth-3 plan — every chunk carries
+    the block, dead bands moved zero bytes, and the three-deep rotation
+    measurably hid transfer behind compute on every chunk."""
+    recs = [json.loads(ln) for ln in FIXTURES[15].open()]
+    cfg = recs[0]["config"]
+    assert cfg["resolved_engine"] == "ooc" and cfg["mesh"] is None
+    chunks = [r for r in recs if r["event"] == "chunk"]
+    assert chunks and all(OOC_KEYS <= set(c.get("ooc", {})) for c in chunks)
+    for c in chunks:
+        o = c["ooc"]
+        # The gun is band-local: most of the 9 bands are dead and were
+        # never fetched — transfer scales with active bands, not area.
+        assert o["bands"] == 9 and o["skipped_bands"] >= 1
+        assert o["visits"] + o["skipped_bands"] * (
+            c["take"] // 3 or 1
+        ) >= o["bands"]
+        assert o["bytes_h2d"] > 0 and o["bytes_d2h"] > 0
+        assert 0.0 < o["overlap_fraction"] <= 1.0
+    # The accounting is self-consistent: whole-board transfer would be
+    # rows*row_bytes per direction per sweep; the skip kept us under it.
+    row_bytes = cfg["width"] // 32 * 4
+    whole = cfg["height"] * row_bytes
+    assert all(c["ooc"]["bytes_d2h"] < whole for c in chunks)
+    # Stats ride along (the host-side fold): same record shape as every
+    # in-core --stats run.
+    stats = [r for r in recs if r["event"] == "stats"]
+    assert len(stats) == len(chunks)
+    assert all(s["population"] > 0 for s in stats)
+
+
+def test_v15_fixture_summarize_renders_ooc_column(capsys):
+    assert summ_mod.main(
+        ["summarize", str(FIXTURES[15].parent)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "ooc (bands skip h2d/d2h ovl%)" in out
+    assert "9b skip" in out and "ovl" in out
+
+
+def test_non_ooc_runs_omit_the_column(capsys):
+    # v14's fleet fixture has chunkless records; v1's has plain chunks —
+    # neither should grow the ooc column.
+    assert summ_mod.main(["summarize", str(FIXTURES[1].parent)]) == 0
+    out = capsys.readouterr().out
+    assert "ooc (" not in out
+
+
+def test_v1_to_v15_merge_renders(tmp_path, capsys):
+    for fixture in FIXTURES.values():
+        shutil.copy(fixture, tmp_path / fixture.name)
+    _v15_stream(tmp_path)
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for run_id in (
+        "pr2run", "pr3run", "pr5run", "pr6run", "pr7run", "pr8run",
+        "pr9run", "pr10run", "pr12run", "pr14run", "pr17run",
+        "pr18run", "pr19run", "pr20run", "v15",
+    ):
+        assert run_id in out
+    assert "ooc (bands skip h2d/d2h ovl%)" in out
+
+
+def test_future_schema_fails_loudly_not_keyerror(tmp_path, capsys):
+    (tmp_path / "fut.rank0.jsonl").write_text(
+        json.dumps(
+            {
+                "event": "run_header", "t": 0.0, "schema": 99,
+                "run_id": "fut", "process_index": 0, "process_count": 1,
+                "config": {},
+            }
+        )
+        + "\n"
+    )
+    assert summ_mod.main(["summarize", str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "schema v99 is newer than this reader supports" in err
+    assert f"max v{telemetry.SCHEMA_VERSION}" in err
